@@ -1,0 +1,62 @@
+"""gofr_tpu — a TPU-native microservice framework.
+
+A brand-new framework with the capabilities of GoFr (the Go reference at
+/root/reference: one ``App`` running HTTP/gRPC/metrics servers, pub/sub
+subscribers and CLI commands behind a single context-based handler signature,
+with a dependency container, datasources, inter-service clients, migrations
+and out-of-the-box observability — see reference pkg/gofr/gofr.go:29-46)
+PLUS a first-class TPU inference path: JAX/XLA models, request-coalescing
+continuous batching, Pallas kernels and ICI-sharded serving via
+``jax.sharding``.
+
+Handler signature (reference pkg/gofr/handler.go:12 uses
+``func(c *Context) (interface{}, error)``; the Pythonic equivalent):
+
+    @app.get("/greet")
+    def greet(ctx):
+        return {"hello": ctx.request.param("name")}
+
+Errors are raised, not returned: raise ``gofr_tpu.errors.HTTPError`` (or a
+subclass) to control the response status.
+"""
+
+from .version import __version__, FRAMEWORK
+from .errors import (
+    GofrError,
+    HTTPError,
+    BadRequest,
+    Unauthorized,
+    Forbidden,
+    NotFound,
+    EntityNotFound,
+    InternalServerError,
+)
+from .config import Config, EnvConfig, MapConfig
+from .glog import Logger, LogLevel, new_logger
+from .context import Context
+from .container import Container
+from .app import App, new_app, new_cmd
+
+__all__ = [
+    "__version__",
+    "FRAMEWORK",
+    "App",
+    "new_app",
+    "new_cmd",
+    "Context",
+    "Container",
+    "Config",
+    "EnvConfig",
+    "MapConfig",
+    "Logger",
+    "LogLevel",
+    "new_logger",
+    "GofrError",
+    "HTTPError",
+    "BadRequest",
+    "Unauthorized",
+    "Forbidden",
+    "NotFound",
+    "EntityNotFound",
+    "InternalServerError",
+]

@@ -1,0 +1,301 @@
+"""The App: one object running HTTP, gRPC, metrics, subscribers and CLI.
+
+Reference: pkg/gofr/gofr.go —
+  - New (gofr.go:56) / NewCMD (gofr.go:93)
+  - route registration GET/POST/PUT/PATCH/DELETE (gofr.go:190-207)
+  - Run (gofr.go:108-164): metrics server goroutine, default routes
+    (health/alive/favicon/catch-all, gofr.go:125-141), HTTP server, gRPC
+    server if a service registered (gofr.go:144-151), one goroutine per
+    subscription (gofr.go:154-161)
+  - auth enablers (gofr.go:268-302), AddHTTPService (gofr.go:177),
+    Subscribe (gofr.go:304), SubCommand (gofr.go:223), Migrate (gofr.go:227)
+
+Differences by design: ``run(block=False)`` + ``stop()`` exist so apps are
+testable in-process (the reference blocks forever on a WaitGroup), and the
+gRPC layer supports server streaming (the reference is unary-only,
+grpc.go:22-26 — streaming is required for token streaming).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Callable, Iterable
+
+from .config import Config, EnvConfig
+from .container import Container
+from .context import Context
+from .http.middleware import (
+    apikey_auth_middleware,
+    basic_auth_middleware,
+    cors_middleware,
+    logging_middleware,
+    metrics_middleware,
+    oauth_middleware,
+    tracer_middleware,
+    JWKSKeyProvider,
+)
+from .http.request import Request
+from .http.responder import Responder, ResponseWriter, FileResponse
+from .http.router import Router
+from .http.server import HTTPServer
+from .metrics import update_system_metrics
+from .static import FAVICON_ICO
+from .subscriber import SubscriptionManager
+from .version import __version__
+
+# Default ports (reference pkg/gofr/default.go:3-7)
+DEFAULT_HTTP_PORT = 8000
+DEFAULT_METRICS_PORT = 2121
+DEFAULT_GRPC_PORT = 9000
+
+HandlerFunc = Callable[[Context], Any]
+
+
+class App:
+    def __init__(self, config: Config | None = None, config_folder: str = "./configs"):
+        self.config: Config = config if config is not None else EnvConfig(config_folder)
+        self.container = Container(self.config)
+        self.logger = self.container.logger
+
+        self.router = Router()
+        self._http_registered = False
+        self.http_port = self.config.get_int("HTTP_PORT", DEFAULT_HTTP_PORT)
+        self.metrics_port = self.config.get_int("METRICS_PORT", DEFAULT_METRICS_PORT)
+        self.grpc_port = self.config.get_int("GRPC_PORT", DEFAULT_GRPC_PORT)
+
+        self._http_server: HTTPServer | None = None
+        self._metrics_server: HTTPServer | None = None
+        self._grpc_server = None
+        self._grpc_services: list = []
+        self.subscription_manager = SubscriptionManager(self.container)
+        self._cmd_routes: list[tuple] = []
+        self._running = threading.Event()
+
+        # Middleware chain in reference order (http/router.go:19-24):
+        # Tracer -> Logging(+recovery) -> CORS -> Metrics [-> auth]
+        self.router.use(tracer_middleware(self.container.tracer))
+        self.router.use(logging_middleware(self.logger))
+        self.router.use(cors_middleware())
+        self.router.use(metrics_middleware(self.container.metrics))
+
+    # -- handler adaptation (reference handler.go:32-36) --------------------
+    def _adapt(self, fn: HandlerFunc):
+        def transport_handler(req: Request, w: ResponseWriter) -> None:
+            ctx = Context(request=req, container=self.container, responder=Responder(w))
+            with ctx.trace("gofr-handler"):
+                try:
+                    data = fn(ctx)
+                except Exception as e:
+                    Responder(w).respond(None, e)
+                    if not hasattr(e, "status_code"):
+                        raise  # let logging middleware record the traceback
+                    return
+            if w._streaming or (w.body and data is None):
+                return  # handler streamed or wrote directly
+            Responder(w).respond(data, None)
+        transport_handler.__name__ = getattr(fn, "__name__", "handler")
+        return transport_handler
+
+    def add_route(self, method: str, path: str, fn: HandlerFunc) -> None:
+        """reference gofr.go:209 add — registers and marks HTTP serving on."""
+        self._http_registered = True
+        self.router.add(method, path, self._adapt(fn))
+
+    def _route_decorator(self, method: str, path: str):
+        def deco(fn: HandlerFunc) -> HandlerFunc:
+            self.add_route(method, path, fn)
+            return fn
+        return deco
+
+    def get(self, path: str, fn: HandlerFunc | None = None):
+        """``app.get("/x", handler)`` or ``@app.get("/x")`` (gofr.go:190)."""
+        if fn is None:
+            return self._route_decorator("GET", path)
+        self.add_route("GET", path, fn)
+        return fn
+
+    def post(self, path: str, fn: HandlerFunc | None = None):
+        if fn is None:
+            return self._route_decorator("POST", path)
+        self.add_route("POST", path, fn)
+        return fn
+
+    def put(self, path: str, fn: HandlerFunc | None = None):
+        if fn is None:
+            return self._route_decorator("PUT", path)
+        self.add_route("PUT", path, fn)
+        return fn
+
+    def patch(self, path: str, fn: HandlerFunc | None = None):
+        if fn is None:
+            return self._route_decorator("PATCH", path)
+        self.add_route("PATCH", path, fn)
+        return fn
+
+    def delete(self, path: str, fn: HandlerFunc | None = None):
+        if fn is None:
+            return self._route_decorator("DELETE", path)
+        self.add_route("DELETE", path, fn)
+        return fn
+
+    # -- auth enablers (reference gofr.go:268-302) ---------------------------
+    def enable_basic_auth(self, users: dict[str, str] | None = None,
+                          validate: Callable[[str, str], bool] | None = None) -> None:
+        self.router.use(basic_auth_middleware(users, validate))
+
+    def enable_apikey_auth(self, *keys: str, validate: Callable[[str], bool] | None = None) -> None:
+        self.router.use(apikey_auth_middleware(keys, validate))
+
+    def enable_oauth(self, jwks_url: str, refresh_interval: float = 300.0, http_get=None) -> None:
+        provider = JWKSKeyProvider(jwks_url, refresh_interval, http_get=http_get)
+        self._jwks_provider = provider  # kept so stop() can halt its refresh thread
+        self.router.use(oauth_middleware(provider))
+
+    # -- services (reference gofr.go:177 AddHTTPService) ---------------------
+    def add_http_service(self, name: str, address: str, *options) -> None:
+        from .service import new_http_service
+
+        self.container.register_service(
+            name,
+            new_http_service(address, self.logger, self.container.metrics, *options),
+        )
+
+    # -- pub/sub (reference gofr.go:304-312) ---------------------------------
+    def subscribe(self, topic: str, fn: HandlerFunc | None = None):
+        if fn is None:
+            def deco(f: HandlerFunc) -> HandlerFunc:
+                self.subscription_manager.register(topic, f)
+                return f
+            return deco
+        self.subscription_manager.register(topic, fn)
+        return fn
+
+    # -- gRPC (reference gofr.go:49-53 RegisterService) ----------------------
+    def register_grpc_service(self, service) -> None:
+        self._grpc_services.append(service)
+
+    # -- CLI (reference gofr.go:223 SubCommand) ------------------------------
+    def sub_command(self, pattern: str, fn: HandlerFunc | None = None, description: str = ""):
+        if fn is None:
+            def deco(f: HandlerFunc) -> HandlerFunc:
+                self._cmd_routes.append((pattern, f, description))
+                return f
+            return deco
+        self._cmd_routes.append((pattern, fn, description))
+        return fn
+
+    # -- migrations (reference gofr.go:227-229 Migrate) ----------------------
+    def migrate(self, migrations: dict) -> None:
+        from .migration import run as migration_run
+
+        migration_run(migrations, self.container)
+
+    # -- default routes (reference gofr.go:125-141, handler.go:38-57) --------
+    def _install_default_routes(self) -> None:
+        def health(req: Request, w: ResponseWriter) -> None:
+            payload = self.container.health()
+            w.set_header("Content-Type", "application/json")
+            w.write(json.dumps({"data": payload}, default=str).encode())
+
+        def alive(req: Request, w: ResponseWriter) -> None:
+            w.set_header("Content-Type", "application/json")
+            w.write(b'{"data":{"status":"UP"}}')
+
+        def favicon(req: Request, w: ResponseWriter) -> None:
+            w.set_header("Content-Type", "image/x-icon")
+            w.write(FAVICON_ICO)
+
+        self.router.add("GET", "/.well-known/health", health)
+        self.router.add("GET", "/.well-known/alive", alive)
+        self.router.add("GET", "/favicon.ico", favicon)
+
+    def _metrics_router(self) -> Router:
+        r = Router()
+
+        def metrics_handler(req: Request, w: ResponseWriter) -> None:
+            update_system_metrics(self.container.metrics)
+            w.set_header("Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+            w.write(self.container.metrics.render_prometheus().encode())
+
+        r.add("GET", "/metrics", metrics_handler)
+        return r
+
+    # -- lifecycle (reference gofr.go:108-164 Run) ---------------------------
+    def run(self, block: bool = True) -> None:
+        c = self.container
+        self.logger.info({"event": "starting app", "name": c.app_name,
+                          "version": c.app_version, "framework": __version__})
+
+        self._metrics_server = HTTPServer(self._metrics_router(), self.metrics_port, self.logger)
+        self._metrics_server.start()
+        self.metrics_port = self._metrics_server.port
+
+        if self._http_registered:
+            self._install_default_routes()
+            self._http_server = HTTPServer(self.router, self.http_port, self.logger)
+            self._http_server.start()
+            self.http_port = self._http_server.port
+
+        if self._grpc_services:
+            from .grpcx.server import GRPCServer
+
+            self._grpc_server = GRPCServer(
+                self._grpc_services, self.grpc_port, self.container)
+            self._grpc_server.start()
+            self.grpc_port = self._grpc_server.port
+
+        if self.subscription_manager.subscriptions:
+            self.subscription_manager.start()
+
+        self._running.set()
+        if block:
+            try:
+                threading.Event().wait()
+            except KeyboardInterrupt:
+                self.stop()
+
+    def stop(self) -> None:
+        for srv in (self._http_server, self._metrics_server):
+            if srv is not None:
+                srv.stop()
+        if self._grpc_server is not None:
+            self._grpc_server.stop()
+        self.subscription_manager.stop()
+        provider = getattr(self, "_jwks_provider", None)
+        if provider is not None:
+            provider.shutdown()
+        self.container.close()
+        self._running.clear()
+
+    def __enter__(self) -> "App":
+        self.run(block=False)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- CMD apps (reference gofr.go:93-110 + cmd.go:27-63) -------------------
+    def run_command(self, argv: Iterable[str] | None = None) -> int:
+        from .cli import run_cmd
+
+        return run_cmd(self, argv)
+
+
+def new_app(config: Config | None = None, **kw) -> App:
+    """reference gofr.New (gofr.go:56)."""
+    return App(config, **kw)
+
+
+def new_cmd(config: Config | None = None, **kw) -> App:
+    """reference gofr.NewCMD (gofr.go:93) — same App, CLI entrypoint; a
+    CMD_LOGS_FILE config routes logs to a file (gofr.go:98)."""
+    app = App(config, **kw)
+    log_file = app.config.get("CMD_LOGS_FILE")
+    if log_file:
+        from .glog import new_file_logger, LogLevel
+
+        app.container.logger = new_file_logger(
+            log_file, LogLevel.parse(app.config.get("LOG_LEVEL")))
+        app.logger = app.container.logger
+    return app

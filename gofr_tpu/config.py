@@ -1,0 +1,119 @@
+"""Configuration: ``.env`` file + process environment.
+
+Reference: pkg/gofr/config/config.go:3-6 defines ``Config{Get, GetOrDefault}``;
+pkg/gofr/config/godotenv.go:18-33 loads ``./configs/.env`` and then falls back
+to the process env. We keep the same two-method surface plus typed helpers
+(the reference scatters ``strconv`` calls at each use site; a typed getter is
+the idiomatic Python equivalent).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class Config(Protocol):
+    def get(self, key: str) -> str | None: ...
+
+    def get_or_default(self, key: str, default: str) -> str: ...
+
+
+class _TypedMixin:
+    """Typed convenience getters shared by all Config implementations."""
+
+    def get(self, key: str) -> str | None:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def get_or_default(self, key: str, default: str) -> str:
+        v = self.get(key)
+        return v if v not in (None, "") else default
+
+    def get_int(self, key: str, default: int) -> int:
+        v = self.get(key)
+        if v in (None, ""):
+            return default
+        try:
+            return int(v)
+        except ValueError:
+            return default
+
+    def get_float(self, key: str, default: float) -> float:
+        v = self.get(key)
+        if v in (None, ""):
+            return default
+        try:
+            return float(v)
+        except ValueError:
+            return default
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self.get(key)
+        if v in (None, ""):
+            return default
+        return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def parse_env_file(path: str) -> dict[str, str]:
+    """Parse a dotenv-style file: KEY=VALUE lines, '#' comments, optional
+    quoting. Mirrors the subset of godotenv the reference relies on."""
+    out: dict[str, str] = {}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                if line.startswith("export "):
+                    line = line[len("export "):]
+                if "=" not in line:
+                    continue
+                key, _, val = line.partition("=")
+                key = key.strip()
+                val = val.strip()
+                if len(val) >= 2 and val[0] == val[-1] and val[0] in "\"'":
+                    val = val[1:-1]
+                else:
+                    # strip trailing inline comment
+                    if " #" in val:
+                        val = val.split(" #", 1)[0].rstrip()
+                if key:
+                    out[key] = val
+    except OSError:
+        pass
+    return out
+
+
+class EnvConfig(_TypedMixin):
+    """Loads ``<folder>/.env`` (+ ``.<APP_ENV>.env`` override) then process env.
+
+    Reference: pkg/gofr/config/godotenv.go:11-33, selected by App.readConfig
+    (pkg/gofr/gofr.go:167-174) which uses ``./configs``.
+    """
+
+    def __init__(self, folder: str = "./configs"):
+        self.folder = folder
+        self._file_vars: dict[str, str] = parse_env_file(os.path.join(folder, ".env"))
+        app_env = os.environ.get("APP_ENV", "")
+        if app_env:
+            self._file_vars.update(
+                parse_env_file(os.path.join(folder, f".{app_env}.env"))
+            )
+
+    def get(self, key: str) -> str | None:
+        # Process env wins over the file, matching godotenv's non-override
+        # load into the environment followed by os.Getenv reads.
+        if key in os.environ:
+            return os.environ[key]
+        return self._file_vars.get(key)
+
+
+class MapConfig(_TypedMixin):
+    """In-memory config for tests (reference: pkg/gofr/testutil/mock_config.go:11)."""
+
+    def __init__(self, values: Mapping[str, str] | None = None):
+        self.values: dict[str, str] = dict(values or {})
+
+    def get(self, key: str) -> str | None:
+        return self.values.get(key)

@@ -1,0 +1,145 @@
+"""Dependency container: the one object carrying every shared resource.
+
+Reference: pkg/gofr/container/container.go:26-38 (Container with embedded
+Logger, Services, metricsManager, PubSub, Redis, SQL) and :44-126
+(``NewContainer(conf)`` wiring everything from config with graceful
+degradation — a down datasource logs and stays None instead of failing
+startup). Health aggregation: container/health.go:5-25. The TPU engine is a
+first-class datasource here — the whole point of the framework.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from . import metrics as gmetrics
+from . import tracing
+from .config import Config, EnvConfig
+from .datasource import Health, STATUS_DOWN, STATUS_UP
+from .glog import Logger, LogLevel, new_logger
+
+
+class Container:
+    def __init__(self, config: Config | None = None, logger: Logger | None = None):
+        self.config: Config = config if config is not None else EnvConfig()
+        self.app_name = self.config.get_or_default("APP_NAME", "gofr-app")
+        self.app_version = self.config.get_or_default("APP_VERSION", "dev")
+
+        self.logger: Logger = logger if logger is not None else new_logger(
+            LogLevel.parse(self.config.get("LOG_LEVEL"))
+        )
+        self.metrics = gmetrics.Manager(logger=self.logger)
+        gmetrics.register_framework_metrics(self.metrics)
+        self.tracer = tracing.tracer_from_config(self.config, self.app_name)
+
+        # Datasources — wired from config, graceful degradation throughout
+        self.redis = None
+        self.sql = None
+        self.pubsub = None
+        self.tpu = None
+        self.services: dict[str, Any] = {}
+        self._remote_level_poller = None
+
+        self._wire_datasources()
+        self._wire_remote_log_level()
+
+    # -- wiring -------------------------------------------------------------
+    def _wire_datasources(self) -> None:
+        cfg, log = self.config, self.logger
+        if cfg.get("REDIS_HOST"):
+            try:
+                from .datasource.redisclient import new_redis_client
+
+                self.redis = new_redis_client(cfg, log, self.metrics)
+            except Exception as e:
+                log.error({"event": "redis connect failed", "error": repr(e)})
+        if cfg.get("DB_DIALECT") or cfg.get("DB_HOST"):
+            try:
+                from .datasource.sql import new_sql
+
+                self.sql = new_sql(cfg, log, self.metrics)
+            except Exception as e:
+                log.error({"event": "sql connect failed", "error": repr(e)})
+        backend = (cfg.get("PUBSUB_BACKEND") or "").upper()
+        if backend:
+            try:
+                from .datasource.pubsub import new_pubsub_client
+
+                self.pubsub = new_pubsub_client(backend, cfg, log, self.metrics)
+            except Exception as e:
+                log.error({"event": "pubsub connect failed", "backend": backend, "error": repr(e)})
+        if cfg.get("TPU_MODEL") or cfg.get_bool("TPU_ENABLED"):
+            try:
+                from .tpu import new_engine_from_config
+
+                self.tpu = new_engine_from_config(cfg, log, self.metrics)
+            except Exception as e:
+                log.error({"event": "tpu engine init failed", "error": repr(e)})
+
+    def _wire_remote_log_level(self) -> None:
+        """Reference: logging/dynamicLevelLogger.go wired at
+        container/container.go:64-67 — poll REMOTE_LOG_URL for level changes."""
+        url = self.config.get("REMOTE_LOG_URL")
+        if not url:
+            return
+        try:
+            from .remote_level import RemoteLevelPoller
+
+            interval = self.config.get_float("REMOTE_LOG_FETCH_INTERVAL", 15.0)
+            self._remote_level_poller = RemoteLevelPoller(self.logger, url, interval)
+        except Exception as e:
+            self.logger.error({"event": "remote log level init failed", "error": repr(e)})
+
+    # -- service registry (container/container.go:130) ----------------------
+    def register_service(self, name: str, svc: Any) -> None:
+        self.services[name] = svc
+
+    def get_http_service(self, name: str) -> Any:
+        return self.services.get(name)
+
+    def get_publisher(self):
+        return self.pubsub
+
+    def get_subscriber(self):
+        return self.pubsub
+
+    # -- health (container/health.go:5-25) ----------------------------------
+    def health(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "name": self.app_name,
+            "version": self.app_version,
+            "status": STATUS_UP,
+        }
+        for name, ds in (("redis", self.redis), ("sql", self.sql),
+                         ("pubsub", self.pubsub), ("tpu", self.tpu)):
+            if ds is None:
+                continue
+            try:
+                h: Health = ds.health_check()
+                out[name] = h.to_dict()
+                if h.status == STATUS_DOWN:
+                    out["status"] = STATUS_DOWN
+            except Exception as e:
+                out[name] = {"status": STATUS_DOWN, "details": {"error": repr(e)}}
+                out["status"] = STATUS_DOWN
+        services = {}
+        for name, svc in self.services.items():
+            try:
+                services[name] = svc.health_check().to_dict()
+            except Exception as e:
+                services[name] = {"status": STATUS_DOWN, "details": {"error": repr(e)}}
+        if services:
+            out["services"] = services
+        return out
+
+    def close(self) -> None:
+        for ds in (self.redis, self.sql, self.pubsub, self.tpu):
+            if ds is not None and hasattr(ds, "close"):
+                try:
+                    ds.close()
+                except Exception:
+                    pass
+        if self._remote_level_poller is not None:
+            self._remote_level_poller.stop()
+        if self.tracer is not None and self.tracer.exporter is not None:
+            self.tracer.exporter.shutdown()  # final span flush

@@ -1,0 +1,81 @@
+"""Per-request Context: request + container access behind one facade.
+
+Reference: pkg/gofr/context.go:12-27 — Context embeds context.Context, the
+transport-agnostic Request interface and *container.Container, plus Trace()
+(:45) and Bind() (:53). Handlers receive exactly one of these regardless of
+transport (HTTP, gRPC adapter, pub/sub message, CLI).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any
+
+from .container import Container
+
+
+class Context:
+    def __init__(self, request: Any, container: Container, responder: Any = None):
+        self.request = request
+        self.container = container
+        self._responder = responder
+
+    # -- container facade ---------------------------------------------------
+    @property
+    def logger(self):
+        return self.container.logger
+
+    @property
+    def metrics(self):
+        return self.container.metrics
+
+    @property
+    def config(self):
+        return self.container.config
+
+    @property
+    def redis(self):
+        return self.container.redis
+
+    @property
+    def sql(self):
+        return self.container.sql
+
+    @property
+    def tpu(self):
+        """The TPU inference datasource — ``ctx.tpu.predict(...)``."""
+        return self.container.tpu
+
+    def get_http_service(self, name: str):
+        return self.container.get_http_service(name)
+
+    def get_publisher(self):
+        return self.container.get_publisher()
+
+    # -- request facade -----------------------------------------------------
+    def param(self, key: str, default: str = "") -> str:
+        return self.request.param(key, default)
+
+    def path_param(self, key: str, default: str = "") -> str:
+        return self.request.path_param(key, default)
+
+    def bind(self, into: type | None = None) -> Any:
+        """Deserialize the request body (reference context.go:53 Bind)."""
+        return self.request.bind(into)
+
+    def header(self, key: str, default: str = "") -> str:
+        if hasattr(self.request, "header"):
+            return self.request.header(key, default)
+        return default
+
+    # -- tracing (reference context.go:45-51 Trace) --------------------------
+    def trace(self, name: str):
+        """Context manager opening a user span:
+
+            with ctx.trace("expensive-work"):
+                ...
+        """
+        tracer = self.container.tracer
+        if tracer is None:
+            return contextlib.nullcontext()
+        return tracer.span(name)

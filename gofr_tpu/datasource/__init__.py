@@ -1,0 +1,32 @@
+"""Datasource layer: shared health types and the reduced logger surface.
+
+Reference: pkg/gofr/datasource/health.go:3-11 (Health type + status consts)
+and datasource/logger.go:10-16 (reduced Logger interface so datasources do
+not depend on the full logging package).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Protocol, runtime_checkable
+
+STATUS_UP = "UP"
+STATUS_DOWN = "DOWN"
+STATUS_DEGRADED = "DEGRADED"
+
+
+@dataclass
+class Health:
+    status: str = STATUS_DOWN
+    details: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"status": self.status, "details": self.details}
+
+
+@runtime_checkable
+class DSLogger(Protocol):
+    def debug(self, *args: Any) -> None: ...
+    def info(self, *args: Any) -> None: ...
+    def warn(self, *args: Any) -> None: ...
+    def error(self, *args: Any) -> None: ...

@@ -1,0 +1,92 @@
+"""Framework error hierarchy.
+
+The reference maps handler errors to HTTP statuses in
+pkg/gofr/http/responder.go:47-57 (nil -> 200, ErrorEntityNotFound -> 404,
+else -> 500). Here the mapping is carried by the exception itself: any
+handler may raise ``HTTPError`` (or subclass) with an explicit status;
+unexpected exceptions become 500s in the recovery middleware
+(reference pkg/gofr/http/middleware/logger.go:94-117).
+"""
+
+from __future__ import annotations
+
+
+class GofrError(Exception):
+    """Base class for all framework errors."""
+
+
+class HTTPError(GofrError):
+    """An error with an explicit HTTP status code."""
+
+    status_code: int = 500
+
+    def __init__(self, message: str = "", status_code: int | None = None):
+        super().__init__(message or self.__class__.__name__)
+        if status_code is not None:
+            self.status_code = status_code
+        self.message = message or self.__class__.__name__
+
+    def to_dict(self) -> dict:
+        return {"message": self.message}
+
+
+class BadRequest(HTTPError):
+    status_code = 400
+
+
+class Unauthorized(HTTPError):
+    status_code = 401
+
+
+class Forbidden(HTTPError):
+    status_code = 403
+
+
+class NotFound(HTTPError):
+    status_code = 404
+
+
+class EntityNotFound(NotFound):
+    """Reference: pkg/gofr/http/errors.go ErrorEntityNotFound -> 404."""
+
+    def __init__(self, name: str = "entity", value: str = ""):
+        super().__init__(f"No {name} found for value {value!r}")
+        self.name = name
+        self.value = value
+
+
+class InvalidParameter(BadRequest):
+    def __init__(self, *params: str):
+        super().__init__(f"Invalid parameter(s): {', '.join(params)}")
+        self.params = params
+
+
+class MissingParameter(BadRequest):
+    def __init__(self, *params: str):
+        super().__init__(f"Missing parameter(s): {', '.join(params)}")
+        self.params = params
+
+
+class InternalServerError(HTTPError):
+    status_code = 500
+
+
+class ServiceUnavailable(HTTPError):
+    status_code = 503
+
+
+class CircuitOpenError(ServiceUnavailable):
+    """Raised by the client-side circuit breaker while open
+    (reference: pkg/gofr/service/circuit_breaker.go ErrCircuitOpen)."""
+
+    def __init__(self) -> None:
+        super().__init__("circuit breaker is open")
+
+
+def status_from_error(err: BaseException | None) -> int:
+    """Map an exception to an HTTP status (reference responder.go:47-57)."""
+    if err is None:
+        return 200
+    if isinstance(err, HTTPError):
+        return err.status_code
+    return 500

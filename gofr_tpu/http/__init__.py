@@ -1,0 +1,20 @@
+"""HTTP transport: server, router, middleware, request/responder.
+
+Reference: pkg/gofr/http/ (+ middleware/, response/) and httpServer.go.
+"""
+
+from .request import Request
+from .responder import Responder, Raw, FileResponse, ResponseWriter
+from .router import Router, Route
+from .server import HTTPServer
+
+__all__ = [
+    "Request",
+    "Responder",
+    "Raw",
+    "FileResponse",
+    "ResponseWriter",
+    "Router",
+    "Route",
+    "HTTPServer",
+]

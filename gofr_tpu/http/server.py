@@ -1,0 +1,117 @@
+"""Threaded HTTP/1.1 server flushing Router-produced responses.
+
+Reference: pkg/gofr/httpServer.go:12-36 wraps net/http.Server on HTTP_PORT
+(default 8000, default.go:4) with a 5s read-header timeout. Python
+equivalent: a ThreadingHTTPServer with a per-request dispatch into the
+router. Supports chunked streaming responses (needed for token streaming;
+the reference has no HTTP streaming path).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .request import Request
+from .responder import ResponseWriter
+from .router import Router
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    router: Router = None  # type: ignore[assignment]
+    logger = None
+    timeout = 5  # read timeout, mirrors the reference's ReadHeaderTimeout
+
+    # silence default stderr access logs — the logging middleware owns this
+    def log_message(self, fmt: str, *args) -> None:
+        pass
+
+    def _handle(self) -> None:
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        req = Request(
+            method=self.command,
+            path=self.path,
+            headers=dict(self.headers.items()),
+            body=body,
+            remote_addr=self.client_address[0],
+        )
+        w = ResponseWriter()
+        stream_started = threading.Event()
+
+        if hasattr(self.server, "_gofr_streaming_hook"):
+            pass  # reserved
+
+        try:
+            # streaming: if a handler writes chunks, flush them live
+            original_write_chunk = w.write_chunk
+
+            def live_chunk(data: bytes) -> None:
+                if not stream_started.is_set():
+                    stream_started.set()
+                    self.send_response(w.status)
+                    for k, v in w.headers.items():
+                        self.send_header(k, v)
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                self.wfile.flush()
+
+            w.write_chunk = live_chunk  # type: ignore[method-assign]
+            self.router(req, w)
+            w.write_chunk = original_write_chunk  # type: ignore[method-assign]
+        except (BrokenPipeError, ConnectionResetError):
+            return
+        except Exception as e:  # router middleware should have caught this
+            if self.logger is not None:
+                self.logger.error({"event": "unhandled server error", "error": repr(e)})
+            w = ResponseWriter()
+            w.status = 500
+            w.set_header("Content-Type", "application/json")
+            w.write(b'{"error":{"message":"internal server error"}}')
+
+        try:
+            if stream_started.is_set():
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+                return
+            self.send_response(w.status)
+            for k, v in w.headers.items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(w.body)))
+            self.end_headers()
+            if self.command != "HEAD":
+                self.wfile.write(w.body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = do_OPTIONS = do_HEAD = _handle
+
+
+class HTTPServer:
+    def __init__(self, router: Router, port: int = 8000, logger=None, host: str = "0.0.0.0"):
+        self.router = router
+        self.port = port
+        self.host = host
+        self.logger = logger
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        handler_cls = type("BoundHandler", (_Handler,), {"router": self.router, "logger": self.logger})
+        self._server = ThreadingHTTPServer((self.host, self.port), handler_cls)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]  # resolve port 0
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True, name=f"http-server-{self.port}")
+        self._thread.start()
+        if self.logger is not None:
+            self.logger.info({"event": "http server started", "port": self.port})
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self.logger is not None:
+            self.logger.info({"event": "http server stopped", "port": self.port})

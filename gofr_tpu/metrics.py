@@ -1,0 +1,266 @@
+"""Metrics: name-keyed registry of counter/up-down-counter/histogram/gauge
+with Prometheus text exposition.
+
+Reference surface: pkg/gofr/metrics/register.go:13-23 (``Manager`` iface with
+NewCounter/NewUpDownCounter/NewHistogram/NewGauge + record methods), the typed
+store with already-/not-registered errors (metrics/store.go:14-113,
+metrics/errors.go:5-19), label validation and the >20 label-cardinality
+warning (register.go:233), and the promhttp endpoint with per-scrape runtime
+gauges (metrics/handler.go:11-34). The OTel+Prometheus exporter pair is
+replaced by a direct text-format renderer — one fewer moving part, same wire
+format.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterable, Sequence
+
+
+class MetricError(Exception):
+    pass
+
+
+class MetricAlreadyRegistered(MetricError):
+    def __init__(self, name: str):
+        super().__init__(f"metric {name!r} is already registered")
+
+
+class MetricNotRegistered(MetricError):
+    def __init__(self, name: str):
+        super().__init__(f"metric {name!r} is not registered")
+
+
+def _label_key(labels: dict[str, str]) -> tuple[tuple[str, str], ...]:
+    return tuple(sorted(labels.items()))
+
+
+@dataclass
+class _Metric:
+    name: str
+    desc: str
+    kind: str  # counter | updown | histogram | gauge
+    buckets: Sequence[float] = ()
+    # label-set key -> value. For histograms the value is
+    # (bucket_counts: list[int], total_sum: float, count: int).
+    series: dict[tuple, object] = field(default_factory=dict)
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+DEFAULT_HISTOGRAM_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10,
+)
+
+
+class Manager:
+    """Thread-safe metrics registry + recorder.
+
+    API matches the reference Manager (metrics/register.go:13-23) with
+    snake_case naming; labels are keyword arguments:
+
+        m.new_counter("app_reqs", "total requests")
+        m.increment_counter("app_reqs", path="/a", method="GET")
+    """
+
+    def __init__(self, logger=None):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+        self._logger = logger
+
+    # -- registration (metrics/register.go:53-144) --------------------------
+    def _register(self, name: str, desc: str, kind: str, buckets: Sequence[float] = ()) -> None:
+        if not name:
+            raise MetricError("metric name cannot be empty")
+        with self._lock:
+            if name in self._metrics:
+                raise MetricAlreadyRegistered(name)
+            self._metrics[name] = _Metric(name=name, desc=desc, kind=kind, buckets=tuple(buckets))
+
+    def new_counter(self, name: str, desc: str = "") -> None:
+        self._register(name, desc, "counter")
+
+    def new_updown_counter(self, name: str, desc: str = "") -> None:
+        self._register(name, desc, "updown")
+
+    def new_histogram(self, name: str, desc: str = "", buckets: Sequence[float] = DEFAULT_HISTOGRAM_BUCKETS) -> None:
+        self._register(name, desc, "histogram", sorted(buckets))
+
+    def new_gauge(self, name: str, desc: str = "") -> None:
+        self._register(name, desc, "gauge")
+
+    # -- recording (metrics/register.go:147-231) ----------------------------
+    def _get(self, name: str, kind: str) -> _Metric:
+        m = self._metrics.get(name)
+        if m is None or m.kind != kind:
+            raise MetricNotRegistered(name)
+        return m
+
+    def _check_cardinality(self, m: _Metric, labels: dict[str, str]) -> None:
+        # reference register.go:233 getAttributes warns past 20 label values
+        if len(labels) > 20 and self._logger is not None:
+            self._logger.warn(
+                {"event": "high metric label cardinality", "metric": m.name, "labels": len(labels)}
+            )
+
+    def increment_counter(self, name: str, **labels: str) -> None:
+        m = self._get(name, "counter")
+        self._check_cardinality(m, labels)
+        key = _label_key(labels)
+        with m.lock:
+            m.series[key] = float(m.series.get(key, 0.0)) + 1.0
+
+    def delta_updown_counter(self, name: str, delta: float, **labels: str) -> None:
+        m = self._get(name, "updown")
+        key = _label_key(labels)
+        with m.lock:
+            m.series[key] = float(m.series.get(key, 0.0)) + delta
+
+    def record_histogram(self, name: str, value: float, **labels: str) -> None:
+        m = self._get(name, "histogram")
+        key = _label_key(labels)
+        with m.lock:
+            entry = m.series.get(key)
+            if entry is None:
+                entry = [[0] * len(m.buckets), 0.0, 0]
+                m.series[key] = entry
+            counts, _, _ = entry
+            for i, b in enumerate(m.buckets):
+                if value <= b:
+                    counts[i] += 1
+            entry[1] += value
+            entry[2] += 1
+
+    def set_gauge(self, name: str, value: float, **labels: str) -> None:
+        m = self._get(name, "gauge")
+        key = _label_key(labels)
+        with m.lock:
+            m.series[key] = float(value)
+
+    # -- exposition ---------------------------------------------------------
+    def render_prometheus(self) -> str:
+        """Render all metrics in Prometheus text exposition format 0.0.4."""
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in sorted(metrics, key=lambda x: x.name):
+            ptype = {"counter": "counter", "updown": "gauge", "gauge": "gauge", "histogram": "histogram"}[m.kind]
+            if m.desc:
+                lines.append(f"# HELP {m.name} {m.desc}")
+            lines.append(f"# TYPE {m.name} {ptype}")
+            with m.lock:
+                series = dict(m.series)
+            for key, val in sorted(series.items()):
+                label_str = _fmt_labels(key)
+                if m.kind == "histogram":
+                    counts, total, count = val  # type: ignore[misc]
+                    cum = 0
+                    for b, c in zip(m.buckets, counts):
+                        cum = c
+                        lines.append(
+                            f'{m.name}_bucket{_fmt_labels(key, extra=("le", _fmt_float(b)))} {cum}'
+                        )
+                    lines.append(f'{m.name}_bucket{_fmt_labels(key, extra=("le", "+Inf"))} {count}')
+                    lines.append(f"{m.name}_sum{label_str} {total}")
+                    lines.append(f"{m.name}_count{label_str} {count}")
+                else:
+                    lines.append(f"{m.name}{label_str} {val}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt_float(v: float) -> str:
+    return repr(float(v)) if v != int(v) else str(int(v))
+
+
+def _fmt_labels(key: tuple, extra: tuple[str, str] | None = None) -> str:
+    items = list(key)
+    if extra is not None:
+        items.append(extra)
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{str(v).replace(chr(92), chr(92)*2).replace(chr(34), chr(92)+chr(34))}"' for k, v in items)
+    return "{" + inner + "}"
+
+
+# -- framework metrics ------------------------------------------------------
+
+# Bucket priors from the reference (container/container.go:147-157):
+HTTP_BUCKETS = (0.001, 0.003, 0.005, 0.01, 0.02, 0.03, 0.05, 0.1, 0.2, 0.3,
+                0.5, 0.75, 1, 2, 3, 5, 10, 30)
+SQL_BUCKETS_US = (50, 75, 100, 125, 150, 200, 300, 500, 750, 1000, 2000, 3000,
+                  4000, 5000, 7500, 10000)
+REDIS_BUCKETS_US = (50, 75, 100, 125, 150, 200, 300, 500, 750, 1000, 2000, 3000)
+# TPU device-op latency priors (new; microsecond-scale host ops up to
+# second-scale sharded executions):
+TPU_BUCKETS = (0.0005, 0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.15, 0.2,
+               0.3, 0.5, 0.75, 1, 2, 5, 10, 30)
+
+
+def register_framework_metrics(m: Manager) -> None:
+    """Built-in metrics (reference container/container.go:138-166 registers 16;
+    we add the ``app_tpu_*`` family for the TPU datasource)."""
+    # system gauges — refreshed per scrape by system_metrics()
+    m.new_gauge("app_go_routines", "number of live threads")
+    m.new_gauge("app_sys_memory_alloc", "resident set size in bytes")
+    m.new_gauge("app_sys_total_alloc", "peak resident set size in bytes")
+    m.new_gauge("app_go_numGC", "number of completed GC collections")
+    m.new_gauge("app_go_sys", "virtual memory size in bytes")
+
+    m.new_histogram("app_http_response", "response time of http requests in seconds", HTTP_BUCKETS)
+    m.new_histogram("app_http_service_response", "response time of http service requests in seconds", HTTP_BUCKETS)
+    m.new_histogram("app_sql_stats", "response time of sql queries in microseconds", SQL_BUCKETS_US)
+    m.new_gauge("app_sql_open_connections", "open sql connections")
+    m.new_gauge("app_sql_inUse_connections", "in-use sql connections")
+    m.new_histogram("app_redis_stats", "response time of redis commands in microseconds", REDIS_BUCKETS_US)
+
+    m.new_counter("app_pubsub_publish_total_count", "total publish attempts")
+    m.new_counter("app_pubsub_publish_success_count", "successful publishes")
+    m.new_counter("app_pubsub_subscribe_total_count", "total subscribe receives")
+    m.new_counter("app_pubsub_subscribe_success_count", "successful subscribe receives")
+
+    # TPU datasource family (no reference equivalent; BASELINE.json north star)
+    m.new_histogram("app_tpu_predict_duration", "end-to-end predict latency in seconds", TPU_BUCKETS)
+    m.new_histogram("app_tpu_device_execute_duration", "on-device execution time in seconds", TPU_BUCKETS)
+    m.new_histogram("app_tpu_batch_wait_duration", "time a request waits for a batch in seconds", TPU_BUCKETS)
+    m.new_gauge("app_tpu_batch_fill", "fraction of batch slots occupied at dispatch")
+    m.new_counter("app_tpu_requests_total", "total TPU predict requests")
+    m.new_counter("app_tpu_tokens_generated_total", "total generated tokens")
+    m.new_gauge("app_tpu_devices", "number of visible TPU devices")
+
+
+def update_system_metrics(m: Manager) -> None:
+    """Per-scrape runtime stats (reference metrics/handler.go:20-34 refreshes
+    goroutines/heap/GC per scrape; Python equivalents via /proc + gc)."""
+    try:
+        m.set_gauge("app_go_routines", float(threading.active_count()))
+        counts = gc.get_stats()
+        m.set_gauge("app_go_numGC", float(sum(s.get("collections", 0) for s in counts)))
+        rss, peak, vsize = _read_proc_mem()
+        m.set_gauge("app_sys_memory_alloc", rss)
+        m.set_gauge("app_sys_total_alloc", peak)
+        m.set_gauge("app_go_sys", vsize)
+    except MetricNotRegistered:
+        pass
+
+
+def _read_proc_mem() -> tuple[float, float, float]:
+    rss = peak = vsize = 0.0
+    try:
+        with open(f"/proc/{os.getpid()}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    rss = float(line.split()[1]) * 1024
+                elif line.startswith("VmHWM:"):
+                    peak = float(line.split()[1]) * 1024
+                elif line.startswith("VmSize:"):
+                    vsize = float(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return rss, peak, vsize
+
+
+Iterable  # re-export quiet
+time  # keep import

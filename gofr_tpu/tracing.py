@@ -1,0 +1,232 @@
+"""Self-contained distributed tracing with W3C traceparent propagation.
+
+Reference wiring: OTel tracer provider + composite propagator at startup
+(pkg/gofr/gofr.go:235-243), inbound span per request
+(http/middleware/tracer.go:14-30), handler span (handler.go:34), user spans via
+``c.Trace(name)`` (context.go:45-51), outbound header injection
+(service/new.go:140-158), optional Zipkin batch exporter (gofr.go:245-257).
+
+This implementation is dependency-free: spans are kept in a contextvar stack,
+trace context crosses process boundaries via the ``traceparent`` header
+(W3C Trace Context, same wire format the reference propagates), and finished
+spans go to a pluggable exporter (a Zipkin-JSON HTTP exporter is provided).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import secrets
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+_current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "gofr_tpu_current_span", default=None
+)
+
+
+def _new_trace_id() -> str:
+    return secrets.token_hex(16)
+
+
+def _new_span_id() -> str:
+    return secrets.token_hex(8)
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str | None = None
+    start_ns: int = field(default_factory=time.monotonic_ns)
+    start_epoch_us: int = field(default_factory=lambda: int(time.time() * 1e6))
+    end_ns: int | None = None
+    attributes: dict[str, Any] = field(default_factory=dict)
+    tracer: "Tracer | None" = None
+    _token: Any = None
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def end(self) -> None:
+        if self.end_ns is None:
+            self.end_ns = time.monotonic_ns()
+            if self.tracer is not None:
+                self.tracer._on_end(self)
+
+    @property
+    def duration_us(self) -> int:
+        end = self.end_ns if self.end_ns is not None else time.monotonic_ns()
+        return (end - self.start_ns) // 1000
+
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+
+def current_span() -> Span | None:
+    return _current.get()
+
+
+def parse_traceparent(header: str | None) -> tuple[str, str] | None:
+    """Parse a W3C traceparent header -> (trace_id, parent_span_id)."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    _, trace_id, span_id, _ = parts
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    return trace_id, span_id
+
+
+class Tracer:
+    """Creates spans and hands finished ones to the exporter."""
+
+    def __init__(self, service_name: str = "gofr-app", exporter: "SpanExporter | None" = None):
+        self.service_name = service_name
+        self.exporter = exporter
+
+    def start_span(
+        self,
+        name: str,
+        *,
+        parent: Span | None = None,
+        traceparent: str | None = None,
+        attributes: dict[str, Any] | None = None,
+    ) -> Span:
+        if parent is None:
+            parent = current_span()
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        else:
+            ctx = parse_traceparent(traceparent)
+            if ctx is not None:
+                trace_id, parent_id = ctx
+            else:
+                trace_id, parent_id = _new_trace_id(), None
+        span = Span(
+            name=name,
+            trace_id=trace_id,
+            span_id=_new_span_id(),
+            parent_id=parent_id,
+            attributes=dict(attributes or {}),
+            tracer=self,
+        )
+        span._token = _current.set(span)
+        return span
+
+    def _on_end(self, span: Span) -> None:
+        if span._token is not None:
+            with contextlib.suppress(ValueError):
+                _current.reset(span._token)
+            span._token = None
+        if self.exporter is not None:
+            self.exporter.export(span, self.service_name)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **kw: Any):
+        s = self.start_span(name, **kw)
+        try:
+            yield s
+        finally:
+            s.end()
+
+
+class SpanExporter:
+    def export(self, span: Span, service_name: str) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class InMemoryExporter(SpanExporter):
+    """Test exporter collecting finished spans."""
+
+    def __init__(self) -> None:
+        self.spans: list[Span] = []
+
+    def export(self, span: Span, service_name: str) -> None:
+        self.spans.append(span)
+
+
+class ZipkinExporter(SpanExporter):
+    """Batched Zipkin v2 JSON exporter (reference: gofr.go:245-257 wires a
+    zipkin batch exporter when TRACER_HOST is set)."""
+
+    def __init__(self, host: str, port: int = 9411, batch_size: int = 64, flush_interval: float = 2.0):
+        self.url = f"http://{host}:{port}/api/v2/spans"
+        self.batch_size = batch_size
+        self.flush_interval = flush_interval
+        self._buf: list[dict] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="zipkin-exporter")
+        self._thread.start()
+
+    def export(self, span: Span, service_name: str) -> None:
+        z = {
+            "traceId": span.trace_id,
+            "id": span.span_id,
+            "name": span.name,
+            "timestamp": span.start_epoch_us,
+            "duration": max(span.duration_us, 1),
+            "localEndpoint": {"serviceName": service_name},
+            "tags": {k: str(v) for k, v in span.attributes.items()},
+        }
+        if span.parent_id:
+            z["parentId"] = span.parent_id
+        flush_now = False
+        with self._lock:
+            self._buf.append(z)
+            if len(self._buf) >= self.batch_size:
+                flush_now = True
+        if flush_now:
+            self._flush()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.flush_interval):
+            self._flush()
+
+    def _flush(self) -> None:
+        with self._lock:
+            batch, self._buf = self._buf, []
+        if not batch:
+            return
+        try:
+            req = urllib.request.Request(
+                self.url,
+                data=json.dumps(batch).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req, timeout=2).close()
+        except Exception:
+            pass  # tracing must never take the app down
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._flush()
+
+
+def tracer_from_config(config, service_name: str) -> Tracer:
+    """Reference: gofr.go:231-258 initTracer — exporter only when TRACER_HOST set."""
+    host = config.get("TRACER_HOST")
+    exporter: SpanExporter | None = None
+    if host:
+        port = int(config.get_or_default("TRACER_PORT", "9411"))
+        exporter = ZipkinExporter(host, port)
+    return Tracer(service_name=service_name, exporter=exporter)
+
+
+NoopSpan = Span(name="noop", trace_id="0" * 32, span_id="0" * 16)
+Callable  # re-export quiet

@@ -1,0 +1,4 @@
+"""Framework version constant (reference: pkg/gofr/version/version.go:3)."""
+
+__version__ = "0.1.0-dev"
+FRAMEWORK = "gofr_tpu"

@@ -1,0 +1,149 @@
+"""End-to-end App tests: real server on an ephemeral port, driven over
+localhost (mirrors the reference's framework-level tests,
+pkg/gofr/gofr_test.go:43-80)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gofr_tpu import App, new_cmd
+from gofr_tpu.config import MapConfig
+from gofr_tpu.errors import EntityNotFound
+
+
+@pytest.fixture
+def app():
+    a = App(MapConfig({"HTTP_PORT": "0", "METRICS_PORT": "0", "APP_NAME": "test-app"}))
+    yield a
+    if a._running.is_set():
+        a.stop()
+
+
+def _get(port, path, **kw):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5, **kw) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_app_serves_routes_and_defaults(app):
+    @app.get("/greet")
+    def greet(ctx):
+        return {"hello": ctx.param("name", "world")}
+
+    @app.get("/missing")
+    def missing(ctx):
+        raise EntityNotFound("thing", "1")
+
+    @app.post("/echo")
+    def echo(ctx):
+        return ctx.bind()
+
+    app.run(block=False)
+    port = app.http_port
+
+    status, body = _get(port, "/greet?name=tpu")
+    assert status == 200
+    assert json.loads(body) == {"data": {"hello": "tpu"}}
+
+    status, body = _get(port, "/missing")
+    assert status == 404
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/echo", data=b'{"a":1}',
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        assert json.loads(r.read()) == {"data": {"a": 1}}
+
+    # default routes (reference gofr.go:125-141)
+    status, body = _get(port, "/.well-known/alive")
+    assert status == 200 and json.loads(body)["data"]["status"] == "UP"
+
+    status, body = _get(port, "/.well-known/health")
+    health = json.loads(body)["data"]
+    assert health["name"] == "test-app" and health["status"] == "UP"
+
+    status, _ = _get(port, "/favicon.ico")
+    assert status == 200
+
+    status, _ = _get(port, "/no-such-route")
+    assert status == 404
+
+
+def test_metrics_endpoint_scrapes(app):
+    @app.get("/ping")
+    def ping(ctx):
+        return "pong"
+
+    app.run(block=False)
+    _get(app.http_port, "/ping")
+    status, body = _get(app.metrics_port, "/metrics")
+    assert status == 200
+    text = body.decode()
+    assert "app_http_response_bucket" in text
+    assert 'path="/ping"' in text
+    assert "app_go_routines" in text
+
+
+def test_handler_exception_recovered(app):
+    @app.get("/boom")
+    def boom(ctx):
+        raise RuntimeError("unexpected")
+
+    app.run(block=False)
+    status, body = _get(app.http_port, "/boom")
+    assert status == 500
+    assert "error" in json.loads(body)
+
+
+def test_correlation_id_and_traceparent(app):
+    @app.get("/traced")
+    def traced(ctx):
+        with ctx.trace("inner-work"):
+            return "ok"
+
+    app.run(block=False)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{app.http_port}/traced",
+        headers={"traceparent": "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"})
+    with urllib.request.urlopen(req, timeout=5) as r:
+        assert r.headers["X-Correlation-ID"] == "ab" * 16
+
+
+def test_basic_auth_enabled_app(app):
+    @app.get("/secure")
+    def secure(ctx):
+        return "top-secret"
+
+    app.enable_basic_auth({"u": "p"})
+    app.run(block=False)
+    status, _ = _get(app.http_port, "/secure")
+    assert status == 401
+    # health stays open (reference middleware skips well-known routes)
+    status, _ = _get(app.http_port, "/.well-known/alive")
+    assert status == 200
+
+
+def test_cmd_app_subcommands(capsys):
+    app = new_cmd(MapConfig({}))
+
+    @app.sub_command("hello")
+    def hello(ctx):
+        return f"Hello {ctx.param('name', 'World')}!"
+
+    assert app.run_command(["hello", "-name=gofr"]) == 0
+    assert "Hello gofr!" in capsys.readouterr().out
+
+    assert app.run_command(["unknown"]) == 1
+    assert "No Command Found!" in capsys.readouterr().err
+
+
+def test_cmd_flag_parsing():
+    from gofr_tpu.cli import parse_args
+
+    args, flags = parse_args(["do", "thing", "-a=1", "--b", "2", "-c"])
+    assert args == ["do", "thing"]
+    assert flags == {"a": "1", "b": "2", "c": "true"}

@@ -1,0 +1,56 @@
+import os
+
+from gofr_tpu.config import EnvConfig, MapConfig, parse_env_file
+
+
+def test_map_config_roundtrip():
+    c = MapConfig({"A": "1", "FLAG": "true", "F": "2.5"})
+    assert c.get("A") == "1"
+    assert c.get("MISSING") is None
+    assert c.get_or_default("MISSING", "x") == "x"
+    assert c.get_int("A", 0) == 1
+    assert c.get_int("MISSING", 7) == 7
+    assert c.get_float("F", 0.0) == 2.5
+    assert c.get_bool("FLAG") is True
+    assert c.get_bool("MISSING", True) is True
+
+
+def test_env_file_parsing(tmp_path):
+    f = tmp_path / ".env"
+    f.write_text(
+        "# comment\n"
+        "APP_NAME=demo\n"
+        'QUOTED="hello world"\n'
+        "export EXPORTED=yes\n"
+        "INLINE=value # trailing comment\n"
+        "EMPTY=\n"
+        "malformed line\n"
+    )
+    vals = parse_env_file(str(f))
+    assert vals["APP_NAME"] == "demo"
+    assert vals["QUOTED"] == "hello world"
+    assert vals["EXPORTED"] == "yes"
+    assert vals["INLINE"] == "value"
+    assert vals["EMPTY"] == ""
+    assert "malformed" not in vals
+
+
+def test_env_config_process_env_wins(tmp_path, monkeypatch):
+    cfgdir = tmp_path / "configs"
+    cfgdir.mkdir()
+    (cfgdir / ".env").write_text("HTTP_PORT=8001\nONLY_FILE=yes\n")
+    monkeypatch.setenv("HTTP_PORT", "9005")
+    c = EnvConfig(str(cfgdir))
+    assert c.get("HTTP_PORT") == "9005"
+    assert c.get("ONLY_FILE") == "yes"
+
+
+def test_env_config_app_env_override(tmp_path, monkeypatch):
+    cfgdir = tmp_path / "configs"
+    cfgdir.mkdir()
+    (cfgdir / ".env").write_text("K=base\n")
+    (cfgdir / ".staging.env").write_text("K=staging\n")
+    monkeypatch.setenv("APP_ENV", "staging")
+    assert EnvConfig(str(cfgdir)).get("K") == "staging"
+    monkeypatch.delenv("APP_ENV")
+    assert EnvConfig(str(cfgdir)).get("K") == "base"

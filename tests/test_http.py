@@ -1,0 +1,143 @@
+import base64
+import json
+
+from gofr_tpu.http.request import Request
+from gofr_tpu.http.responder import Responder, ResponseWriter, Raw, FileResponse
+from gofr_tpu.http.router import Router, compile_template
+from gofr_tpu.http.middleware import (
+    apikey_auth_middleware,
+    basic_auth_middleware,
+    cors_middleware,
+    logging_middleware,
+)
+from gofr_tpu.errors import EntityNotFound, BadRequest
+from gofr_tpu.testutil import new_mock_logger
+import pytest
+
+
+def test_request_params_and_headers():
+    req = Request("get", "/items?x=1&x=2&y=hello", headers={"Content-Type": "application/json", "Host": "h:80"})
+    assert req.method == "GET"
+    assert req.path == "/items"
+    assert req.param("x") == "1"
+    assert req.params("x") == ["1", "2"]
+    assert req.param("missing", "d") == "d"
+    assert req.header("content-type") == "application/json"
+    assert req.host_name() == "http://h:80"
+
+
+def test_request_bind_json_and_dataclass():
+    import dataclasses
+
+    @dataclasses.dataclass
+    class Body:
+        name: str = ""
+        count: int = 0
+
+    req = Request("POST", "/x", body=b'{"name":"a","count":3,"extra":1}')
+    assert req.bind() == {"name": "a", "count": 3, "extra": 1}
+    b = req.bind(Body)
+    assert b.name == "a" and b.count == 3
+
+    with pytest.raises(BadRequest):
+        Request("POST", "/x", body=b"not-json").bind()
+    with pytest.raises(BadRequest):
+        Request("POST", "/x").bind()
+
+
+def test_path_template_compilation():
+    pat = compile_template("/user/{id}/posts/{post_id}")
+    m = pat.match("/user/42/posts/abc")
+    assert m.groupdict() == {"id": "42", "post_id": "abc"}
+    assert pat.match("/user/42") is None
+
+
+def test_router_dispatch_and_status():
+    r = Router()
+    r.add("GET", "/hello/{name}", lambda req, w: w.write(req.path_param("name").encode()))
+
+    w = ResponseWriter()
+    r(Request("GET", "/hello/world"), w)
+    assert w.body == b"world" and w.status == 200
+
+    w = ResponseWriter()
+    r(Request("POST", "/hello/world"), w)
+    assert w.status == 405
+
+    w = ResponseWriter()
+    r(Request("GET", "/nope"), w)
+    assert w.status == 404
+
+
+def test_responder_envelopes():
+    w = ResponseWriter()
+    Responder(w).respond({"a": 1}, None)
+    assert json.loads(w.body) == {"data": {"a": 1}}
+
+    w = ResponseWriter()
+    Responder(w).respond(None, EntityNotFound("user", "9"))
+    assert w.status == 404
+    assert "user" in json.loads(w.body)["error"]["message"]
+
+    w = ResponseWriter()
+    Responder(w).respond(Raw([1, 2]), None)
+    assert json.loads(w.body) == [1, 2]
+
+    w = ResponseWriter()
+    Responder(w).respond(FileResponse(b"png-bytes", name="x.png"), None)
+    assert w.headers["Content-Type"] == "image/png"
+    assert w.body == b"png-bytes"
+
+
+def test_logging_middleware_recovers_and_logs():
+    log = new_mock_logger()
+
+    def boom(req, w):
+        raise RuntimeError("kaboom")
+
+    h = logging_middleware(log)(boom)
+    w = ResponseWriter()
+    h(Request("GET", "/x"), w)
+    assert w.status == 500
+    assert "panic recovered" in log.stderr
+    assert '"uri": "/x"' in log.stdout or "/x" in log.stdout
+
+
+def test_cors_short_circuits_options():
+    called = []
+    h = cors_middleware()(lambda req, w: called.append(1))
+    w = ResponseWriter()
+    h(Request("OPTIONS", "/x"), w)
+    assert not called
+    assert w.headers["Access-Control-Allow-Origin"] == "*"
+    h(Request("GET", "/x"), w)
+    assert called
+
+
+def test_basic_auth():
+    ok = []
+    h = basic_auth_middleware({"admin": "secret"})(lambda req, w: ok.append(1))
+    w = ResponseWriter()
+    h(Request("GET", "/x"), w)
+    assert w.status == 401 and not ok
+
+    creds = base64.b64encode(b"admin:secret").decode()
+    w = ResponseWriter()
+    h(Request("GET", "/x", headers={"Authorization": f"Basic {creds}"}), w)
+    assert ok
+
+    bad = base64.b64encode(b"admin:wrong").decode()
+    w = ResponseWriter()
+    h(Request("GET", "/x", headers={"Authorization": f"Basic {bad}"}), w)
+    assert w.status == 401
+
+
+def test_apikey_auth():
+    ok = []
+    h = apikey_auth_middleware(["k1"])(lambda req, w: ok.append(1))
+    w = ResponseWriter()
+    h(Request("GET", "/x", headers={"X-API-KEY": "k1"}), w)
+    assert ok
+    w = ResponseWriter()
+    h(Request("GET", "/x", headers={"X-API-KEY": "nope"}), w)
+    assert w.status == 401

@@ -1,0 +1,68 @@
+import json
+
+import pytest
+
+from gofr_tpu.glog import LogLevel
+from gofr_tpu.testutil import new_mock_logger
+
+
+def test_level_ordering_and_parse():
+    assert LogLevel.DEBUG < LogLevel.INFO < LogLevel.NOTICE < LogLevel.WARN < LogLevel.ERROR < LogLevel.FATAL
+    assert LogLevel.parse("debug") == LogLevel.DEBUG
+    assert LogLevel.parse("WARN") == LogLevel.WARN
+    assert LogLevel.parse("nonsense") == LogLevel.INFO
+    assert LogLevel.parse(None) == LogLevel.INFO
+
+
+def test_json_log_lines_and_level_filter():
+    log = new_mock_logger(LogLevel.INFO)
+    log.debug("hidden")
+    log.info({"event": "hello", "n": 1})
+    log.warn("watch out")
+    lines = [json.loads(l) for l in log.stdout.strip().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["level"] == "INFO"
+    assert lines[0]["message"] == {"event": "hello", "n": 1}
+    assert lines[1]["level"] == "WARN"
+
+
+def test_error_goes_to_stderr():
+    log = new_mock_logger()
+    log.error("boom")
+    log.info("fine")
+    assert "boom" in log.stderr
+    assert "boom" not in log.stdout
+    assert "fine" in log.stdout
+
+
+def test_formatted_variants():
+    log = new_mock_logger()
+    log.infof("x=%d y=%s", 3, "z")
+    assert "x=3 y=z" in log.stdout
+
+
+def test_fatal_exits():
+    log = new_mock_logger()
+    with pytest.raises(SystemExit):
+        log.fatal("dead")
+    assert "dead" in log.stderr
+
+
+def test_change_level():
+    log = new_mock_logger(LogLevel.INFO)
+    log.debug("no")
+    log.change_level(LogLevel.DEBUG)
+    log.debug("yes")
+    assert "yes" in log.stdout
+    assert '"no"' not in log.stdout
+
+
+def test_remote_level_poller_applies_level():
+    from gofr_tpu.remote_level import RemoteLevelPoller
+
+    log = new_mock_logger(LogLevel.INFO)
+    payload = json.dumps({"data": {"logLevel": "DEBUG"}}).encode()
+    p = RemoteLevelPoller(log, "http://unused", interval=3600, http_get=lambda url: payload)
+    p.poll_once()
+    p.stop()
+    assert log.level == LogLevel.DEBUG

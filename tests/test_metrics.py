@@ -1,0 +1,70 @@
+import pytest
+
+from gofr_tpu.metrics import (
+    Manager,
+    MetricAlreadyRegistered,
+    MetricNotRegistered,
+    register_framework_metrics,
+    update_system_metrics,
+)
+
+
+def test_counter_lifecycle():
+    m = Manager()
+    m.new_counter("reqs", "total")
+    m.increment_counter("reqs", path="/a")
+    m.increment_counter("reqs", path="/a")
+    m.increment_counter("reqs", path="/b")
+    text = m.render_prometheus()
+    assert '# TYPE reqs counter' in text
+    assert 'reqs{path="/a"} 2.0' in text
+    assert 'reqs{path="/b"} 1.0' in text
+
+
+def test_duplicate_and_missing_registration():
+    m = Manager()
+    m.new_gauge("g")
+    with pytest.raises(MetricAlreadyRegistered):
+        m.new_gauge("g")
+    with pytest.raises(MetricNotRegistered):
+        m.increment_counter("nope")
+    with pytest.raises(MetricNotRegistered):
+        m.increment_counter("g")  # wrong kind
+
+
+def test_updown_and_gauge():
+    m = Manager()
+    m.new_updown_counter("inflight")
+    m.delta_updown_counter("inflight", 3)
+    m.delta_updown_counter("inflight", -1)
+    m.new_gauge("temp")
+    m.set_gauge("temp", 42.5, zone="a")
+    text = m.render_prometheus()
+    assert "inflight 2.0" in text
+    assert 'temp{zone="a"} 42.5' in text
+
+
+def test_histogram_buckets_cumulative():
+    m = Manager()
+    m.new_histogram("lat", "latency", buckets=[0.1, 1.0, 10.0])
+    for v in (0.05, 0.5, 5.0, 50.0):
+        m.record_histogram("lat", v)
+    text = m.render_prometheus()
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="1"} 2' in text
+    assert 'lat_bucket{le="10"} 3' in text
+    assert 'lat_bucket{le="+Inf"} 4' in text
+    assert "lat_count 4" in text
+    assert "lat_sum 55.55" in text
+
+
+def test_framework_metrics_register_and_system_update():
+    m = Manager()
+    register_framework_metrics(m)
+    update_system_metrics(m)
+    text = m.render_prometheus()
+    assert "app_go_routines" in text
+    assert "app_http_response" in text
+    assert "app_tpu_predict_duration" in text
+    # system gauges got real values
+    assert "app_sys_memory_alloc 0.0" not in text

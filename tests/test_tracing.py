@@ -1,0 +1,31 @@
+from gofr_tpu.tracing import InMemoryExporter, Tracer, current_span, parse_traceparent
+
+
+def test_traceparent_parse():
+    assert parse_traceparent("00-" + "a" * 32 + "-" + "b" * 16 + "-01") == ("a" * 32, "b" * 16)
+    assert parse_traceparent("garbage") is None
+    assert parse_traceparent(None) is None
+    assert parse_traceparent("00-short-bad-01") is None
+
+
+def test_span_nesting_and_export():
+    exp = InMemoryExporter()
+    t = Tracer("svc", exporter=exp)
+    with t.span("outer") as outer:
+        assert current_span() is outer
+        with t.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+            assert current_span() is inner
+        assert current_span() is outer
+    assert current_span() is None
+    assert [s.name for s in exp.spans] == ["inner", "outer"]
+    assert exp.spans[0].duration_us >= 0
+
+
+def test_remote_parent_via_traceparent():
+    t = Tracer("svc")
+    s = t.start_span("inbound", traceparent="00-" + "1" * 32 + "-" + "2" * 16 + "-01")
+    assert s.trace_id == "1" * 32
+    assert s.parent_id == "2" * 16
+    s.end()
