@@ -1,0 +1,15 @@
+"""Model zoo: Llama (flagship decode path), BERT (embeddings), ViT (vision).
+
+All models are pure-functional JAX: ``init(cfg, key) -> params`` pytrees of
+plain arrays (or QuantizedLinear leaves), ``apply``-style forwards, static
+shapes, layers stacked on a leading axis and iterated with ``lax.scan`` so
+compile time stays flat in depth and pipeline parallelism can split the
+layer axis. No torch, no module classes — params are data, which is what
+``jax.sharding`` wants to see.
+"""
+
+from .common import ModelConfig, LLAMA_CONFIGS, BERT_CONFIGS, VIT_CONFIGS
+from . import llama, bert, vit
+
+__all__ = ["ModelConfig", "LLAMA_CONFIGS", "BERT_CONFIGS", "VIT_CONFIGS",
+           "llama", "bert", "vit"]

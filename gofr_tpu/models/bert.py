@@ -1,0 +1,103 @@
+"""BERT-style bidirectional encoder producing sentence embeddings.
+
+Serving target: BERT-base embedding endpoint (BASELINE.md config #2).
+Same TPU-first layout as llama.py: stacked layers + lax.scan, functional
+params, static shapes with an attention mask for padding.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import full_attention
+from ..ops.norms import layer_norm
+from ..ops.quant import qmatmul
+from .common import ModelConfig, dense_init
+
+
+def init(cfg: ModelConfig, key) -> dict:
+    dt = cfg.jdtype
+    keys = jax.random.split(key, 16)
+    L, D, H, hd, F, V = (cfg.n_layers, cfg.dim, cfg.n_heads,
+                         cfg.dim // cfg.n_heads, cfg.ffn_dim, cfg.vocab_size)
+    return {
+        "embedding": dense_init(keys[0], (V, D), dt, scale=0.02),
+        "pos_embedding": dense_init(keys[1], (cfg.max_seq, D), dt, scale=0.02),
+        "type_embedding": dense_init(keys[2], (cfg.type_vocab_size, D), dt, scale=0.02),
+        "embed_norm_w": jnp.ones((D,), dt),
+        "embed_norm_b": jnp.zeros((D,), dt),
+        "layers": {
+            "wq": dense_init(keys[3], (L, D, H * hd), dt),
+            "bq": jnp.zeros((L, H * hd), dt),
+            "wk": dense_init(keys[4], (L, D, H * hd), dt),
+            "bk": jnp.zeros((L, H * hd), dt),
+            "wv": dense_init(keys[5], (L, D, H * hd), dt),
+            "bv": jnp.zeros((L, H * hd), dt),
+            "wo": dense_init(keys[6], (L, H * hd, D), dt),
+            "bo": jnp.zeros((L, D), dt),
+            "attn_norm_w": jnp.ones((L, D), dt),
+            "attn_norm_b": jnp.zeros((L, D), dt),
+            "w_in": dense_init(keys[7], (L, D, F), dt),
+            "b_in": jnp.zeros((L, F), dt),
+            "w_out": dense_init(keys[8], (L, F, D), dt),
+            "b_out": jnp.zeros((L, D), dt),
+            "ffn_norm_w": jnp.ones((L, D), dt),
+            "ffn_norm_b": jnp.zeros((L, D), dt),
+        },
+        "pooler_w": dense_init(keys[9], (D, D), dt),
+        "pooler_b": jnp.zeros((D,), dt),
+    }
+
+
+def encode(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
+           mask: jnp.ndarray | None = None,
+           token_types: jnp.ndarray | None = None) -> jnp.ndarray:
+    """tokens [B, S] -> hidden states [B, S, D]."""
+    B, S = tokens.shape
+    if mask is None:
+        mask = jnp.ones((B, S), bool)
+    if token_types is None:
+        token_types = jnp.zeros((B, S), jnp.int32)
+    H, hd = cfg.n_heads, cfg.dim // cfg.n_heads
+
+    x = (params["embedding"][tokens]
+         + params["pos_embedding"][None, :S]
+         + params["type_embedding"][token_types]).astype(cfg.jdtype)
+    x = layer_norm(x, params["embed_norm_w"], params["embed_norm_b"], cfg.norm_eps)
+
+    def body(x, w):
+        q = (qmatmul(x, w["wq"]) + w["bq"]).reshape(B, S, H, hd)
+        k = (qmatmul(x, w["wk"]) + w["bk"]).reshape(B, S, H, hd)
+        v = (qmatmul(x, w["wv"]) + w["bv"]).reshape(B, S, H, hd)
+        attn = full_attention(q, k, v, mask=mask).reshape(B, S, H * hd)
+        x = layer_norm(x + qmatmul(attn, w["wo"]) + w["bo"],
+                       w["attn_norm_w"], w["attn_norm_b"], cfg.norm_eps)
+        h = jax.nn.gelu(qmatmul(x, w["w_in"]) + w["b_in"])
+        x = layer_norm(x + qmatmul(h, w["w_out"]) + w["b_out"],
+                       w["ffn_norm_w"], w["ffn_norm_b"], cfg.norm_eps)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return x
+
+
+def embed(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
+          mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Mean-pooled L2-normalized sentence embeddings [B, D] (the serving
+    endpoint's output)."""
+    B, S = tokens.shape
+    if mask is None:
+        mask = jnp.ones((B, S), bool)
+    x = encode(params, cfg, tokens, mask)
+    m = mask[..., None].astype(x.dtype)
+    pooled = (x * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+    pooled = pooled.astype(jnp.float32)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
+
+
+def pool_cls(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
+             mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Classic BERT pooler: tanh(W @ h_[CLS])."""
+    x = encode(params, cfg, tokens, mask)
+    return jnp.tanh(qmatmul(x[:, 0], params["pooler_w"]) + params["pooler_b"])

@@ -1,0 +1,97 @@
+"""Shared model configuration and initializer helpers."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str = "custom"
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    max_seq: int = 8192
+    rope_theta: float = 500000.0
+    rope_scaling: dict | None = None
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: str = "bfloat16"
+    # encoder-model extras
+    n_classes: int = 0
+    image_size: int = 224
+    patch_size: int = 14
+    type_vocab_size: int = 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+    def with_(self, **kw) -> "ModelConfig":
+        return replace(self, **kw)
+
+
+LLAMA_CONFIGS = {
+    # Llama-3-8B / 70B (architecture dims are public knowledge)
+    "llama3-8b": ModelConfig(name="llama3-8b", vocab_size=128256, dim=4096,
+                             n_layers=32, n_heads=32, n_kv_heads=8,
+                             ffn_dim=14336, max_seq=8192),
+    "llama3-70b": ModelConfig(name="llama3-70b", vocab_size=128256, dim=8192,
+                              n_layers=80, n_heads=64, n_kv_heads=8,
+                              ffn_dim=28672, max_seq=8192),
+    # small variants for single-chip serving and tests
+    "llama-1b": ModelConfig(name="llama-1b", vocab_size=128256, dim=2048,
+                            n_layers=16, n_heads=32, n_kv_heads=8,
+                            ffn_dim=8192, max_seq=8192, tie_embeddings=True),
+    "tiny": ModelConfig(name="tiny", vocab_size=256, dim=64, n_layers=2,
+                        n_heads=4, n_kv_heads=2, ffn_dim=128, max_seq=128,
+                        rope_theta=10000.0, dtype="float32"),
+}
+
+BERT_CONFIGS = {
+    "bert-base": ModelConfig(name="bert-base", vocab_size=30522, dim=768,
+                             n_layers=12, n_heads=12, n_kv_heads=12,
+                             ffn_dim=3072, max_seq=512, norm_eps=1e-12),
+    "tiny": ModelConfig(name="tiny-bert", vocab_size=128, dim=64, n_layers=2,
+                        n_heads=4, n_kv_heads=4, ffn_dim=128, max_seq=64,
+                        norm_eps=1e-12, dtype="float32"),
+}
+
+VIT_CONFIGS = {
+    "vit-l-14": ModelConfig(name="vit-l-14", dim=1024, n_layers=24,
+                            n_heads=16, n_kv_heads=16, ffn_dim=4096,
+                            image_size=224, patch_size=14, n_classes=1000),
+    "tiny": ModelConfig(name="tiny-vit", dim=64, n_layers=2, n_heads=4,
+                        n_kv_heads=4, ffn_dim=128, image_size=28,
+                        patch_size=14, n_classes=10, dtype="float32"),
+}
+
+
+def dense_init(key, shape, dtype, scale: float | None = None) -> jnp.ndarray:
+    """Truncated-normal fan-in init."""
+    fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
+    std = scale if scale is not None else fan_in ** -0.5
+    return (jax.random.truncated_normal(key, -2.0, 2.0, shape, jnp.float32) * std).astype(dtype)
+
+
+def sample_logits(logits: jnp.ndarray, key, temperature: float = 0.0,
+                  top_k: int = 0) -> jnp.ndarray:
+    """Sample token ids from [B, V] logits. temperature<=0 -> greedy.
+    Shape-static (top_k is a python int) so it jits once."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

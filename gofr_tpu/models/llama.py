@@ -1,0 +1,202 @@
+"""Llama-family decoder: GQA + RoPE + SwiGLU, KV-cache prefill/decode.
+
+TPU-first design decisions:
+  - Layer weights are STACKED on a leading [L, ...] axis and iterated with
+    ``lax.scan`` — one compiled layer body regardless of depth (compile time
+    flat in n_layers; the scan axis is also the natural pipeline-parallel
+    split).
+  - The KV cache is preallocated [L, B, Smax, KV, hd] with a per-slot
+    ``lengths`` cursor, so continuous batching can retire/admit sequences
+    per batch slot without reshaping anything.
+  - Weights may be int8 ``QuantizedLinear`` leaves (ops.quant): decode is
+    HBM-bound, so int8 halves the weight traffic per step.
+  - All matmuls keep [*, dim] x [dim, out] shapes large and MXU-aligned;
+    softmax in f32; everything else bf16.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import causal_attention, decode_attention
+from ..ops.norms import rms_norm
+from ..ops.quant import qmatmul
+from ..ops.rope import apply_rope, rope_frequencies
+from .common import ModelConfig, dense_init
+
+
+_ROPE_CACHE: dict[tuple, tuple] = {}
+
+
+def get_rope_tables(cfg: ModelConfig, max_seq: int):
+    """Memoized (cos, sin) tables — computed once per (model, capacity).
+    Callers in a serving loop should thread these through prefill/decode_step
+    so un-jitted paths don't rebuild them per token."""
+    scaling_key = tuple(sorted(cfg.rope_scaling.items())) if cfg.rope_scaling else None
+    key = (cfg.head_dim, max_seq, cfg.rope_theta, scaling_key)
+    if key not in _ROPE_CACHE:
+        _ROPE_CACHE[key] = rope_frequencies(cfg.head_dim, max_seq,
+                                            cfg.rope_theta, cfg.rope_scaling)
+    return _ROPE_CACHE[key]
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray        # [L, B, Smax, KV, hd]
+    v: jnp.ndarray        # [L, B, Smax, KV, hd]
+    lengths: jnp.ndarray  # [B] int32 — valid entries per slot
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_seq: int | None = None,
+               dtype=None) -> KVCache:
+    max_seq = max_seq or cfg.max_seq
+    dtype = dtype or cfg.jdtype
+    shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        lengths=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def init(cfg: ModelConfig, key) -> dict:
+    """Random-init params; same pytree layout a checkpoint loader fills."""
+    dt = cfg.jdtype
+    keys = jax.random.split(key, 12)
+    L, D, H, KV, hd, F, V = (cfg.n_layers, cfg.dim, cfg.n_heads,
+                             cfg.n_kv_heads, cfg.head_dim, cfg.ffn_dim,
+                             cfg.vocab_size)
+    params = {
+        "embedding": dense_init(keys[0], (V, D), dt, scale=0.02),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), dt),
+            "wq": dense_init(keys[1], (L, D, H * hd), dt),
+            "wk": dense_init(keys[2], (L, D, KV * hd), dt),
+            "wv": dense_init(keys[3], (L, D, KV * hd), dt),
+            "wo": dense_init(keys[4], (L, H * hd, D), dt),
+            "ffn_norm": jnp.ones((L, D), dt),
+            "w_gate": dense_init(keys[5], (L, D, F), dt),
+            "w_up": dense_init(keys[6], (L, D, F), dt),
+            "w_down": dense_init(keys[7], (L, F, D), dt),
+        },
+        "final_norm": jnp.ones((D,), dt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense_init(keys[8], (D, V), dt)
+    return params
+
+
+def _layer(x, layer_w, cfg: ModelConfig, cos, sin, positions,
+           kv_write, attend):
+    """One transformer block. ``kv_write(k_new, v_new) -> (k_all, v_all)``
+    handles cache interaction; ``attend(q, k, v)`` runs attention.
+    Returns (x_out, (k_stored, v_stored))."""
+    B, S = x.shape[0], x.shape[1]
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = rms_norm(x, layer_w["attn_norm"], cfg.norm_eps)
+    q = qmatmul(h, layer_w["wq"]).reshape(B, S, H, hd)
+    k = qmatmul(h, layer_w["wk"]).reshape(B, S, KV, hd)
+    v = qmatmul(h, layer_w["wv"]).reshape(B, S, KV, hd)
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+
+    k_all, v_all = kv_write(k, v)
+    attn = attend(q, k_all, v_all).reshape(B, S, H * hd)
+    x = x + qmatmul(attn, layer_w["wo"])
+
+    h = rms_norm(x, layer_w["ffn_norm"], cfg.norm_eps)
+    gated = jax.nn.silu(qmatmul(h, layer_w["w_gate"])) * qmatmul(h, layer_w["w_up"])
+    x = x + qmatmul(gated, layer_w["w_down"])
+    return x, (k_all, v_all)
+
+
+def _logits(params, cfg: ModelConfig, x):
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if cfg.tie_embeddings:
+        return jnp.dot(x, params["embedding"].T,
+                       preferred_element_type=jnp.float32)
+    return qmatmul(x, params["lm_head"]).astype(jnp.float32)
+
+
+def prefill(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
+            cache: KVCache, lengths: jnp.ndarray | None = None,
+            rope_tables=None) -> tuple[jnp.ndarray, KVCache]:
+    """Process prompts [B, S] (right-padded), fill the cache.
+
+    ``lengths`` [B]: true prompt lengths (defaults to full S).
+    Returns (logits [B, S, V] in f32, cache with lengths set).
+    """
+    B, S = tokens.shape
+    if lengths is None:
+        lengths = jnp.full((B,), S, jnp.int32)
+    cos, sin = rope_tables or get_rope_tables(cfg, cache.k.shape[2])
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    valid = positions < lengths[:, None]
+
+    x = params["embedding"][tokens].astype(cfg.jdtype)
+
+    def body(x, layer_w):
+        def kv_write(k, v):
+            return k, v  # prefill attends over the fresh S-long k/v
+
+        def attend(q, k, v):
+            return causal_attention(q, k, v, mask=valid)
+
+        return _layer(x, layer_w, cfg, cos, sin, positions, kv_write, attend)
+
+    x, (k_stack, v_stack) = jax.lax.scan(body, x, params["layers"])
+    # k_stack: [L, B, S, KV, hd] -> write into the cache's first S slots
+    if S > cache.k.shape[2]:
+        raise ValueError(f"prompt length {S} exceeds cache capacity {cache.k.shape[2]}")
+    k_full = jax.lax.dynamic_update_slice(
+        cache.k, k_stack.astype(cache.k.dtype), (0, 0, 0, 0, 0))
+    v_full = jax.lax.dynamic_update_slice(
+        cache.v, v_stack.astype(cache.v.dtype), (0, 0, 0, 0, 0))
+    return _logits(params, cfg, x), KVCache(k_full, v_full, lengths)
+
+
+def _cache_write_at(cache_layer: jnp.ndarray, new: jnp.ndarray,
+                    lengths: jnp.ndarray) -> jnp.ndarray:
+    """Write new [B, 1, KV, hd] at per-slot positions ``lengths`` into
+    [B, Smax, KV, hd]."""
+    def write_one(buf, tok, pos):
+        return jax.lax.dynamic_update_slice(buf, tok.astype(buf.dtype), (pos, 0, 0))
+    return jax.vmap(write_one)(cache_layer, new, lengths)
+
+
+def decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
+                cache: KVCache, rope_tables=None) -> tuple[jnp.ndarray, KVCache]:
+    """One decode step for tokens [B] against the cache.
+
+    Returns (logits [B, V] f32, updated cache with lengths+1).
+
+    CAPACITY CONTRACT: callers must ensure ``lengths < cache capacity``
+    before stepping — at capacity the write position clamps and silently
+    overwrites the last KV entry (no data-dependent errors are possible
+    under jit). The serving engine retires slots before they hit capacity.
+    """
+    B = tokens.shape[0]
+    cos, sin = rope_tables or get_rope_tables(cfg, cache.k.shape[2])
+    positions = cache.lengths[:, None]  # [B,1] — write position == current length
+    new_lengths = cache.lengths + 1
+
+    x = params["embedding"][tokens[:, None]].astype(cfg.jdtype)  # [B,1,D]
+
+    def body(x, xs):
+        layer_w, k_layer, v_layer = xs
+
+        def kv_write(k, v):
+            return (_cache_write_at(k_layer, k, cache.lengths),
+                    _cache_write_at(v_layer, v, cache.lengths))
+
+        def attend(q, k_all, v_all):
+            return decode_attention(q, k_all, v_all, new_lengths)
+
+        x, kv = _layer(x, layer_w, cfg, cos, sin, positions, kv_write, attend)
+        return x, kv
+
+    x, (k_new, v_new) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    return _logits(params, cfg, x[:, 0]), KVCache(k_new, v_new, new_lengths)

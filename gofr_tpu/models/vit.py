@@ -1,0 +1,86 @@
+"""Vision Transformer (ViT-L/14 class) for batched image classification.
+
+Serving target: Kafka -> batched ViT classification (BASELINE.md config #4).
+Patchify is a reshape+matmul (not a conv) — identical math for
+non-overlapping patches and a better fit for the MXU than XLA's conv path
+at patch granularity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import full_attention
+from ..ops.norms import layer_norm
+from ..ops.quant import qmatmul
+from .common import ModelConfig, dense_init
+
+
+def n_patches(cfg: ModelConfig) -> int:
+    return (cfg.image_size // cfg.patch_size) ** 2
+
+
+def init(cfg: ModelConfig, key) -> dict:
+    dt = cfg.jdtype
+    keys = jax.random.split(key, 12)
+    L, D, H, hd, F = (cfg.n_layers, cfg.dim, cfg.n_heads,
+                      cfg.dim // cfg.n_heads, cfg.ffn_dim)
+    patch_dim = 3 * cfg.patch_size * cfg.patch_size
+    return {
+        "patch_proj": dense_init(keys[0], (patch_dim, D), dt),
+        "cls_token": jnp.zeros((1, 1, D), dt),
+        "pos_embedding": dense_init(keys[1], (n_patches(cfg) + 1, D), dt, scale=0.02),
+        "layers": {
+            "norm1_w": jnp.ones((L, D), dt),
+            "norm1_b": jnp.zeros((L, D), dt),
+            "wq": dense_init(keys[2], (L, D, H * hd), dt),
+            "wk": dense_init(keys[3], (L, D, H * hd), dt),
+            "wv": dense_init(keys[4], (L, D, H * hd), dt),
+            "wo": dense_init(keys[5], (L, H * hd, D), dt),
+            "norm2_w": jnp.ones((L, D), dt),
+            "norm2_b": jnp.zeros((L, D), dt),
+            "w_in": dense_init(keys[6], (L, D, F), dt),
+            "b_in": jnp.zeros((L, F), dt),
+            "w_out": dense_init(keys[7], (L, F, D), dt),
+            "b_out": jnp.zeros((L, D), dt),
+        },
+        "final_norm_w": jnp.ones((D,), dt),
+        "final_norm_b": jnp.zeros((D,), dt),
+        "head": dense_init(keys[8], (D, cfg.n_classes), dt),
+    }
+
+
+def patchify(images: jnp.ndarray, patch: int) -> jnp.ndarray:
+    """[B, H, W, 3] -> [B, n_patches, 3*patch*patch]."""
+    B, H, W, C = images.shape
+    gh, gw = H // patch, W // patch
+    x = images.reshape(B, gh, patch, gw, patch, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # B, gh, gw, p, p, C
+    return x.reshape(B, gh * gw, patch * patch * C)
+
+
+def forward(params: dict, cfg: ModelConfig, images: jnp.ndarray) -> jnp.ndarray:
+    """images [B, H, W, 3] float -> logits [B, n_classes] f32 (pre-LN ViT)."""
+    B = images.shape[0]
+    H, hd = cfg.n_heads, cfg.dim // cfg.n_heads
+
+    x = qmatmul(patchify(images.astype(cfg.jdtype), cfg.patch_size),
+                params["patch_proj"])
+    cls = jnp.broadcast_to(params["cls_token"], (B, 1, cfg.dim))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embedding"][None]
+    S = x.shape[1]
+
+    def body(x, w):
+        h = layer_norm(x, w["norm1_w"], w["norm1_b"], cfg.norm_eps)
+        q = qmatmul(h, w["wq"]).reshape(B, S, H, hd)
+        k = qmatmul(h, w["wk"]).reshape(B, S, H, hd)
+        v = qmatmul(h, w["wv"]).reshape(B, S, H, hd)
+        x = x + qmatmul(full_attention(q, k, v).reshape(B, S, H * hd), w["wo"])
+        h = layer_norm(x, w["norm2_w"], w["norm2_b"], cfg.norm_eps)
+        x = x + qmatmul(jax.nn.gelu(qmatmul(h, w["w_in"]) + w["b_in"]), w["w_out"]) + w["b_out"]
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = layer_norm(x, params["final_norm_w"], params["final_norm_b"], cfg.norm_eps)
+    return qmatmul(x[:, 0], params["head"]).astype(jnp.float32)

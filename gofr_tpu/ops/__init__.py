@@ -1,0 +1,24 @@
+"""TPU-native compute ops: norms, rotary embeddings, attention, quantization.
+
+Pure-JAX paths, shape-static and jit/vmap/shard_map compatible; Pallas TPU
+kernels for the hot ops land in ``ops.flash`` (these jnp versions stay as
+the portable fallback and numerics reference). No reference equivalent —
+the reference (GoFr) has no compute layer; this is the TPU graft's core.
+"""
+
+from .norms import rms_norm, layer_norm
+from .rope import rope_frequencies, apply_rope
+from .attention import causal_attention, decode_attention
+from .quant import quantize_int8, QuantizedLinear, qmatmul
+
+__all__ = [
+    "rms_norm",
+    "layer_norm",
+    "rope_frequencies",
+    "apply_rope",
+    "causal_attention",
+    "decode_attention",
+    "quantize_int8",
+    "QuantizedLinear",
+    "qmatmul",
+]

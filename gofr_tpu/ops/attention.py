@@ -1,0 +1,91 @@
+"""Attention: causal prefill and single-step decode against a KV cache.
+
+Reference-free (GoFr has no compute layer). Designed for the TPU:
+  - GQA handled by reshaping Q to [.., kv_heads, group, ..] so the einsum
+    stays a large MXU matmul instead of head-looped small ones.
+  - Softmax in float32, matmuls in bf16.
+  - Decode masks by per-sequence cache length (continuous batching: every
+    batch slot has its own cursor).
+These jnp paths are the portable baseline (XLA already fuses them well);
+they also serve as the numerics reference that the Pallas TPU kernels are
+tested against once ``ops.flash`` lands (planned kernel set: flash prefill,
+decode attention, quantized matmul).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _repeat_kv_shape(q: jnp.ndarray, n_kv: int) -> jnp.ndarray:
+    """[B, S, H, D] -> [B, S, n_kv, group, D] without copying."""
+    b, s, h, d = q.shape
+    return q.reshape(b, s, n_kv, h // n_kv, d)
+
+
+def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                     mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Causal self-attention for prefill.
+
+    q: [B, S, H, D]; k, v: [B, S, KV, D] (KV may divide H for GQA).
+    mask: optional [B, S] validity mask (1 = real token, 0 = padding).
+    Returns [B, S, H, D].
+    """
+    b, s, h, d = q.shape
+    n_kv = k.shape[2]
+    scale = d ** -0.5
+
+    qg = _repeat_kv_shape(q * scale, n_kv)  # [B,S,KV,G,D]
+    # scores: [B, KV, G, S, S]
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, k,
+                        preferred_element_type=jnp.float32)
+    causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+    scores = jnp.where(causal[None, None, None], scores, NEG_INF)
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+    return out.reshape(b, s, h, d)
+
+
+def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+                     lengths: jnp.ndarray) -> jnp.ndarray:
+    """Single-token decode attention against a preallocated KV cache.
+
+    q: [B, 1, H, D]; k_cache, v_cache: [B, Smax, KV, D];
+    lengths: [B] int32 — number of valid cache entries per sequence
+    (INCLUDING the token being decoded, already written to the cache).
+    Returns [B, 1, H, D].
+    """
+    b, _, h, d = q.shape
+    smax = k_cache.shape[1]
+    n_kv = k_cache.shape[2]
+    scale = d ** -0.5
+
+    qg = _repeat_kv_shape(q * scale, n_kv)[:, 0]  # [B,KV,G,D]
+    scores = jnp.einsum("bkgd,btkd->bkgt", qg, k_cache,
+                        preferred_element_type=jnp.float32)  # [B,KV,G,Smax]
+    valid = jnp.arange(smax)[None, :] < lengths[:, None]  # [B,Smax]
+    scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum("bkgt,btkd->bkgd", probs, v_cache)
+    return out.reshape(b, 1, h, d)
+
+
+def full_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Bidirectional attention (BERT/ViT encoders). Shapes as causal_attention."""
+    b, s, h, d = q.shape
+    n_kv = k.shape[2]
+    scale = d ** -0.5
+    qg = _repeat_kv_shape(q * scale, n_kv)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, k,
+                        preferred_element_type=jnp.float32)
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+    return out.reshape(b, s, h, d)

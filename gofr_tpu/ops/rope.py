@@ -1,0 +1,51 @@
+"""Rotary position embeddings (RoPE), Llama-3 style with NTK scaling hook.
+
+Frequencies are precomputed once per model (static shapes — nothing here
+re-traces per step); application is a fused elementwise op that XLA folds
+into the surrounding attention computation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq: int, theta: float = 500000.0,
+                     scaling: dict | None = None) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Precompute (cos, sin) tables of shape [max_seq, head_dim//2].
+
+    ``scaling`` supports the Llama-3 frequency-scaling dict
+    {factor, low_freq_factor, high_freq_factor, original_max_position}.
+    """
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if scaling:
+        factor = scaling.get("factor", 8.0)
+        low = scaling.get("low_freq_factor", 1.0)
+        high = scaling.get("high_freq_factor", 4.0)
+        orig = scaling.get("original_max_position", 8192)
+        wavelen = 2.0 * jnp.pi / inv_freq
+        ratio = orig / wavelen
+        smooth = jnp.clip((ratio - low) / (high - low), 0.0, 1.0)
+        inv_freq = jnp.where(
+            wavelen > orig / low,  # long wavelengths: fully scaled
+            inv_freq / factor,
+            inv_freq * smooth + (inv_freq / factor) * (1.0 - smooth),
+        )
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # [max_seq, head_dim//2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+               positions: jnp.ndarray) -> jnp.ndarray:
+    """Rotate ``x`` [..., seq, heads, head_dim] by per-token positions.
+
+    ``positions`` is [..., seq] int32 — explicit positions (not an offset)
+    so continuous batching can give every sequence its own cursor.
+    """
+    dtype = x.dtype
+    c = cos[positions][..., :, None, :]  # [..., seq, 1, hd/2]
+    s = sin[positions][..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)

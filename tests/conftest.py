@@ -1,15 +1,24 @@
-"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh BEFORE any jax
-import, so multi-chip sharding logic is exercised hermetically (the driver
-does the same for dryrun_multichip)."""
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh so multi-chip
+sharding logic is exercised hermetically (the driver does the same for
+dryrun_multichip).
+
+Note: the ambient environment registers a real-TPU platform from
+sitecustomize at interpreter boot, so env vars set here are too late —
+use jax.config overrides, which take effect before first backend use.
+"""
 
 import os
-
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
-
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+# Tests validate numerics: use exact f32 matmuls. Production keeps the
+# platform default (bf16 passes on the MXU), which is what we want on TPU.
+jax.config.update("jax_default_matmul_precision", "float32")
+
+assert jax.devices()[0].platform == "cpu", "tests must run on the CPU backend"
+assert len(jax.devices()) == 8, "tests expect a virtual 8-device CPU mesh"

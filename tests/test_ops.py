@@ -1,0 +1,184 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.ops.attention import causal_attention, decode_attention, full_attention
+from gofr_tpu.ops.norms import layer_norm, rms_norm
+from gofr_tpu.ops.quant import dequantize, maybe_quantize_tree, qmatmul, quantize_int8
+from gofr_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+def naive_attention(q, k, v, causal=True):
+    """Slow per-head reference with GQA repetition."""
+    b, s, h, d = q.shape
+    kv = k.shape[2]
+    rep = h // kv
+    k = np.repeat(np.asarray(k, np.float32), rep, axis=2)
+    v = np.repeat(np.asarray(v, np.float32), rep, axis=2)
+    q = np.asarray(q, np.float32)
+    out = np.zeros_like(q)
+    for bi in range(b):
+        for hi in range(h):
+            scores = q[bi, :, hi] @ k[bi, :, hi].T / np.sqrt(d)
+            if causal:
+                scores = np.where(np.tril(np.ones((s, s), bool)), scores, -1e30)
+            p = np.exp(scores - scores.max(-1, keepdims=True))
+            p = p / p.sum(-1, keepdims=True)
+            out[bi, :, hi] = p @ v[bi, :, hi]
+    return out
+
+
+def test_rms_norm_matches_reference():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 16))
+    w = jax.random.normal(jax.random.PRNGKey(1), (16,))
+    got = rms_norm(x, w)
+    xf = np.asarray(x, np.float64)
+    want = xf / np.sqrt((xf ** 2).mean(-1, keepdims=True) + 1e-5) * np.asarray(w, np.float64)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_layer_norm_matches_reference():
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 7, 8))
+    w = jnp.ones((8,)) * 1.5
+    b = jnp.ones((8,)) * 0.25
+    got = layer_norm(x, w, b, eps=1e-12)
+    xf = np.asarray(x, np.float64)
+    want = (xf - xf.mean(-1, keepdims=True)) / np.sqrt(xf.var(-1, keepdims=True) + 1e-12) * 1.5 + 0.25
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_rope_rotation_preserves_norm_and_is_relative():
+    cos, sin = rope_frequencies(8, 32, theta=10000.0)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 4, 2, 8))
+    pos = jnp.arange(4)[None]
+    y = apply_rope(x, cos, sin, pos)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(y), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5)
+    # position 0 is identity
+    np.testing.assert_allclose(np.asarray(y[:, 0]), np.asarray(x[:, 0]), atol=1e-6)
+    # relative property: <rope(q,i), rope(k,j)> depends only on i-j
+    q = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 1, 8))
+    k = jax.random.normal(jax.random.PRNGKey(2), (1, 1, 1, 8))
+    def dot_at(pi, pj):
+        qi = apply_rope(q, cos, sin, jnp.array([[pi]]))
+        kj = apply_rope(k, cos, sin, jnp.array([[pj]]))
+        return float(jnp.sum(qi * kj))
+    assert dot_at(3, 1) == pytest.approx(dot_at(7, 5), rel=1e-4)
+
+
+def test_llama3_rope_scaling_changes_low_freqs():
+    plain_cos, _ = rope_frequencies(8, 64, theta=10000.0)
+    scaled_cos, _ = rope_frequencies(8, 64, theta=10000.0, scaling={
+        "factor": 8.0, "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+        "original_max_position": 16})
+    assert not np.allclose(np.asarray(plain_cos), np.asarray(scaled_cos))
+
+
+def test_causal_attention_matches_naive():
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (2, 6, 4, 8))
+    k = jax.random.normal(jax.random.PRNGKey(1), (2, 6, 2, 8))
+    v = jax.random.normal(jax.random.PRNGKey(2), (2, 6, 2, 8))
+    got = causal_attention(q, k, v)
+    want = naive_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3, atol=2e-3)
+
+
+def test_full_attention_matches_naive():
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 4, 8))
+    k = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 4, 8))
+    v = jax.random.normal(jax.random.PRNGKey(2), (2, 5, 4, 8))
+    got = full_attention(q, k, v)
+    want = naive_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3, atol=2e-3)
+
+
+def test_decode_attention_equals_causal_last_step():
+    """Decoding the t-th token against a cache == last row of causal prefill."""
+    B, S, H, KV, D = 2, 6, 4, 2, 8
+    q_all = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D))
+    k_all = jax.random.normal(jax.random.PRNGKey(1), (B, S, KV, D))
+    v_all = jax.random.normal(jax.random.PRNGKey(2), (B, S, KV, D))
+    want = causal_attention(q_all, k_all, v_all)[:, -1:]
+
+    smax = 10
+    k_cache = jnp.zeros((B, smax, KV, D)).at[:, :S].set(k_all)
+    v_cache = jnp.zeros((B, smax, KV, D)).at[:, :S].set(v_all)
+    got = decode_attention(q_all[:, -1:], k_cache, v_cache,
+                           lengths=jnp.full((B,), S, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-3, atol=2e-3)
+
+
+def test_decode_attention_per_slot_lengths():
+    """Each batch slot honors its own cursor."""
+    B, KV, D = 2, 1, 4
+    smax = 8
+    k_cache = jax.random.normal(jax.random.PRNGKey(0), (B, smax, KV, D))
+    v_cache = jax.random.normal(jax.random.PRNGKey(1), (B, smax, KV, D))
+    q = jax.random.normal(jax.random.PRNGKey(2), (B, 1, KV, D))
+    lengths = jnp.array([2, 5], jnp.int32)
+    got = decode_attention(q, k_cache, v_cache, lengths)
+    for b, ln in enumerate([2, 5]):
+        solo = decode_attention(q[b:b+1], k_cache[b:b+1, :], v_cache[b:b+1, :],
+                                jnp.array([ln], jnp.int32))
+        np.testing.assert_allclose(np.asarray(got[b]), np.asarray(solo[0]), rtol=1e-4)
+
+
+def test_quantize_roundtrip_accuracy():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32)) * 0.1
+    qw = quantize_int8(w)
+    assert qw.w.dtype == jnp.int8
+    assert qw.scale.shape == (32,)
+    err = np.abs(np.asarray(dequantize(qw, jnp.float32)) - np.asarray(w))
+    assert err.max() < 0.1 * 2 / 127  # within one quantization step
+
+
+def test_qmatmul_quantized_close_to_dense():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 64))
+    w = jax.random.normal(jax.random.PRNGKey(1), (64, 32)) * 0.05
+    dense = np.asarray(x) @ np.asarray(w)
+    quant = qmatmul(x, quantize_int8(w))
+    rel = np.abs(np.asarray(quant) - dense).max() / np.abs(dense).max()
+    assert rel < 0.02
+    # plain path too
+    np.testing.assert_allclose(np.asarray(qmatmul(x, w)), dense, rtol=2e-3, atol=2e-3)
+
+
+def test_maybe_quantize_tree_selects_correct_leaves():
+    from gofr_tpu.ops.quant import QuantizedLinear
+
+    params = {
+        "embedding": jnp.zeros((512, 512)),
+        "layers": {
+            "wq": jnp.ones((2, 512, 512)),
+            "attn_norm": jnp.ones((2, 512)),
+        },
+        "lm_head": jnp.ones((512, 512)),
+    }
+    q = maybe_quantize_tree(params, True, min_size=1024)
+    assert isinstance(q["layers"]["wq"], QuantizedLinear)
+    assert q["layers"]["wq"].w.shape == (2, 512, 512)
+    assert q["layers"]["wq"].scale.shape == (2, 512)
+    assert isinstance(q["lm_head"], QuantizedLinear)
+    assert not isinstance(q["embedding"], QuantizedLinear)
+    assert not isinstance(q["layers"]["attn_norm"], QuantizedLinear)
+    # disabled -> untouched
+    assert maybe_quantize_tree(params, False) is params
+
+
+def test_maybe_quantize_tree_leaves_stacked_biases_dense():
+    """Stacked [L, F] biases look like 2-D weights by shape; quantizing them
+    breaks the lax.scan leading-axis contract (regression: vit-l-14)."""
+    from gofr_tpu.ops.quant import QuantizedLinear
+    import jax
+    from gofr_tpu.models import VIT_CONFIGS, vit
+
+    cfg = VIT_CONFIGS["tiny"]
+    p = vit.init(cfg, jax.random.PRNGKey(0))
+    q = maybe_quantize_tree(p, True, min_size=0)
+    assert isinstance(q["layers"]["wq"], QuantizedLinear)
+    assert not isinstance(q["layers"]["b_in"], QuantizedLinear)
+    out = vit.forward(q, cfg, jnp.ones((1, 28, 28, 3)))
+    assert out.shape == (1, cfg.n_classes)
