@@ -38,8 +38,13 @@ def get_rope_tables(cfg: ModelConfig, max_seq: int):
     scaling_key = tuple(sorted(cfg.rope_scaling.items())) if cfg.rope_scaling else None
     key = (cfg.head_dim, max_seq, cfg.rope_theta, scaling_key)
     if key not in _ROPE_CACHE:
-        _ROPE_CACHE[key] = rope_frequencies(cfg.head_dim, max_seq,
-                                            cfg.rope_theta, cfg.rope_scaling)
+        tables = rope_frequencies(cfg.head_dim, max_seq,
+                                  cfg.rope_theta, cfg.rope_scaling)
+        # Under a trace the tables are tracers — return them but never
+        # memoize (a cached tracer would leak into later traces).
+        if any(isinstance(t, jax.core.Tracer) for t in tables):
+            return tables
+        _ROPE_CACHE[key] = tables
     return _ROPE_CACHE[key]
 
 
@@ -121,6 +126,49 @@ def _logits(params, cfg: ModelConfig, x):
     return qmatmul(x, params["lm_head"]).astype(jnp.float32)
 
 
+def _causal_scan(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
+                 lengths: jnp.ndarray | None, rope_max: int, rope_tables,
+                 constrain, collect_kv: bool):
+    """Shared causal body for forward/prefill: embed, mask, scan layers.
+
+    Returns (x [B,S,D], kv  — stacked [L,B,S,KV,hd] pair when
+    ``collect_kv`` else None, lengths [B]). ``constrain`` is an optional
+    activation-sharding hook (x -> x) applied to the embedded input and
+    each layer output — a stable GSPMD anchor for dp/sp layouts.
+    """
+    B, S = tokens.shape
+    if lengths is None:
+        lengths = jnp.full((B,), S, jnp.int32)
+    cos, sin = rope_tables or get_rope_tables(cfg, rope_max)
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    valid = positions < lengths[:, None]
+    constrain = constrain or (lambda x: x)
+
+    x = constrain(params["embedding"][tokens].astype(cfg.jdtype))
+
+    def body(x, layer_w):
+        x, kv = _layer(x, layer_w, cfg, cos, sin, positions,
+                       kv_write=lambda k, v: (k, v),
+                       attend=lambda q, k, v: causal_attention(q, k, v,
+                                                               mask=valid))
+        # Training drops the per-layer k/v so the scan never materializes
+        # the [L,B,S,KV,hd] stacks it would otherwise carry.
+        return constrain(x), (kv if collect_kv else None)
+
+    x, kv = jax.lax.scan(body, x, params["layers"])
+    return x, kv, lengths
+
+
+def forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
+            lengths: jnp.ndarray | None = None, rope_tables=None,
+            constrain=None) -> jnp.ndarray:
+    """Cache-free causal forward over [B, S] tokens -> [B, S, V] f32 logits.
+    The training/scoring path: no KV-cache allocation or writes."""
+    x, _, _ = _causal_scan(params, cfg, tokens, lengths, tokens.shape[1],
+                           rope_tables, constrain, collect_kv=False)
+    return _logits(params, cfg, x)
+
+
 def prefill(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
             cache: KVCache, lengths: jnp.ndarray | None = None,
             rope_tables=None) -> tuple[jnp.ndarray, KVCache]:
@@ -129,25 +177,10 @@ def prefill(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     ``lengths`` [B]: true prompt lengths (defaults to full S).
     Returns (logits [B, S, V] in f32, cache with lengths set).
     """
-    B, S = tokens.shape
-    if lengths is None:
-        lengths = jnp.full((B,), S, jnp.int32)
-    cos, sin = rope_tables or get_rope_tables(cfg, cache.k.shape[2])
-    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-    valid = positions < lengths[:, None]
-
-    x = params["embedding"][tokens].astype(cfg.jdtype)
-
-    def body(x, layer_w):
-        def kv_write(k, v):
-            return k, v  # prefill attends over the fresh S-long k/v
-
-        def attend(q, k, v):
-            return causal_attention(q, k, v, mask=valid)
-
-        return _layer(x, layer_w, cfg, cos, sin, positions, kv_write, attend)
-
-    x, (k_stack, v_stack) = jax.lax.scan(body, x, params["layers"])
+    S = tokens.shape[1]
+    x, (k_stack, v_stack), lengths = _causal_scan(
+        params, cfg, tokens, lengths, cache.k.shape[2], rope_tables,
+        constrain=None, collect_kv=True)
     # k_stack: [L, B, S, KV, hd] -> write into the cache's first S slots
     if S > cache.k.shape[2]:
         raise ValueError(f"prompt length {S} exceeds cache capacity {cache.k.shape[2]}")

@@ -1,0 +1,27 @@
+"""Parallelism layer: mesh, sharding specs, sharded train/serve steps.
+
+The reference framework's only notion of "distributed" is goroutines plus
+HTTP/gRPC/pub-sub between processes (SURVEY §5: no NCCL/MPI, no DP/TP/SP).
+The TPU-native equivalent is this package: a named `jax.sharding.Mesh`
+over the slice (ICI) or pod (DCN), PartitionSpec rules per model family,
+and jitted steps whose collectives XLA derives from the specs.
+"""
+
+from .mesh import (AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP, DATA_AXES,
+                   MESH_AXES, MeshPlan, auto_plan, make_mesh,
+                   single_device_mesh)
+from .sharding import (activation_constraint, activation_spec, batch_spec,
+                       fit_spec, kv_cache_specs, param_specs, replicated,
+                       shard_params, shardings_for, spec_for)
+from .train import (TrainState, default_optimizer, init_train_state,
+                    make_train_step, next_token_loss, state_shardings)
+
+__all__ = [
+    "AXIS_DP", "AXIS_FSDP", "AXIS_SP", "AXIS_TP", "DATA_AXES", "MESH_AXES",
+    "MeshPlan", "auto_plan", "make_mesh", "single_device_mesh",
+    "activation_constraint", "activation_spec", "batch_spec", "fit_spec",
+    "kv_cache_specs", "param_specs", "replicated", "shard_params",
+    "shardings_for", "spec_for",
+    "TrainState", "default_optimizer", "init_train_state", "make_train_step",
+    "next_token_loss", "state_shardings",
+]

@@ -1,0 +1,164 @@
+"""Sharding rules: map model pytrees onto the mesh by leaf name.
+
+This is the GSPMD half of the parallelism layer (mesh.py is the substrate):
+every parameter/optimizer/cache leaf gets a `PartitionSpec`, `jax.jit`
+in/out shardings pin the boundaries, and XLA inserts the ICI collectives.
+Nothing in the model code mentions devices — the specs here are the single
+source of truth.
+
+Rule set (Megatron-style TP + ZeRO-3-style fsdp, both expressed as specs):
+  column-parallel  [L, D, out]  (wq/wk/wv/w_gate/w_up/w_in) → (None, fsdp, tp)
+  row-parallel     [L, in, D]   (wo/w_down/w_out)           → (None, tp, fsdp)
+  embeddings       [V, D]                                    → (tp, fsdp)
+  lm_head          [D, V]                                    → (fsdp, tp)
+  norms/biases                                               → replicated/minor
+Int8 `QuantizedLinear` leaves shard like their parent weight; the per-output
+scale follows the output axis.
+
+Any axis that does not divide a dimension is dropped (replicated) — so the
+same rules serve the tiny test configs and the 70B production shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP, DATA_AXES
+
+# leaf name -> spec for the *full* (possibly [L, ...]-stacked) weight
+_COLUMN = {"wq", "wk", "wv", "w_gate", "w_up", "w_in"}
+_ROW = {"wo", "w_down", "w_out"}
+_COLUMN_BIAS = {"bq", "bk", "bv", "b_in"}
+_ROW_BIAS = {"bo", "b_out"}
+
+
+def spec_for(name: str, ndim: int) -> P:
+    """PartitionSpec for a parameter leaf, keyed on its dict name."""
+    if name in _COLUMN:
+        return P(None, AXIS_FSDP, AXIS_TP) if ndim == 3 else P(AXIS_FSDP, AXIS_TP)
+    if name in _ROW:
+        return P(None, AXIS_TP, AXIS_FSDP) if ndim == 3 else P(AXIS_TP, AXIS_FSDP)
+    if name in _COLUMN_BIAS:
+        return P(None, AXIS_TP) if ndim == 2 else P(AXIS_TP)
+    if name in _ROW_BIAS:
+        return P(None, AXIS_FSDP) if ndim == 2 else P(AXIS_FSDP)
+    if name == "embedding":
+        return P(AXIS_TP, AXIS_FSDP)
+    if name == "lm_head":
+        return P(AXIS_FSDP, AXIS_TP)
+    if name in ("pos_embedding", "patch_proj", "pooler_w", "head"):
+        return P(None, AXIS_FSDP) if ndim == 2 else P(AXIS_FSDP)
+    return P()  # norms, small embeddings, cls_token: replicated
+
+
+def _leaf_name(path) -> str:
+    """Last dict key on the tree path (attr keys of NamedTuple leaves like
+    QuantizedLinear.w/.scale are skipped so they inherit the weight's name)."""
+    for entry in reversed(path):
+        if isinstance(entry, jax.tree_util.DictKey):
+            return str(entry.key)
+    return ""
+
+
+def _is_quant_scale(path) -> bool:
+    last = path[-1] if path else None
+    return isinstance(last, (jax.tree_util.GetAttrKey,)) and \
+        getattr(last, "name", "") == "scale"
+
+
+def fit_spec(spec: P, shape: tuple, mesh: Mesh) -> P:
+    """Drop spec axes that don't divide the corresponding dim (replicate
+    instead); pad/truncate the spec to the array rank."""
+    axes = list(spec) + [None] * (len(shape) - len(spec))
+    fitted = []
+    for dim, ax in zip(shape, axes[: len(shape)]):
+        if ax is None:
+            fitted.append(None)
+            continue
+        names = ax if isinstance(ax, tuple) else (ax,)
+        size = 1
+        for n in names:
+            size *= mesh.shape.get(n, 1)
+        fitted.append(ax if size > 0 and dim % size == 0 else None)
+    return P(*fitted)
+
+
+def param_specs(params: Any) -> Any:
+    """Pytree of PartitionSpec matching `params` (unfitted — see
+    `shardings_for` for the mesh-aware version)."""
+
+    def one(path, leaf):
+        name = _leaf_name(path)
+        spec = spec_for(name, leaf.ndim if hasattr(leaf, "ndim") else 0)
+        if _is_quant_scale(path):
+            # per-output-channel scale: keep only the output-axis sharding
+            tail = spec[-1] if len(spec) else None
+            spec = P(None, tail) if leaf.ndim == 2 else P(tail)
+        return spec
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def shardings_for(tree: Any, mesh: Mesh,
+                  specs: Any | None = None) -> Any:
+    """Pytree of NamedSharding for `tree` on `mesh`, with non-dividing axes
+    replicated. `tree` may hold arrays or ShapeDtypeStructs."""
+    specs = specs if specs is not None else param_specs(tree)
+
+    def one(leaf, spec):
+        return NamedSharding(mesh, fit_spec(spec, leaf.shape, mesh))
+
+    return jax.tree_util.tree_map(one, tree, specs)
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    """Place an existing (host/single-device) param tree onto the mesh."""
+    return jax.device_put(params, shardings_for(params, mesh))
+
+
+# -- activations and caches -------------------------------------------------
+
+def batch_spec() -> P:
+    """Tokens/labels [B, S]: batch over (dp, fsdp), sequence over sp."""
+    return P(DATA_AXES, AXIS_SP)
+
+
+def activation_spec(ndim: int = 3) -> P:
+    """Activations [B, S, D]: batch over (dp, fsdp), sequence over sp,
+    feature replicated (tp lives inside the per-layer matmuls)."""
+    if ndim == 2:
+        return P(DATA_AXES, AXIS_SP)
+    return P(DATA_AXES, AXIS_SP, None)
+
+
+def activation_constraint(mesh: Mesh) -> Callable:
+    """`constrain` hook for model forwards: pins [B, S, D] activations to
+    the dp/sp layout so GSPMD has a stable anchor between layers."""
+
+    def constrain(x):
+        if not hasattr(x, "ndim") or x.ndim < 2:
+            return x
+        spec = fit_spec(activation_spec(x.ndim), x.shape, mesh)
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+    return constrain
+
+
+def kv_cache_specs(mesh: Mesh, cache) -> Any:
+    """Shardings for a models.llama.KVCache: [L, B, Smax, KV, hd] — batch
+    over data axes, kv-heads over tp, everything else local."""
+    kv = P(None, DATA_AXES, None, AXIS_TP, None)
+    ln = P(DATA_AXES)
+    return type(cache)(
+        k=NamedSharding(mesh, fit_spec(kv, cache.k.shape, mesh)),
+        v=NamedSharding(mesh, fit_spec(kv, cache.v.shape, mesh)),
+        lengths=NamedSharding(mesh, fit_spec(ln, cache.lengths.shape, mesh)),
+    )
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,163 @@
+"""Sharded training: one jitted step — forward, loss, grad, optax update.
+
+The reference framework has no training loop (it is a Go microservice
+framework); this subsystem exists because a TPU-native serving framework
+needs a first-class fine-tuning/continued-pretraining path for the models
+it serves. Design:
+
+  - ONE `jax.jit` over the whole step with explicit in/out shardings and
+    donated (params, opt_state): XLA fuses forward+backward+update and
+    overlaps the fsdp all-gathers/reduce-scatters with compute.
+  - Gradients reduce over the data axes automatically: params are sharded
+    (or replicated) over (dp, fsdp) while the batch is split over them, so
+    GSPMD inserts the psum/reduce-scatter — we never call a collective.
+  - `jax.checkpoint` on the scanned layer body trades recompute for HBM,
+    which is what makes long-sequence training fit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..models import llama
+from ..models.common import ModelConfig
+from .mesh import Mesh
+from .sharding import (activation_constraint, batch_spec, fit_spec,
+                       param_specs, shardings_for)
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class TrainState(NamedTuple):
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+
+
+def default_optimizer(lr: float = 3e-4, *, warmup: int = 100,
+                      total_steps: int = 10_000,
+                      weight_decay: float = 0.1,
+                      grad_clip: float = 1.0) -> optax.GradientTransformation:
+    sched = optax.warmup_cosine_decay_schedule(0.0, lr, warmup,
+                                               max(total_steps, warmup + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )
+
+
+def next_token_loss(logits: jnp.ndarray, tokens: jnp.ndarray,
+                    lengths: jnp.ndarray) -> jnp.ndarray:
+    """Mean causal-LM cross-entropy: logits [B,S,V] f32 predict tokens
+    shifted left; positions ≥ length are masked out."""
+    B, S, _ = logits.shape
+    targets = tokens[:, 1:]                       # [B, S-1]
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32),
+                               axis=-1)[..., 0]   # [B, S-1]
+    mask = (jnp.arange(1, S)[None, :] < lengths[:, None]).astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def init_train_state(cfg: ModelConfig, key, mesh: Mesh,
+                     optimizer: optax.GradientTransformation) -> TrainState:
+    """Init params + optimizer state DIRECTLY sharded on the mesh: the init
+    itself is jitted with out_shardings, so no host-side full copy of the
+    model ever exists (required for 70B-class runs)."""
+
+    def build(key):
+        params = llama.init(cfg, key)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=optimizer.init(params))
+
+    shapes = jax.eval_shape(build, key)
+    out_sh = state_shardings(shapes, mesh)
+    return jax.jit(build, out_shardings=out_sh)(key)
+
+
+def state_shardings(state_like: Any, mesh: Mesh) -> Any:
+    """Shardings for a TrainState (or its eval_shape): optimizer moments
+    mirror their parameter's spec; scalars replicate."""
+    p_specs = param_specs(state_like.params)
+    p_shard = shardings_for(state_like.params, mesh, p_specs)
+    rep = NamedSharding(mesh, P())
+
+    # Optax moment leaves MIRROR the param tree: an adam mu/nu leaf's tree
+    # path ends with the same dict-key chain as its parameter (e.g.
+    # .mu['layers']['wo']). Match by that name chain — matching by shape
+    # would collide wq/wo (same shape, transposed specs).
+    def names(path) -> tuple:
+        return tuple(str(e.key) for e in path
+                     if isinstance(e, jax.tree_util.DictKey))
+
+    by_names: dict[tuple, Any] = {}
+    for (path, _), sh in zip(
+            jax.tree_util.tree_flatten_with_path(state_like.params)[0],
+            jax.tree_util.tree_leaves(p_shard)):
+        by_names[names(path)] = sh
+
+    def match(path, leaf):
+        key = names(path)
+        # longest non-empty suffix of the opt-leaf path naming a param
+        for i in range(len(key)):
+            sh = by_names.get(key[i:])
+            if sh is not None:
+                return sh
+        return rep
+
+    opt_sh = jax.tree_util.tree_map_with_path(match, state_like.opt_state)
+    return TrainState(step=rep, params=p_shard, opt_state=opt_sh)
+
+
+def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
+                    mesh: Mesh, *, remat: bool = True) -> Callable:
+    """Build the jitted sharded train step:
+    step(state, tokens [B,S], lengths [B]) -> (state, metrics dict)."""
+    constrain = activation_constraint(mesh)
+
+    fwd = (jax.checkpoint(llama.forward, static_argnums=(1, 5))
+           if remat else llama.forward)
+
+    def loss_fn(params, tokens, lengths):
+        logits = fwd(params, cfg, tokens, lengths, None, constrain)
+        return next_token_loss(logits, tokens, lengths)
+
+    def step(state: TrainState, tokens, lengths):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, lengths)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        new = TrainState(step=state.step + 1, params=params,
+                         opt_state=opt_state)
+        return new, {"loss": loss.astype(jnp.float32),
+                     "grad_norm": gnorm.astype(jnp.float32),
+                     "step": new.step}
+
+    def data_sharding(shape_rank2, shape_rank1):
+        tok = NamedSharding(mesh, fit_spec(batch_spec(), shape_rank2, mesh))
+        ln = NamedSharding(mesh, fit_spec(P(batch_spec()[0]), shape_rank1, mesh))
+        return tok, ln
+
+    compiled: dict[tuple, Callable] = {}
+
+    def jitted(state: TrainState, tokens, lengths):
+        key = (tuple(tokens.shape), tuple(lengths.shape))
+        if key not in compiled:
+            st_sh = state_shardings(state, mesh)
+            tok_sh, len_sh = data_sharding(tokens.shape, lengths.shape)
+            rep = NamedSharding(mesh, P())
+            metrics_sh = {"loss": rep, "grad_norm": rep, "step": rep}
+            fn = jax.jit(step,
+                         in_shardings=(st_sh, tok_sh, len_sh),
+                         out_shardings=(st_sh, metrics_sh),
+                         donate_argnums=(0,))
+            compiled[key] = (fn, tok_sh, len_sh)
+        fn, tok_sh, len_sh = compiled[key]
+        return fn(state, jax.device_put(jnp.asarray(tokens), tok_sh),
+                  jax.device_put(jnp.asarray(lengths), len_sh))
+
+    return jitted

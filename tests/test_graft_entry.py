@@ -1,0 +1,19 @@
+"""Driver-contract checks: entry() compiles, dryrun_multichip executes."""
+
+import jax
+
+import __graft_entry__ as graft
+
+
+def test_entry_jits():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (2, 64, graft._SMOKE.vocab_size)
+
+
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_2():
+    graft.dryrun_multichip(2)
