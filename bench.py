@@ -82,8 +82,8 @@ def bench_decode(cfg: ModelConfig, batch: int, cache_len: int,
 
     # params/rope passed as arguments (NOT closed over: closure arrays get
     # captured as lowering constants — 8.5GB baked into the executable).
-    @functools.partial(jax.jit, donate_argnums=(2,))
-    def step(params, tokens, cache):
+    @functools.partial(jax.jit, donate_argnums=(3,))
+    def step(params, rope, tokens, cache):
         logits, cache = llama.decode_step(params, cfg, tokens, cache, rope)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
@@ -92,16 +92,16 @@ def bench_decode(cfg: ModelConfig, batch: int, cache_len: int,
     # region (np.asarray forces a device->host copy of the final tokens,
     # which transitively requires every step to have run).
     t0 = time.perf_counter()
-    tokens, cache = step(params, tokens, cache)
+    tokens, cache = step(params, rope, tokens, cache)
     np.asarray(tokens)
     log(f"  compile+first step: {time.perf_counter() - t0:.1f}s")
     for _ in range(3):
-        tokens, cache = step(params, tokens, cache)
+        tokens, cache = step(params, rope, tokens, cache)
     np.asarray(tokens)
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        tokens, cache = step(params, tokens, cache)
+        tokens, cache = step(params, rope, tokens, cache)
     np.asarray(tokens)
     dt = time.perf_counter() - t0
     tok_s = batch * steps / dt

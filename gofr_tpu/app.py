@@ -158,7 +158,8 @@ class App:
 
         self.container.register_service(
             name,
-            new_http_service(address, self.logger, self.container.metrics, *options),
+            new_http_service(address, self.logger, self.container.metrics, *options,
+                             tracer=self.container.tracer),
         )
 
     # -- pub/sub (reference gofr.go:304-312) ---------------------------------

@@ -1,0 +1,118 @@
+"""Kafka driver (gated: requires the optional ``kafka-python`` client).
+
+Reference: pkg/gofr/datasource/pubsub/kafka/kafka.go —
+  - lazy per-topic readers in a consumer group, guarded by a lock
+    (kafka.go:117-153, getNewReader :166, RWMutex :33)
+  - single shared producer (:41-76), publish :90-115
+  - commit-on-success via the message committer (message.go:25)
+  - create/delete topic via the admin client (:180-196)
+  - health = broker reachability + reader/writer stats (health.go:9-53)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .. import Health, STATUS_DOWN, STATUS_UP
+from . import Message
+
+
+class KafkaClient:
+    def __init__(self, brokers: str, consumer_group: str = "gofr",
+                 partition_size: int = 0, offset: str = "latest", logger=None):
+        try:
+            import kafka  # noqa: F401  (gated import)
+        except ImportError as e:
+            raise RuntimeError(
+                "KAFKA backend requires the kafka-python package") from e
+        from kafka import KafkaProducer
+
+        self._kafka = kafka
+        self.brokers = brokers.split(",")
+        self.consumer_group = consumer_group
+        self.offset = "earliest" if offset.lower() in ("earliest", "oldest") else "latest"
+        self.logger = logger
+        self._producer = KafkaProducer(bootstrap_servers=self.brokers)
+        self._consumers: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _consumer(self, topic: str):
+        """Lazy per-topic consumer (reference kafka.go:166 getNewReader)."""
+        with self._lock:
+            if topic not in self._consumers:
+                self._consumers[topic] = self._kafka.KafkaConsumer(
+                    topic, bootstrap_servers=self.brokers,
+                    group_id=self.consumer_group,
+                    auto_offset_reset=self.offset,
+                    enable_auto_commit=False)
+            return self._consumers[topic]
+
+    def publish(self, topic: str, message: bytes) -> None:
+        self._producer.send(topic, message).get(timeout=30)
+
+    def subscribe(self, topic: str, timeout: Optional[float] = None) -> Message | None:
+        consumer = self._consumer(topic)
+        ms = int((0.5 if timeout is None else timeout) * 1000)
+        batch = consumer.poll(timeout_ms=ms, max_records=1)
+        for records in batch.values():
+            for rec in records:
+                def commit(rec=rec):
+                    # commit THIS message's offset, not the consumer's
+                    # current position — committing the position would mark
+                    # earlier uncommitted (failed) messages as processed and
+                    # break at-least-once (reference kafka/message.go:25-30
+                    # commits the specific message)
+                    from kafka import TopicPartition
+                    from kafka.structs import OffsetAndMetadata
+
+                    consumer.commit({
+                        TopicPartition(rec.topic, rec.partition):
+                            OffsetAndMetadata(rec.offset + 1, None)})
+
+                return Message(
+                    topic, rec.value,
+                    metadata={"offset": str(rec.offset),
+                              "partition": str(rec.partition)},
+                    committer=commit)
+        return None
+
+    def create_topic(self, name: str) -> None:
+        from kafka.admin import KafkaAdminClient, NewTopic
+
+        admin = KafkaAdminClient(bootstrap_servers=self.brokers)
+        try:
+            admin.create_topics([NewTopic(name, num_partitions=1,
+                                          replication_factor=1)])
+        finally:
+            admin.close()
+
+    def delete_topic(self, name: str) -> None:
+        from kafka.admin import KafkaAdminClient
+
+        admin = KafkaAdminClient(bootstrap_servers=self.brokers)
+        try:
+            admin.delete_topics([name])
+        finally:
+            admin.close()
+
+    def health_check(self) -> Health:
+        try:
+            ok = self._producer.bootstrap_connected()
+            return Health(status=STATUS_UP if ok else STATUS_DOWN,
+                          details={"backend": "KAFKA", "brokers": self.brokers,
+                                   "readers": list(self._consumers)})
+        except Exception as e:
+            return Health(status=STATUS_DOWN,
+                          details={"backend": "KAFKA", "error": repr(e)})
+
+    def close(self) -> None:
+        try:
+            self._producer.close()
+        except Exception:
+            pass
+        for c in self._consumers.values():
+            try:
+                c.close()
+            except Exception:
+                pass

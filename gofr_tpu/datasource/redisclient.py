@@ -1,0 +1,287 @@
+"""Redis datasource: a dependency-free RESP2 client with command observability.
+
+Reference: pkg/gofr/datasource/redis/ —
+  - client from REDIS_HOST/PORT (redis.go:35-76)
+  - a hook logging every command + pipeline with µs duration into the
+    ``app_redis_stats`` histogram (hook.go:65-84)
+  - health via PING + INFO Stats (health.go:11-40)
+
+The reference rides go-redis; no Redis client library is available here, so
+this speaks the RESP2 wire protocol directly over a socket — which also
+keeps the datasource layer dependency-free. The testutil FakeRedisServer
+(testutil/redisfake.py) is the miniredis-equivalent seam
+(reference datasource/redis/redis_test.go:48-52).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Any
+
+from . import DSLogger, Health, STATUS_DOWN, STATUS_UP
+
+
+class RedisError(Exception):
+    """Server-side error reply (RESP '-ERR ...')."""
+
+
+def encode_command(*args: Any) -> bytes:
+    """RESP2 array-of-bulk-strings request framing."""
+    out = [f"*{len(args)}\r\n".encode()]
+    for a in args:
+        b = a if isinstance(a, bytes) else str(a).encode()
+        out.append(f"${len(b)}\r\n".encode())
+        out.append(b)
+        out.append(b"\r\n")
+    return b"".join(out)
+
+
+class _Reader:
+    """Buffered RESP2 reply parser over a socket."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._buf = b""
+
+    def _read_line(self) -> bytes:
+        while b"\r\n" not in self._buf:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("redis connection closed")
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\r\n", 1)
+        return line
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n + 2:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("redis connection closed")
+            self._buf += chunk
+        data, self._buf = self._buf[:n], self._buf[n + 2:]  # strip \r\n
+        return data
+
+    def read_reply(self) -> Any:
+        line = self._read_line()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RedisError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            return self._read_exact(n)
+        if kind == b"*":
+            n = int(rest)
+            if n == -1:
+                return None
+            return [self.read_reply() for _ in range(n)]
+        raise RedisError(f"unexpected RESP type {line!r}")
+
+
+class Pipeline:
+    """Batched commands flushed in one round trip
+    (reference hook.go:75-84 ProcessPipelineHook observes the whole batch)."""
+
+    def __init__(self, client: "RedisClient"):
+        self._client = client
+        self._cmds: list[tuple] = []
+
+    def command(self, *args) -> "Pipeline":
+        self._cmds.append(args)
+        return self
+
+    def __getattr__(self, name: str):
+        def call(*args):
+            return self.command(name.upper(), *args)
+        return call
+
+    def execute(self) -> list[Any]:
+        return self._client._execute_pipeline(self._cmds)
+
+
+class RedisClient:
+    def __init__(self, host: str = "localhost", port: int = 6379,
+                 logger: DSLogger | None = None, metrics=None,
+                 timeout: float = 5.0):
+        self.host = host
+        self.port = port
+        self.logger = logger
+        self.metrics = metrics
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+        self._reader: _Reader | None = None
+        self._connect()
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection((self.host, self.port),
+                                              timeout=self.timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._reader = _Reader(self._sock)
+
+    # -- observability hook (reference hook.go:65-84) ------------------------
+    def _observe(self, label: str, dur_us: float) -> None:
+        if self.metrics is not None:
+            try:
+                self.metrics.record_histogram("app_redis_stats", dur_us, type=label)
+            except Exception:
+                pass
+        if self.logger is not None:
+            self.logger.debug({"event": "redis command", "command": label,
+                               "duration_us": int(dur_us)})
+
+    # -- generic command ----------------------------------------------------
+    def command(self, *args) -> Any:
+        label = str(args[0]).upper() if args else ""
+        start = time.perf_counter()
+        payload = encode_command(*args)
+        with self._lock:
+            try:
+                self._sock.sendall(payload)
+            except (ConnectionError, OSError, AttributeError):
+                # safe to retry: the command never reached the server
+                # (AttributeError: socket already closed -> _sock is None)
+                self._connect()
+                self._sock.sendall(payload)
+            try:
+                reply = self._reader.read_reply()
+            except (ConnectionError, OSError):
+                # NOT safe to blindly resend (the server may have executed a
+                # non-idempotent command before the connection died) — but we
+                # must reconnect so the stream isn't left desynchronized
+                self._connect()
+                raise
+        self._observe(label, (time.perf_counter() - start) * 1e6)
+        return reply
+
+    def _execute_pipeline(self, cmds: list[tuple]) -> list[Any]:
+        if not cmds:
+            return []
+        start = time.perf_counter()
+        payload = b"".join(encode_command(*c) for c in cmds)
+        with self._lock:
+            try:
+                self._sock.sendall(payload)
+                replies = []
+                for _ in cmds:
+                    try:
+                        replies.append(self._reader.read_reply())
+                    except RedisError as e:
+                        replies.append(e)
+            except (ConnectionError, OSError):
+                # reconnect so leftover in-flight replies can't be read as
+                # answers to later commands, then surface the failure
+                self._connect()
+                raise
+        self._observe(f"pipeline[{len(cmds)}]", (time.perf_counter() - start) * 1e6)
+        return replies
+
+    def pipeline(self) -> Pipeline:
+        return Pipeline(self)
+
+    # -- typed convenience surface ------------------------------------------
+    @staticmethod
+    def _text(reply: Any) -> str | None:
+        return reply.decode() if isinstance(reply, bytes) else reply
+
+    def ping(self) -> bool:
+        return self.command("PING") == "PONG"
+
+    def set(self, key: str, value: Any, ex: float | None = None) -> bool:
+        args: list[Any] = ["SET", key, value]
+        if ex is not None:
+            args += ["PX", int(ex * 1000)]
+        return self.command(*args) == "OK"
+
+    def get(self, key: str) -> str | None:
+        return self._text(self.command("GET", key))
+
+    def delete(self, *keys: str) -> int:
+        return self.command("DEL", *keys)
+
+    def exists(self, *keys: str) -> int:
+        return self.command("EXISTS", *keys)
+
+    def incr(self, key: str, by: int = 1) -> int:
+        return self.command("INCRBY", key, by)
+
+    def decr(self, key: str, by: int = 1) -> int:
+        return self.command("DECRBY", key, by)
+
+    def expire(self, key: str, seconds: float) -> bool:
+        return self.command("PEXPIRE", key, int(seconds * 1000)) == 1
+
+    def ttl(self, key: str) -> int:
+        return self.command("TTL", key)
+
+    def keys(self, pattern: str = "*") -> list[str]:
+        return [self._text(k) for k in self.command("KEYS", pattern)]
+
+    def hset(self, key: str, field: str, value: Any, *more) -> int:
+        return self.command("HSET", key, field, value, *more)
+
+    def hget(self, key: str, field: str) -> str | None:
+        return self._text(self.command("HGET", key, field))
+
+    def hgetall(self, key: str) -> dict[str, str]:
+        flat = self.command("HGETALL", key) or []
+        it = iter(flat)
+        return {self._text(k): self._text(v) for k, v in zip(it, it)}
+
+    def hdel(self, key: str, *fields: str) -> int:
+        return self.command("HDEL", key, *fields)
+
+    def lpush(self, key: str, *values) -> int:
+        return self.command("LPUSH", key, *values)
+
+    def rpush(self, key: str, *values) -> int:
+        return self.command("RPUSH", key, *values)
+
+    def lrange(self, key: str, start: int = 0, stop: int = -1) -> list[str]:
+        return [self._text(v) for v in self.command("LRANGE", key, start, stop)]
+
+    def flushdb(self) -> bool:
+        return self.command("FLUSHDB") == "OK"
+
+    def info(self, section: str = "") -> dict[str, str]:
+        raw = self.command("INFO", section) if section else self.command("INFO")
+        out: dict[str, str] = {}
+        for line in (self._text(raw) or "").splitlines():
+            if line and not line.startswith("#") and ":" in line:
+                k, v = line.split(":", 1)
+                out[k] = v
+        return out
+
+    # -- health (reference health.go:11-40) ----------------------------------
+    def health_check(self) -> Health:
+        try:
+            stats = self.info("stats")
+            return Health(status=STATUS_UP, details={
+                "host": f"{self.host}:{self.port}", **stats})
+        except Exception as e:
+            return Health(status=STATUS_DOWN, details={
+                "host": f"{self.host}:{self.port}", "error": repr(e)})
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except Exception:
+                    pass
+                self._sock = None
+
+
+def new_redis_client(cfg, logger: DSLogger | None = None, metrics=None) -> RedisClient:
+    """Wire from config (reference redis.go:38-47): REDIS_HOST, REDIS_PORT."""
+    return RedisClient(
+        host=cfg.get_or_default("REDIS_HOST", "localhost"),
+        port=cfg.get_int("REDIS_PORT", 6379),
+        logger=logger, metrics=metrics)

@@ -79,8 +79,10 @@ class CircuitOpenError(ServiceUnavailable):
     """Raised by the client-side circuit breaker while open
     (reference: pkg/gofr/service/circuit_breaker.go ErrCircuitOpen)."""
 
-    def __init__(self) -> None:
-        super().__init__("circuit breaker is open")
+    def __init__(self, address: str = "") -> None:
+        suffix = f" for {address}" if address else ""
+        super().__init__(f"circuit breaker is open{suffix}")
+        self.address = address
 
 
 def status_from_error(err: BaseException | None) -> int:

@@ -1,0 +1,94 @@
+"""Shared decorator plumbing for service options.
+
+The reference re-implements the 10-verb surface in every decorator
+(e.g. service/basic_auth.go:46-125, circuit_breaker.go:171-269). Here a
+single base class forwards every verb through one ``_do`` choke point, so
+each decorator overrides exactly one method.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+
+def set_header_default(headers: dict, key: str, value: str) -> None:
+    """setdefault with case-insensitive key matching — a caller-supplied
+    'authorization' must win over a decorator's 'Authorization'."""
+    lower = key.lower()
+    if any(k.lower() == lower for k in headers):
+        return
+    headers[key] = value
+
+
+class VerbSurface:
+    """The 10-verb client surface, all flowing through one ``_do`` choke
+    point. Shared by the innermost HTTPService and every decorator so the
+    verb list exists exactly once."""
+
+    def _do(self, method: str, path: str, params, body, headers) -> Any:
+        raise NotImplementedError
+
+    def get(self, path: str, params: Mapping[str, Any] | None = None):
+        return self._do("GET", path, params, None, None)
+
+    def get_with_headers(self, path, params=None, headers=None):
+        return self._do("GET", path, params, None, headers)
+
+    def post(self, path: str, params=None, body=b""):
+        return self._do("POST", path, params, body, None)
+
+    def post_with_headers(self, path, params=None, body=b"", headers=None):
+        return self._do("POST", path, params, body, headers)
+
+    def put(self, path: str, params=None, body=b""):
+        return self._do("PUT", path, params, body, None)
+
+    def put_with_headers(self, path, params=None, body=b"", headers=None):
+        return self._do("PUT", path, params, body, headers)
+
+    def patch(self, path: str, params=None, body=b""):
+        return self._do("PATCH", path, params, body, None)
+
+    def patch_with_headers(self, path, params=None, body=b"", headers=None):
+        return self._do("PATCH", path, params, body, headers)
+
+    def delete(self, path: str, body=b""):
+        return self._do("DELETE", path, None, body, None)
+
+    def delete_with_headers(self, path, body=b"", headers=None):
+        return self._do("DELETE", path, None, body, headers)
+
+
+class ServiceWrapper(VerbSurface):
+    def __init__(self, inner):
+        self.inner = inner
+
+    def _do(self, method: str, path: str, params, body, headers) -> Any:
+        return _dispatch(self.inner, method, path, params, body, headers)
+
+    def health_check(self):
+        return self.inner.health_check()
+
+    def close(self) -> None:
+        self.inner.close()
+
+    def __getattr__(self, name: str):
+        # delegate state inspection (is_open, address, timeout, ...) through
+        # the decorator chain so wrapping order never hides it
+        return getattr(self.inner, name)
+
+
+def _dispatch(svc, method: str, path: str, params, body, headers):
+    """Call the matching ``*_with_headers`` verb on any client layer."""
+    m = method.upper()
+    if m == "GET":
+        return svc.get_with_headers(path, params, headers)
+    if m == "POST":
+        return svc.post_with_headers(path, params, body, headers)
+    if m == "PUT":
+        return svc.put_with_headers(path, params, body, headers)
+    if m == "PATCH":
+        return svc.patch_with_headers(path, params, body, headers)
+    if m == "DELETE":
+        return svc.delete_with_headers(path, body, headers)
+    raise ValueError(f"unsupported method {method!r}")

@@ -1,0 +1,128 @@
+"""Migration tests: ordering, run-once ledger (SQL + Redis), rollback,
+pubsub topic facade, and the TPU model-version ledger extension."""
+
+from __future__ import annotations
+
+import pytest
+
+from gofr_tpu.container import Container
+from gofr_tpu.datasource.pubsub import mem
+from gofr_tpu.migration import Migrate, MigrationError, run
+from gofr_tpu.testutil import new_mock_config
+from gofr_tpu.testutil.redisfake import FakeRedisServer
+
+
+@pytest.fixture(autouse=True)
+def clean_broker():
+    mem.reset()
+    yield
+    mem.reset()
+
+
+@pytest.fixture()
+def container():
+    c = Container(new_mock_config({
+        "DB_DIALECT": "sqlite", "DB_NAME": ":memory:",
+        "PUBSUB_BACKEND": "MEM"}))
+    yield c
+    c.close()
+
+
+def test_runs_in_version_order_once(container):
+    order = []
+    migrations = {
+        20240102: Migrate(up=lambda ds: order.append(2)),
+        20240101: Migrate(up=lambda ds: order.append(1)),
+    }
+    run(migrations, container)
+    assert order == [1, 2]
+
+    # second run: ledger says both applied — nothing re-runs
+    run(migrations, container)
+    assert order == [1, 2]
+
+    # a later migration picks up from the ledger
+    migrations[20240103] = Migrate(up=lambda ds: order.append(3))
+    run(migrations, container)
+    assert order == [1, 2, 3]
+
+
+def test_plain_callables_accepted(container):
+    done = []
+    run({1: lambda ds: done.append(True)}, container)
+    assert done == [True]
+
+
+def test_sql_effects_and_ledger(container):
+    def up(ds):
+        ds.sql.execute("CREATE TABLE t (x INTEGER)")
+        ds.sql.execute("INSERT INTO t VALUES (?)", 42)
+
+    run({1: Migrate(up=up)}, container)
+    assert container.sql.query_row("SELECT x FROM t")["x"] == 42
+    ledger = container.sql.query("SELECT * FROM gofr_migrations")
+    assert len(ledger) == 1 and ledger[0]["version"] == 1
+    assert ledger[0]["method"] == "UP"
+
+
+def test_rollback_on_failure(container):
+    def bad(ds):
+        ds.sql.execute("CREATE TABLE doomed (x INTEGER)")
+        raise ValueError("boom")
+
+    with pytest.raises(MigrationError):
+        run({1: Migrate(up=bad)}, container)
+    # table creation rolled back, ledger empty
+    assert container.sql.query(
+        "SELECT name FROM sqlite_master WHERE name='doomed'") == []
+    assert container.sql.query("SELECT * FROM gofr_migrations") == []
+
+    # and it re-runs after the failure is fixed
+    done = []
+    run({1: Migrate(up=lambda ds: done.append(1))}, container)
+    assert done == [1]
+
+
+def test_invalid_migration_rejected(container):
+    with pytest.raises(MigrationError):
+        run({1: Migrate(up=None)}, container)
+
+
+def test_pubsub_topic_facade(container):
+    run({1: Migrate(up=lambda ds: ds.pubsub.create_topic("orders"))}, container)
+    assert "orders" in container.pubsub.health_check().details["topics"]
+
+
+def test_redis_ledger():
+    srv = FakeRedisServer()
+    try:
+        c = Container(new_mock_config({
+            "REDIS_HOST": srv.host, "REDIS_PORT": str(srv.port)}))
+        order = []
+        run({5: Migrate(up=lambda ds: order.append(5))}, c)
+        run({5: Migrate(up=lambda ds: order.append(5))}, c)  # no re-run
+        assert order == [5]
+        assert "5" in c.redis.hgetall("gofr_migrations")
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_tpu_model_ledger(container):
+    def up(ds):
+        ds.tpu.register_model("llama3-8b", weights_path="/w/v2", revision="v2")
+
+    run({1: Migrate(up=up)}, container)  # no engine wired — still records
+
+
+def test_app_migrate_entrypoint():
+    from gofr_tpu.app import App
+
+    app = App(new_mock_config({
+        "DB_DIALECT": "sqlite", "DB_NAME": ":memory:",
+        "HTTP_PORT": "0", "METRICS_PORT": "0"}))
+    app.migrate({1: Migrate(up=lambda ds: ds.sql.execute(
+        "CREATE TABLE via_app (x INTEGER)"))})
+    assert app.container.sql.query(
+        "SELECT name FROM sqlite_master WHERE name='via_app'") != []
+    app.container.close()

@@ -1,0 +1,236 @@
+"""Inter-service HTTP client tests: verbs, decorators, circuit breaker.
+
+Mirrors the reference's httptest-server approach (service/circuit_breaker_test.go,
+service/basic_auth_test.go): a real in-process HTTP server built from the
+framework's own Router/HTTPServer is the seam.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+
+import pytest
+
+from gofr_tpu.http.responder import ResponseWriter
+from gofr_tpu.http.router import Router
+from gofr_tpu.http.server import HTTPServer
+from gofr_tpu.service import (
+    APIKeyAuthOption,
+    BasicAuthOption,
+    CircuitBreakerOption,
+    CircuitOpenError,
+    HealthOption,
+    OAuthOption,
+    new_http_service,
+)
+from gofr_tpu.testutil import new_mock_logger
+
+
+@pytest.fixture()
+def backend():
+    """In-process echo server; yields (base_url, state dict, router)."""
+    state = {"fail": False, "requests": []}
+    r = Router()
+
+    def echo(req, w: ResponseWriter):
+        state["requests"].append(req)
+        if state["fail"]:
+            w.status = 500
+            w.write(b'{"error":"boom"}')
+            return
+        w.set_header("Content-Type", "application/json")
+        w.write(json.dumps({
+            "method": req.method, "path": req.path,
+            "q": {k: v for k, v in req.query.items()},
+            "auth": req.header("authorization"),
+            "apikey": req.header("x-api-key"),
+            "body": req.body.decode() if req.body else "",
+        }).encode())
+
+    for method in ("GET", "POST", "PUT", "PATCH", "DELETE"):
+        r.add(method, "/echo", echo)
+        r.add(method, "/api/items/{id}", echo)
+
+    def alive(req, w):
+        if state["fail"]:
+            w.status = 500
+            return
+        w.write(b'{"data":{"status":"UP"}}')
+
+    r.add("GET", "/.well-known/alive", alive)
+    r.add("GET", "/custom-health", alive)
+
+    srv = HTTPServer(r, 0, new_mock_logger())
+    srv.start()
+    yield f"http://127.0.0.1:{srv.port}", state, r
+    srv.stop()
+
+
+def test_verbs_params_and_body(backend):
+    url, state, _ = backend
+    svc = new_http_service(url, new_mock_logger())
+
+    got = svc.get("/echo", {"a": 1, "multi": [1, 2]}).json()
+    assert got["method"] == "GET"
+    assert got["q"]["a"] == ["1"] and got["q"]["multi"] == ["1", "2"]
+
+    got = svc.post("/echo", body={"x": 1}).json()
+    assert got["method"] == "POST" and json.loads(got["body"]) == {"x": 1}
+
+    assert svc.put("/echo", body=b"raw").json()["body"] == "raw"
+    assert svc.patch("/echo").json()["method"] == "PATCH"
+    assert svc.delete("/echo").json()["method"] == "DELETE"
+
+
+def test_non_2xx_is_response_not_exception(backend):
+    url, state, _ = backend
+    svc = new_http_service(url, new_mock_logger())
+    resp = svc.get("/does-not-exist")
+    assert resp.status_code == 404 and not resp.ok
+
+
+def test_metrics_recorded(backend):
+    url, _, _ = backend
+    from gofr_tpu.metrics import Manager, register_framework_metrics
+
+    m = Manager()
+    register_framework_metrics(m)
+    svc = new_http_service(url, new_mock_logger(), m)
+    svc.get("/echo")
+    text = m.render_prometheus()
+    assert 'app_http_service_response' in text
+    assert 'method="GET"' in text
+
+
+def test_basic_auth_decorator(backend):
+    url, _, _ = backend
+    svc = new_http_service(url, new_mock_logger(), None,
+                           BasicAuthOption("user", "pass"))
+    got = svc.get("/echo").json()
+    expect = base64.b64encode(b"user:pass").decode()
+    assert got["auth"] == f"Basic {expect}"
+
+
+def test_apikey_auth_decorator(backend):
+    url, _, _ = backend
+    svc = new_http_service(url, new_mock_logger(), None, APIKeyAuthOption("sekrit"))
+    assert svc.get("/echo").json()["apikey"] == "sekrit"
+
+
+def test_oauth_decorator_fetches_and_caches_token(backend):
+    url, _, _ = backend
+    calls = []
+
+    def fake_fetch():
+        calls.append(1)
+        return {"access_token": "tok123", "expires_in": 3600}
+
+    svc = new_http_service(url, new_mock_logger(), None,
+                           OAuthOption("http://unused/token", "id", "secret",
+                                       fetch=fake_fetch))
+    assert svc.get("/echo").json()["auth"] == "Bearer tok123"
+    assert svc.get("/echo").json()["auth"] == "Bearer tok123"
+    assert len(calls) == 1  # cached until expiry
+
+
+def test_custom_health_endpoint(backend):
+    url, _, _ = backend
+    svc = new_http_service(url, new_mock_logger(), None, HealthOption("/custom-health"))
+    assert svc.health_check().status == "UP"
+
+
+def test_decorators_compose(backend):
+    url, _, _ = backend
+    svc = new_http_service(
+        url, new_mock_logger(), None,
+        CircuitBreakerOption(threshold=3, interval=60, start_background_probe=False),
+        BasicAuthOption("u", "p"),
+        APIKeyAuthOption("k"),
+    )
+    got = svc.get("/echo").json()
+    assert got["auth"].startswith("Basic ") and got["apikey"] == "k"
+
+
+def test_user_supplied_header_wins_any_casing(backend):
+    url, _, _ = backend
+    svc = new_http_service(url, new_mock_logger(), None, BasicAuthOption("u", "p"))
+    got = svc.get_with_headers("/echo", headers={"authorization": "Bearer mine"}).json()
+    assert got["auth"] == "Bearer mine"
+
+
+def test_breaker_state_visible_through_outer_decorators(backend):
+    url, _, _ = backend
+    svc = new_http_service(
+        url, new_mock_logger(), None,
+        CircuitBreakerOption(threshold=1, interval=60, start_background_probe=False),
+        BasicAuthOption("u", "p"))
+    assert svc.is_open is False  # delegated through the auth wrapper
+
+
+def test_custom_health_repoints_breaker_probe(backend):
+    url, state, _ = backend
+    svc = new_http_service(
+        url, new_mock_logger(), None,
+        CircuitBreakerOption(threshold=1, interval=60, start_background_probe=False),
+        HealthOption("/custom-health"))
+    probed = svc.inner.health_probe()  # svc.inner is the breaker
+    assert probed.status == "UP"
+    state["fail"] = True
+    assert svc.inner.health_probe().status == "DOWN"
+
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_and_recovers(self, backend):
+        url, state, _ = backend
+        svc = new_http_service(
+            url, new_mock_logger(), None,
+            CircuitBreakerOption(threshold=3, interval=60,
+                                 start_background_probe=False))
+
+        state["fail"] = True
+        for _ in range(3):
+            assert svc.get("/echo").status_code == 500
+        assert svc.is_open
+        with pytest.raises(CircuitOpenError):
+            svc.get("/echo")
+
+        # inline recovery probe allowed after `interval` — force it by
+        # rewinding the opened-at clock, then a healthy backend closes it
+        state["fail"] = False
+        svc._opened_at = svc._last_probe = 0.0
+        assert svc.get("/echo").ok
+        assert not svc.is_open
+        # and failure count reset: three more failures needed to re-open
+        state["fail"] = True
+        assert svc.get("/echo").status_code == 500
+        assert not svc.is_open
+
+    def test_background_probe_closes_circuit(self, backend):
+        url, state, _ = backend
+        svc = new_http_service(
+            url, new_mock_logger(), None,
+            CircuitBreakerOption(threshold=1, interval=0.05))
+        state["fail"] = True
+        svc.get("/echo")
+        assert svc.is_open
+        state["fail"] = False
+        deadline = threading.Event()
+        for _ in range(100):
+            if not svc.is_open:
+                break
+            deadline.wait(0.05)
+        assert not svc.is_open
+        svc.close()
+
+    def test_connection_refused_counts_as_failure(self):
+        svc = new_http_service(
+            "http://127.0.0.1:1", new_mock_logger(), None,
+            CircuitBreakerOption(threshold=2, interval=60,
+                                 start_background_probe=False))
+        svc.inner.timeout = 0.2
+        for _ in range(2):
+            with pytest.raises(Exception):
+                svc.get("/x")
+        assert svc.is_open

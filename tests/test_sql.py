@@ -1,0 +1,104 @@
+"""SQL datasource tests against the hermetic sqlite dialect (the seam the
+reference fills with go-sqlmock, datasource/sql/db_test.go)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import pytest
+
+from gofr_tpu.datasource.sql import DB, new_sql, to_snake_case
+from gofr_tpu.metrics import Manager, register_framework_metrics
+from gofr_tpu.testutil import new_mock_config, new_mock_logger
+
+
+@pytest.fixture()
+def db():
+    cfg = new_mock_config({})
+    d = new_sql(cfg, new_mock_logger())
+    d.execute("CREATE TABLE users (id INTEGER PRIMARY KEY, full_name TEXT, age INTEGER)")
+    yield d
+    d.close()
+
+
+def test_execute_and_query(db):
+    assert db.execute("INSERT INTO users (full_name, age) VALUES (?, ?)", "ada", 36) == 1
+    db.execute("INSERT INTO users (full_name, age) VALUES (?, ?)", "alan", 41)
+    rows = db.query("SELECT * FROM users ORDER BY id")
+    assert [r["full_name"] for r in rows] == ["ada", "alan"]
+    row = db.query_row("SELECT age FROM users WHERE full_name = ?", "ada")
+    assert row == {"age": 36}
+    assert db.query_row("SELECT * FROM users WHERE id = 999") is None
+
+
+def test_select_into_dataclass_snake_case(db):
+    db.execute("INSERT INTO users (full_name, age) VALUES (?, ?)", "ada", 36)
+
+    @dataclasses.dataclass
+    class User:
+        fullName: str = ""   # matches column via snake_case fallback
+        age: int = 0
+
+    users = db.select(User, "SELECT full_name, age FROM users")
+    assert users == [User(fullName="ada", age=36)]
+
+    with pytest.raises(TypeError):
+        db.select(dict, "SELECT 1")
+
+
+def test_select_db_metadata_mapping(db):
+    db.execute("INSERT INTO users (full_name, age) VALUES (?, ?)", "g", 9)
+
+    @dataclasses.dataclass
+    class U:
+        name: str = dataclasses.field(default="", metadata={"db": "full_name"})
+
+    assert db.select(U, "SELECT full_name FROM users")[0].name == "g"
+
+
+def test_transaction_commit_and_rollback(db):
+    with db.begin() as tx:
+        tx.execute("INSERT INTO users (full_name) VALUES (?)", "kept")
+    assert db.query_row("SELECT COUNT(*) AS n FROM users")["n"] == 1
+
+    with pytest.raises(RuntimeError):
+        with db.begin() as tx:
+            tx.execute("INSERT INTO users (full_name) VALUES (?)", "dropped")
+            raise RuntimeError("boom")
+    assert db.query_row("SELECT COUNT(*) AS n FROM users")["n"] == 1
+
+
+def test_metrics_and_health(db):
+    m = Manager()
+    register_framework_metrics(m)
+    db.metrics = m
+    db.query("SELECT 1")
+    assert "app_sql_stats" in m.render_prometheus()
+
+    h = db.health_check()
+    assert h.status == "UP"
+    assert h.details["dialect"] == "sqlite"
+
+    db.close()
+    assert db.health_check().status == "DOWN"
+
+
+def test_to_snake_case():
+    assert to_snake_case("FullName") == "full_name"
+    assert to_snake_case("userID") == "user_id"
+    assert to_snake_case("already_snake") == "already_snake"
+
+
+def test_container_wires_sql():
+    from gofr_tpu.container import Container
+
+    c = Container(new_mock_config({"DB_DIALECT": "sqlite", "DB_NAME": ":memory:"}))
+    assert c.sql is not None
+    c.sql.execute("CREATE TABLE t (x INTEGER)")
+    assert c.health()["sql"]["status"] == "UP"
+    c.close()
+
+
+def test_unsupported_dialect():
+    with pytest.raises(ValueError):
+        new_sql(new_mock_config({"DB_DIALECT": "oracle"}))
