@@ -191,6 +191,25 @@ def prefill(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     return _logits(params, cfg, x), KVCache(k_full, v_full, lengths)
 
 
+def prefill_kv(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
+               lengths: jnp.ndarray | None = None, rope_max: int | None = None,
+               rope_tables=None):
+    """Causal forward returning the raw KV stacks instead of a filled cache.
+
+    The continuous-batching serving engine prefills ONE sequence at a time
+    and writes its KV into a single slot of a shared [L, B, Smax, KV, hd]
+    cache; handing back (k_stack, v_stack) [L, B, S, KV, hd] lets it
+    ``dynamic_update_slice`` into that slot without allocating a throwaway
+    full-capacity cache per admission.
+
+    Returns (logits [B, S, V] f32, k_stack, v_stack, lengths [B]).
+    """
+    x, (k_stack, v_stack), lengths = _causal_scan(
+        params, cfg, tokens, lengths, rope_max or tokens.shape[1],
+        rope_tables, constrain=None, collect_kv=True)
+    return _logits(params, cfg, x), k_stack, v_stack, lengths
+
+
 def _cache_write_at(cache_layer: jnp.ndarray, new: jnp.ndarray,
                     lengths: jnp.ndarray) -> jnp.ndarray:
     """Write new [B, 1, KV, hd] at per-slot positions ``lengths`` into

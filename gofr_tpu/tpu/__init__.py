@@ -1,0 +1,153 @@
+"""TPU datasource: engine, continuous batching, checkpoint loading.
+
+Wired into the container the way Redis/SQL are in the reference
+(pkg/gofr/container/container.go:55-126 builds each datasource from config
+with graceful degradation): ``new_engine_from_config`` reads ``TPU_*``
+config keys, builds the engine, registers the model family's programs, and
+hands back a health-checkable datasource reachable as ``ctx.tpu``.
+
+Config keys (reference config style, pkg/gofr/config/config.go:3):
+  TPU_MODEL           model name: llama family (llama3-8b, llama-1b, tiny),
+                      bert family (bert/bert-base, bert-tiny), or
+                      vit family (vit/vit-l-14, vit-tiny)
+  TPU_WEIGHTS         checkpoint path (.npz or orbax dir); absent = random
+                      init (smoke/serving-bringup mode)
+  TPU_QUANT           "int8" to quantize projection weights on load
+  TPU_SLOTS           decode batch slots for generation (default 8)
+  TPU_MAX_SEQ         serving KV capacity (default min(model max, 2048))
+  TPU_BATCH_BUCKETS   csv of predict batch buckets (default 1,2,4,8)
+  TPU_SEQ_BUCKETS     csv of token-length buckets  (default 32..512)
+  TPU_MAX_BATCH_DELAY coalescing window in seconds (default 0.004)
+  TPU_SHARDING        "tp=8" / "tp=4,dp=2" mesh axes for sharded serving
+                      (axes from gofr_tpu.parallel; weights get
+                      NamedShardings, XLA inserts the ICI collectives)
+  TPU_WARMUP          "true" to precompile all buckets at startup
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .batcher import BatcherClosed, CoalescingBatcher, pad_bucket
+from .checkpoint import (load_npz, load_orbax, load_params, maybe_quantize,
+                         placed, save_npz, save_orbax)
+from .engine import DEFAULT_BATCH_BUCKETS, DEFAULT_SEQ_BUCKETS, Program, TPUEngine
+from .generator import GenerationEngine, GenerationError, GenStream
+
+__all__ = [
+    "BatcherClosed", "CoalescingBatcher", "pad_bucket",
+    "load_npz", "load_orbax", "load_params", "maybe_quantize", "placed",
+    "save_npz", "save_orbax",
+    "DEFAULT_BATCH_BUCKETS", "DEFAULT_SEQ_BUCKETS", "Program", "TPUEngine",
+    "GenerationEngine", "GenerationError", "GenStream",
+    "new_engine_from_config",
+]
+
+
+def _csv_ints(val: str | None, default: tuple[int, ...]) -> tuple[int, ...]:
+    if not val:
+        return default
+    return tuple(int(x) for x in val.split(",") if x.strip())
+
+
+def _parse_mesh(spec: str | None):
+    """"tp=8" / "tp=4,dp=2" -> Mesh over the named parallel axes."""
+    if not spec:
+        return None
+    from ..parallel import make_mesh
+
+    axes = {}
+    for part in spec.split(","):
+        k, _, v = part.partition("=")
+        axes[k.strip()] = int(v)
+    return make_mesh(**axes)
+
+
+def new_engine_from_config(cfg, logger=None, metrics=None) -> TPUEngine:
+    from ..models import BERT_CONFIGS, LLAMA_CONFIGS, VIT_CONFIGS
+
+    name = (cfg.get("TPU_MODEL") or "tiny").strip()
+    mesh = _parse_mesh(cfg.get("TPU_SHARDING"))
+    max_delay = cfg.get_float("TPU_MAX_BATCH_DELAY", 0.004)
+    batch_buckets = _csv_ints(cfg.get("TPU_BATCH_BUCKETS"), DEFAULT_BATCH_BUCKETS)
+    seq_buckets = _csv_ints(cfg.get("TPU_SEQ_BUCKETS"), DEFAULT_SEQ_BUCKETS)
+
+    engine = TPUEngine(logger=logger, metrics=metrics, max_delay=max_delay,
+                       mesh=mesh, model_name=name)
+
+    weights = cfg.get("TPU_WEIGHTS")
+    quant = (cfg.get("TPU_QUANT") or "").lower() == "int8"
+
+    def params_for(model_cfg, init_fn):
+        if weights:
+            params = load_params(weights)
+        else:
+            params = init_fn(model_cfg, jax.random.PRNGKey(0))
+        return placed(maybe_quantize(params, quant), mesh)
+
+    if name.startswith("bert"):
+        from ..models import bert
+
+        key = {"bert": "bert-base", "bert-tiny": "tiny"}.get(name, name)
+        mc = BERT_CONFIGS[key]
+        params = params_for(mc, bert.init)
+        seq_b = tuple(b for b in seq_buckets if b <= mc.max_seq) or (mc.max_seq,)
+
+        def embed_fn(p, tokens, lengths):
+            mask = jnp.arange(tokens.shape[1])[None, :] < lengths[:, None]
+            return bert.embed(p, mc, tokens, mask)
+
+        engine.register("embed", embed_fn, params, kind="tokens",
+                        batch_buckets=batch_buckets, seq_buckets=seq_b)
+    elif name.startswith("vit"):
+        from ..models import vit
+
+        key = {"vit": "vit-l-14", "vit-l14": "vit-l-14", "vit-tiny": "tiny"}.get(name, name)
+        mc = VIT_CONFIGS[key]
+        params = params_for(mc, vit.init)
+
+        def classify_fn(p, images):
+            return jax.nn.softmax(vit.forward(p, mc, images), axis=-1)
+
+        import numpy as np
+
+        engine.register("classify", classify_fn, params, kind="fixed",
+                        batch_buckets=batch_buckets,
+                        example_item=np.zeros(
+                            (mc.image_size, mc.image_size, 3), np.float32))
+    else:
+        from ..models import llama
+
+        mc = LLAMA_CONFIGS.get(name)
+        if mc is None:
+            raise KeyError(f"unknown TPU_MODEL {name!r}; known: "
+                           f"{sorted(LLAMA_CONFIGS) + sorted(BERT_CONFIGS) + sorted(VIT_CONFIGS)}")
+        params = params_for(mc, llama.init)
+        max_seq = cfg.get_int("TPU_MAX_SEQ", min(mc.max_seq, 2048))
+        slots = cfg.get_int("TPU_SLOTS", 8)
+        prompt_b = tuple(b for b in seq_buckets if b < max_seq) or (max_seq // 2,)
+        engine.generator = GenerationEngine(
+            mc, params, slots=slots, max_seq=max_seq, prompt_buckets=prompt_b,
+            logger=logger, metrics=metrics)
+
+        # scoring program: next-token logits at the prompt end (the
+        # non-streaming sibling of generate, e.g. for classification heads)
+        def score_fn(p, tokens, lengths):
+            logits = llama.forward(p, mc, tokens, lengths)
+            idx = jnp.maximum(lengths - 1, 0)
+            return jnp.take_along_axis(
+                logits, idx[:, None, None], axis=1)[:, 0]
+
+        seq_b = tuple(b for b in seq_buckets if b <= max_seq) or (max_seq,)
+        engine.register("score", score_fn, params, kind="tokens",
+                        batch_buckets=batch_buckets, seq_buckets=seq_b)
+
+    if cfg.get_bool("TPU_WARMUP"):
+        engine.warmup()
+    if logger is not None:
+        logger.info({"event": "tpu engine ready", "model": name,
+                     "platform": engine.platform, "devices": len(engine.devices),
+                     "quant": "int8" if quant else "none",
+                     "sharding": cfg.get("TPU_SHARDING") or "single"})
+    return engine

@@ -1,0 +1,127 @@
+"""Model weight loading/versioning — the framework's "checkpoint" story.
+
+The reference's closest analogue is the migration version ledger
+(pkg/gofr/migration/sql.go:142-158); for a serving framework the durable
+state is model weights. Two formats:
+
+  - Orbax checkpoint directory (the JAX-ecosystem standard; what training
+    jobs emit). Restored leaf-by-leaf onto the host then placed.
+  - ``.npz`` flat file with ``/``-joined pytree paths (cheap interchange:
+    ``save_npz``/``load_npz`` round-trip any param tree, including int8
+    ``QuantizedLinear`` leaves, without a schema).
+
+Quantize-on-load: serving wants int8 projections (decode is HBM-bound);
+checkpoints are usually bf16. ``maybe_quantize`` converts the known
+projection leaves at load time so the bf16 copy never reaches the device.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.quant import QuantizedLinear, quantize_int8
+
+# Llama projection leaves worth int8-quantizing (stacked [L, in, out]).
+_QUANT_LEAVES = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head"}
+
+
+def _flatten(tree: Any, prefix: str = "") -> dict[str, np.ndarray]:
+    out: dict[str, np.ndarray] = {}
+    if isinstance(tree, QuantizedLinear):
+        out[prefix + "/__qw"] = np.asarray(tree.w)
+        out[prefix + "/__qscale"] = np.asarray(tree.scale)
+    elif isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}/{k}" if prefix else k))
+    else:
+        out[prefix] = np.asarray(tree)
+    return out
+
+
+def _unflatten(flat: dict[str, np.ndarray]) -> Any:
+    tree: dict = {}
+    quant: dict[str, dict] = {}
+    for path, arr in flat.items():
+        parts = path.split("/")
+        if parts[-1] in ("__qw", "__qscale"):
+            q = quant.setdefault("/".join(parts[:-1]), {})
+            q["w" if parts[-1] == "__qw" else "scale"] = arr
+            continue
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    for path, q in quant.items():
+        parts = path.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = QuantizedLinear(w=q["w"], scale=q["scale"])
+    return tree
+
+
+def save_npz(path: str, params: Any) -> None:
+    np.savez(path, **_flatten(params))
+
+
+def load_npz(path: str) -> Any:
+    with np.load(path) as f:
+        return _unflatten({k: f[k] for k in f.files})
+
+
+def save_orbax(path: str, params: Any) -> None:
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), params)
+
+
+def load_orbax(path: str) -> Any:
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(os.path.abspath(path))
+
+
+def load_params(path: str) -> Any:
+    """Dispatch on layout: .npz file or orbax directory."""
+    if path.endswith(".npz"):
+        return load_npz(path)
+    if os.path.isdir(path):
+        return load_orbax(path)
+    raise FileNotFoundError(f"no checkpoint at {path!r} (expected .npz file "
+                            "or orbax directory)")
+
+
+def maybe_quantize(params: Any, enabled: bool) -> Any:
+    """Int8-quantize known projection leaves of a llama param tree."""
+    if not enabled:
+        return params
+
+    def walk(node: Any, name: str = "") -> Any:
+        if isinstance(node, dict):
+            return {k: walk(v, k) for k, v in node.items()}
+        if (name in _QUANT_LEAVES and not isinstance(node, QuantizedLinear)
+                and getattr(node, "ndim", 0) in (2, 3)):
+            w = jnp.asarray(node)
+            # stacked layers: quantize per (layer, out-channel)
+            axis = w.ndim - 2
+            return quantize_int8(w, axis=axis)
+        return node
+
+    return walk(params)
+
+
+def placed(params: Any, mesh=None) -> Any:
+    """Move a host param tree onto device — sharded over ``mesh`` when
+    given (specs from parallel.param_specs), else default placement."""
+    if mesh is not None:
+        from ..parallel import shard_params
+
+        return shard_params(params, mesh)
+    return jax.device_put(params)

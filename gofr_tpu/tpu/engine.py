@@ -1,0 +1,300 @@
+"""TPU inference engine: the framework's flagship datasource.
+
+No reference equivalent (SURVEY §2 last rows): GoFr's container carries
+Redis/SQL/PubSub clients (pkg/gofr/container/container.go:26-38); here the
+accelerator is wired the same way — constructed from config with graceful
+degradation, health-checked into ``/.well-known/health``, observable through
+``app_tpu_*`` metrics, reachable from handlers as ``ctx.tpu``.
+
+TPU-first design:
+  - Programs are jitted callables compiled AOT per (batch, seq) BUCKET.
+    XLA traces once per static shape; serving arbitrary request shapes
+    means padding to a small lattice of precompiled shapes, never
+    recompiling on the hot path.
+  - A single dispatcher (``CoalescingBatcher``) coalesces concurrent
+    handler threads into one device dispatch, so MXU utilization scales
+    with offered load.
+  - Results transfer device->host once per batch (one ``jax.device_get``),
+    and inputs are stacked host-side then transferred once.
+  - Weights live on device permanently (params are device arrays, possibly
+    sharded over a mesh by the config wiring; the engine is layout-agnostic).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..datasource import Health, STATUS_DEGRADED, STATUS_DOWN, STATUS_UP
+from .batcher import CoalescingBatcher, pad_bucket
+
+DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8)
+DEFAULT_SEQ_BUCKETS = (32, 64, 128, 256, 512)
+
+
+def _round_up(n: int, buckets: Sequence[int]) -> int:
+    return pad_bucket(n, buckets)
+
+
+@dataclass
+class Program:
+    """One servable compiled function.
+
+    kind="tokens": items are 1-D int32 token arrays of varying length;
+      the runner pads to (Bb, Sb) buckets and calls
+      ``fn(params, tokens[B,S], lengths[B])``.
+    kind="fixed": items are pytrees of fixed-shape arrays; the runner
+      stacks them on a new leading axis and calls ``fn(params, batch)``.
+
+    ``fn`` must return an array (or pytree) with leading batch axis.
+    """
+
+    name: str
+    fn: Callable
+    params: Any
+    kind: str = "tokens"
+    batch_buckets: tuple[int, ...] = DEFAULT_BATCH_BUCKETS
+    seq_buckets: tuple[int, ...] = DEFAULT_SEQ_BUCKETS
+    example_item: Any = None  # fixed-kind: per-item input struct for warmup
+    _jitted: Callable = field(init=False, default=None)
+    _compiled_shapes: set = field(init=False, default_factory=set)
+
+    def __post_init__(self):
+        self.batch_buckets = tuple(sorted(self.batch_buckets))
+        self.seq_buckets = tuple(sorted(self.seq_buckets))
+        self._jitted = jax.jit(self.fn)
+
+    @property
+    def max_batch(self) -> int:
+        return self.batch_buckets[-1]
+
+
+class TPUEngine:
+    """Registry of compiled programs + coalescing dispatch + health.
+
+    Thread-safe: any number of handler threads may call ``predict``
+    concurrently; per-program batchers serialize device dispatch.
+    """
+
+    def __init__(self, logger=None, metrics=None, max_delay: float = 0.004,
+                 mesh=None, model_name: str = ""):
+        self.logger = logger
+        self.metrics = metrics
+        self.max_delay = max_delay
+        self.mesh = mesh
+        self.model_name = model_name
+        self.devices = jax.devices()
+        self.platform = self.devices[0].platform
+        self.device_kind = self.devices[0].device_kind
+        self._programs: dict[str, Program] = {}
+        self._batchers: dict[str, CoalescingBatcher] = {}
+        self._lock = threading.Lock()
+        self.generator = None  # set by config wiring for decoder models
+        self._closed = False
+        if metrics is not None:
+            try:
+                metrics.set_gauge("app_tpu_devices", len(self.devices))
+            except Exception:
+                pass
+
+    # -- registration --------------------------------------------------------
+    def register(self, name: str, fn: Callable, params: Any, *,
+                 kind: str = "tokens",
+                 batch_buckets: Sequence[int] = DEFAULT_BATCH_BUCKETS,
+                 seq_buckets: Sequence[int] = DEFAULT_SEQ_BUCKETS,
+                 example_item: Any = None) -> Program:
+        prog = Program(name=name, fn=fn, params=params, kind=kind,
+                       batch_buckets=tuple(batch_buckets),
+                       seq_buckets=tuple(seq_buckets),
+                       example_item=example_item)
+        with self._lock:
+            self._programs[name] = prog
+            self._batchers[name] = CoalescingBatcher(
+                runner=lambda items, p=prog: self._run_batch(p, items),
+                max_batch=prog.max_batch, max_delay=self.max_delay,
+                name=f"tpu-{name}", on_dispatch=self._dispatch_metrics(prog))
+        if self.logger is not None:
+            self.logger.info({"event": "tpu program registered", "program": name,
+                              "kind": kind, "batch_buckets": list(prog.batch_buckets)})
+        return prog
+
+    def _dispatch_metrics(self, prog: Program):
+        def hook(batch_size: int, oldest_wait: float) -> None:
+            if self.metrics is None:
+                return
+            bucket = _round_up(batch_size, prog.batch_buckets)
+            self.metrics.record_histogram("app_tpu_batch_wait_duration",
+                                          oldest_wait, program=prog.name)
+            self.metrics.set_gauge("app_tpu_batch_fill", batch_size / bucket,
+                                   program=prog.name)
+        return hook
+
+    # -- the batched device dispatch ----------------------------------------
+    def _run_batch(self, prog: Program, items: list) -> list:
+        t0 = time.monotonic()
+        if prog.kind == "tokens":
+            out = self._run_tokens(prog, items)
+        else:
+            out = self._run_fixed(prog, items)
+        if self.metrics is not None:
+            self.metrics.record_histogram("app_tpu_device_execute_duration",
+                                          time.monotonic() - t0, program=prog.name)
+        return out
+
+    def _run_tokens(self, prog: Program, items: list) -> list:
+        lengths = [int(np.asarray(it).shape[0]) for it in items]
+        Sb = _round_up(max(lengths), prog.seq_buckets)
+        Bb = _round_up(len(items), prog.batch_buckets)
+        tokens = np.zeros((Bb, Sb), np.int32)
+        for i, it in enumerate(items):
+            tokens[i, : lengths[i]] = np.asarray(it, np.int32)
+        lens = np.zeros((Bb,), np.int32)
+        lens[: len(items)] = lengths
+        self._note_shape(prog, (Bb, Sb))
+        out = prog._jitted(prog.params, jnp.asarray(tokens), jnp.asarray(lens))
+        out = jax.device_get(out)
+        return [jax.tree.map(lambda a: a[i], out) for i in range(len(items))]
+
+    def _run_fixed(self, prog: Program, items: list) -> list:
+        Bb = _round_up(len(items), prog.batch_buckets)
+        pad = [items[-1]] * (Bb - len(items))
+        batch = jax.tree.map(lambda *xs: np.stack(xs), *(list(items) + pad))
+        self._note_shape(prog, (Bb,))
+        out = prog._jitted(prog.params, batch)
+        out = jax.device_get(out)
+        return [jax.tree.map(lambda a: a[i], out) for i in range(len(items))]
+
+    def _note_shape(self, prog: Program, shape: tuple) -> None:
+        if shape not in prog._compiled_shapes:
+            prog._compiled_shapes.add(shape)
+            if self.logger is not None:
+                self.logger.debug({"event": "tpu compile", "program": prog.name,
+                                   "shape": list(shape)})
+
+    # -- public API (ctx.tpu.predict) ---------------------------------------
+    def predict(self, program: str, item: Any, timeout: float | None = 60.0) -> Any:
+        """Run one item through a registered program, coalescing with any
+        concurrent callers. Returns the un-batched result (numpy)."""
+        if self._closed:
+            raise RuntimeError("TPU engine is closed")
+        batcher = self._batchers.get(program)
+        if batcher is None:
+            raise KeyError(f"no TPU program {program!r}; registered: "
+                           f"{sorted(self._programs)}")
+        self._validate_item(self._programs[program], item)
+        t0 = time.monotonic()
+        try:
+            return batcher.submit(item, timeout=timeout)
+        finally:
+            if self.metrics is not None:
+                self.metrics.increment_counter("app_tpu_requests_total",
+                                               program=program)
+                self.metrics.record_histogram("app_tpu_predict_duration",
+                                              time.monotonic() - t0,
+                                              program=program)
+
+    def predict_batch(self, program: str, items: list) -> list:
+        """Direct batched execution, bypassing the coalescing queue (for
+        subscribers that already hold a natural batch)."""
+        prog = self._programs.get(program)
+        if prog is None:
+            raise KeyError(f"no TPU program {program!r}")
+        for it in items:
+            self._validate_item(prog, it)
+        out = []
+        for i in range(0, len(items), prog.max_batch):
+            out.extend(self._run_batch(prog, items[i : i + prog.max_batch]))
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_tpu_requests_total",
+                                           program=program)
+        return out
+
+    def _validate_item(self, prog: Program, item: Any) -> None:
+        """Reject oversized inputs BEFORE they join a coalesced batch — a
+        bad item inside the runner would fail every innocent request
+        dispatched with it."""
+        if prog.kind == "tokens":
+            n = int(np.asarray(item).shape[0])
+            limit = prog.seq_buckets[-1]
+            if n == 0 or n > limit:
+                raise ValueError(
+                    f"program {prog.name!r}: item length {n} outside (0, {limit}]")
+
+    def generate(self, *args, **kw):
+        """Streaming token generation (decoder models). See
+        ``generator.GenerationEngine.generate``."""
+        if self.generator is None:
+            raise RuntimeError("no decoder model configured (TPU_MODEL must "
+                               "be a llama-family model for generate)")
+        return self.generator.generate(*args, **kw)
+
+    # -- warmup (compile-cache priming; BASELINE TTFT target needs this) -----
+    def warmup(self, program: str | None = None) -> None:
+        names = [program] if program else list(self._programs)
+        for name in names:
+            prog = self._programs[name]
+            if prog.kind == "tokens":
+                for Bb in prog.batch_buckets:
+                    for Sb in prog.seq_buckets:
+                        toks = jnp.zeros((Bb, Sb), jnp.int32)
+                        lens = jnp.full((Bb,), Sb, jnp.int32)
+                        jax.block_until_ready(prog._jitted(prog.params, toks, lens))
+                        self._note_shape(prog, (Bb, Sb))
+            elif prog.example_item is not None:
+                for Bb in prog.batch_buckets:
+                    batch = jax.tree.map(
+                        lambda a: jnp.broadcast_to(jnp.asarray(a)[None], (Bb,) + np.shape(a)),
+                        prog.example_item)
+                    jax.block_until_ready(prog._jitted(prog.params, batch))
+                    self._note_shape(prog, (Bb,))
+            elif self.logger is not None:
+                self.logger.warn({"event": "tpu warmup skipped",
+                                  "program": name,
+                                  "reason": "fixed-kind program registered "
+                                            "without example_item"})
+        if self.generator is not None:
+            self.generator.warmup()
+
+    # -- health (reference container/health.go:5-25 shape) -------------------
+    def health_check(self) -> Health:
+        details: dict[str, Any] = {
+            "platform": self.platform,
+            "device_kind": self.device_kind,
+            "devices": len(self.devices),
+            "model": self.model_name,
+            "programs": {
+                n: {"kind": p.kind,
+                    "batch_buckets": list(p.batch_buckets),
+                    "compiled_shapes": sorted(map(list, p._compiled_shapes))}
+                for n, p in self._programs.items()
+            },
+        }
+        if self.mesh is not None:
+            details["mesh"] = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        try:
+            stats = self.devices[0].memory_stats()
+            if stats:
+                details["hbm_bytes_in_use"] = stats.get("bytes_in_use")
+                details["hbm_bytes_limit"] = stats.get("bytes_limit")
+        except Exception:
+            pass
+        if self.generator is not None:
+            details["generator"] = self.generator.stats()
+        if self._closed:
+            return Health(STATUS_DOWN, details)
+        # A live engine with no programs can't serve yet.
+        status = STATUS_UP if (self._programs or self.generator) else STATUS_DEGRADED
+        return Health(status, details)
+
+    def close(self) -> None:
+        self._closed = True
+        for b in self._batchers.values():
+            b.close(drain=False)
+        if self.generator is not None:
+            self.generator.close()

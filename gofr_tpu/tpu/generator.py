@@ -1,0 +1,357 @@
+"""Continuous-batching token generation: the streaming-decode serving loop.
+
+No reference equivalent (SURVEY §5 "checkpoint/resume": the reference is a
+stateless microservice framework; token streaming is the BASELINE.json
+Llama target). Design:
+
+  - A FIXED pool of B batch slots shares one preallocated KV cache
+    [L, B, Smax, KV, hd]. Slots are admitted/retired independently via a
+    per-slot ``lengths`` cursor — XLA shapes never change, so the decode
+    step compiles exactly once.
+  - ADMISSION runs a per-sequence prefill jitted at a small lattice of
+    prompt buckets, writing KV straight into the slot with
+    ``dynamic_update_slice`` (slot index is traced — no per-slot
+    recompile) and emitting the first token, so TTFT = one prefill
+    dispatch, never waiting for a decode round.
+  - DECODE is one jitted step over all B slots per iteration — inactive
+    slots compute but their cursors are frozen, so occupancy only affects
+    useful-token throughput, never shape or compile state.
+  - The KV cache is DONATED through both jits: the cache buffer is
+    updated in place in HBM, zero copies per token.
+  - Sampling (greedy + temperature) is fused into the jitted step; the
+    host sees only B int32s per iteration.
+
+Consumers call ``generate()`` from any thread and read tokens off a
+stream; one background thread owns the device loop.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from typing import Any, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import llama
+from ..models.common import ModelConfig
+from .batcher import pad_bucket
+
+_REQ_IDS = itertools.count(1)
+
+
+class GenerationError(RuntimeError):
+    pass
+
+
+class GenStream:
+    """Iterator over generated token ids; ``cancel()`` releases the slot."""
+
+    def __init__(self, request_id: int, engine: "GenerationEngine"):
+        self.request_id = request_id
+        self._engine = engine
+        self._q: queue.Queue = queue.Queue()
+        self.cancelled = threading.Event()
+        self.prompt_len = 0
+
+    def __iter__(self) -> Iterator[int]:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    def tokens(self) -> list[int]:
+        """Drain the whole stream (blocking) into a list."""
+        return list(self)
+
+    def cancel(self) -> None:
+        self.cancelled.set()
+
+
+class _Request:
+    __slots__ = ("stream", "prompt", "max_new", "temperature", "eos_id",
+                 "enqueued_at")
+
+    def __init__(self, stream: GenStream, prompt: np.ndarray, max_new: int,
+                 temperature: float, eos_id: int | None):
+        self.stream = stream
+        self.prompt = prompt
+        self.max_new = max_new
+        self.temperature = temperature
+        self.eos_id = eos_id
+        self.enqueued_at = time.monotonic()
+
+
+class _Slot:
+    __slots__ = ("request", "remaining", "generated")
+
+    def __init__(self):
+        self.request: _Request | None = None
+        self.remaining = 0
+        self.generated = 0
+
+    @property
+    def free(self) -> bool:
+        return self.request is None
+
+
+class GenerationEngine:
+    def __init__(self, cfg: ModelConfig, params: Any, *, slots: int = 8,
+                 max_seq: int | None = None,
+                 prompt_buckets: tuple[int, ...] = (32, 64, 128, 256, 512),
+                 logger=None, metrics=None, seed: int = 0):
+        self.cfg = cfg
+        self.params = params
+        self.n_slots = slots
+        self.max_seq = min(max_seq or cfg.max_seq, cfg.max_seq)
+        self.prompt_buckets = tuple(sorted(b for b in prompt_buckets
+                                           if b <= self.max_seq)) or (self.max_seq,)
+        self.logger = logger
+        self.metrics = metrics
+        self.rope_tables = llama.get_rope_tables(cfg, self.max_seq)
+
+        self.cache = llama.init_cache(cfg, slots, self.max_seq)
+        self._slots = [_Slot() for _ in range(slots)]
+        self._last_tokens = np.zeros((slots,), np.int32)
+        self._active = np.zeros((slots,), bool)
+        self._temps = np.zeros((slots,), np.float32)
+        self._key = jax.random.PRNGKey(seed)
+
+        self._pending: queue.Queue[_Request] = queue.Queue()
+        self._work = threading.Event()
+        # serializes device-state mutation (the loop thread vs warmup/close)
+        self._device_lock = threading.Lock()
+        # guards the _closed check-then-enqueue in generate() against close()
+        self._admission_lock = threading.Lock()
+        self._closed = False
+        self.total_tokens = 0
+        self.total_requests = 0
+
+        self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(0,))
+        self._step_jit = jax.jit(self._step_fn, donate_argnums=(0,))
+        self._thread = threading.Thread(target=self._loop, name="gofr-tpu-gen",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- jitted device functions --------------------------------------------
+    def _sample(self, logits, temps, key):
+        """Greedy where temp==0, categorical(logits/temp) otherwise — fused
+        per-slot so mixed-sampling batches stay one program."""
+        B = logits.shape[0]
+        keys = jax.random.split(key, B)
+        safe_t = jnp.maximum(temps, 1e-6)[:, None]
+        sampled = jax.vmap(jax.random.categorical)(keys, logits / safe_t)
+        greedy = jnp.argmax(logits, axis=-1)
+        return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+    def _prefill_fn(self, cache, params, tokens, length, slot, temp, key):
+        """tokens [1, Sb] (padded), length/slot scalars. Writes the slot's
+        KV, sets its cursor, returns (first_token scalar, cache)."""
+        logits, k, v, _ = llama.prefill_kv(
+            params, self.cfg, tokens, jnp.asarray([length]),
+            rope_max=self.max_seq, rope_tables=self.rope_tables)
+        k_new = jax.lax.dynamic_update_slice(
+            cache.k, k.astype(cache.k.dtype), (0, slot, 0, 0, 0))
+        v_new = jax.lax.dynamic_update_slice(
+            cache.v, v.astype(cache.v.dtype), (0, slot, 0, 0, 0))
+        lengths = cache.lengths.at[slot].set(length)
+        last = jnp.take(logits[0], length - 1, axis=0)  # [V] at the true end
+        tok = self._sample(last[None, :], temp[None], key)[0]
+        return tok, llama.KVCache(k_new, v_new, lengths)
+
+    def _step_fn(self, cache, params, last_tokens, active, temps, key):
+        """One decode step over all slots; inactive cursors frozen."""
+        logits, stepped = llama.decode_step(params, self.cfg, last_tokens,
+                                            cache, rope_tables=self.rope_tables)
+        lengths = jnp.where(active, stepped.lengths, cache.lengths)
+        toks = self._sample(logits, temps, key)
+        return toks, llama.KVCache(stepped.k, stepped.v, lengths)
+
+    # -- public API ----------------------------------------------------------
+    def generate(self, prompt, max_new_tokens: int = 128,
+                 temperature: float = 0.0, eos_id: int | None = None) -> GenStream:
+        """Enqueue a prompt (sequence of token ids); returns a GenStream
+        yielding generated ids as the device produces them."""
+        if self._closed:
+            raise GenerationError("generation engine is closed")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        stream = GenStream(next(_REQ_IDS), self)
+        stream.prompt_len = len(prompt)
+        if len(prompt) == 0:
+            stream._q.put(GenerationError("empty prompt"))
+            stream._q.put(None)
+            return stream
+        limit = min(self.prompt_buckets[-1], self.max_seq - 1)
+        if len(prompt) > limit:
+            stream._q.put(GenerationError(
+                f"prompt length {len(prompt)} exceeds serving limit {limit}"))
+            stream._q.put(None)
+            return stream
+        with self._admission_lock:
+            if self._closed:
+                raise GenerationError("generation engine is closed")
+            self._pending.put(_Request(stream, prompt, max_new_tokens,
+                                       temperature, eos_id))
+        self._work.set()
+        return stream
+
+    def stats(self) -> dict:
+        return {
+            "slots": self.n_slots,
+            "active": int(self._active.sum()),
+            "queued": self._pending.qsize(),
+            "max_seq": self.max_seq,
+            "prompt_buckets": list(self.prompt_buckets),
+            "total_requests": self.total_requests,
+            "total_tokens": self.total_tokens,
+        }
+
+    def warmup(self) -> None:
+        """Prime every compiled shape (prefill per bucket + the step).
+
+        Safe while serving: the device lock excludes the loop thread for
+        the duration (both jits donate the cache buffer), and the cursor
+        snapshot restores any active slots' state afterwards."""
+        with self._device_lock:
+            cursors = np.asarray(jax.device_get(self.cache.lengths))
+            for b in self.prompt_buckets:
+                toks = jnp.zeros((1, b), jnp.int32)
+                _, self.cache = jax.block_until_ready(self._prefill_jit(
+                    self.cache, self.params, toks, jnp.int32(1), jnp.int32(0),
+                    jnp.float32(0.0), self._key))
+            _, self.cache = jax.block_until_ready(self._step_jit(
+                self.cache, self.params, jnp.asarray(self._last_tokens),
+                jnp.zeros((self.n_slots,), bool), jnp.asarray(self._temps),
+                self._key))
+            # restore cursors dirtied by the dummy dispatches
+            self.cache = self.cache._replace(lengths=jnp.asarray(cursors))
+
+    def close(self) -> None:
+        with self._admission_lock:
+            self._closed = True
+        self._work.set()
+        self._thread.join(timeout=10.0)
+        for slot in self._slots:
+            if slot.request is not None:
+                slot.request.stream._q.put(GenerationError("engine closed"))
+                slot.request.stream._q.put(None)
+                slot.request = None
+        while True:
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            req.stream._q.put(GenerationError("engine closed"))
+            req.stream._q.put(None)
+
+    # -- the serving loop ----------------------------------------------------
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _admit(self) -> None:
+        for idx, slot in enumerate(self._slots):
+            if not slot.free:
+                continue
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                return
+            if req.stream.cancelled.is_set():
+                req.stream._q.put(None)
+                continue
+            self._start(idx, slot, req)
+
+    def _start(self, idx: int, slot: _Slot, req: _Request) -> None:
+        L = len(req.prompt)
+        Sb = pad_bucket(L, self.prompt_buckets)
+        padded = np.zeros((1, Sb), np.int32)
+        padded[0, :L] = req.prompt
+        t0 = time.monotonic()
+        tok, self.cache = self._prefill_jit(
+            self.cache, self.params, jnp.asarray(padded), jnp.int32(L),
+            jnp.int32(idx), jnp.float32(req.temperature), self._next_key())
+        first = int(tok)
+        if self.metrics is not None:
+            self.metrics.record_histogram("app_tpu_batch_wait_duration",
+                                          t0 - req.enqueued_at, program="generate")
+        slot.request = req
+        slot.generated = 0
+        slot.remaining = req.max_new
+        self.total_requests += 1
+        self._temps[idx] = req.temperature
+        self._deliver(idx, slot, first)
+        if slot.request is not None:  # not finished by the first token
+            self._last_tokens[idx] = first
+            self._active[idx] = True
+
+    def _deliver(self, idx: int, slot: _Slot, token: int) -> None:
+        """Push one token to the consumer; retire the slot when finished."""
+        req = slot.request
+        if req.stream.cancelled.is_set():
+            self._retire(idx, slot)
+            return
+        req.stream._q.put(token)
+        slot.generated += 1
+        slot.remaining -= 1
+        self.total_tokens += 1
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_tpu_tokens_generated_total")
+        at_eos = req.eos_id is not None and token == req.eos_id
+        # cursor positions used so far: prompt_len + generated
+        at_capacity = req.stream.prompt_len + slot.generated >= self.max_seq - 1
+        if at_eos or slot.remaining <= 0 or at_capacity:
+            self._retire(idx, slot)
+
+    def _retire(self, idx: int, slot: _Slot) -> None:
+        slot.request.stream._q.put(None)
+        slot.request = None
+        self._active[idx] = False
+        self._temps[idx] = 0.0
+
+    def _loop(self) -> None:
+        while not self._closed:
+            try:
+                if self._active.any() or not self._pending.empty():
+                    with self._device_lock:
+                        self._iteration()
+                else:
+                    self._work.wait(timeout=0.05)
+                    self._work.clear()
+            except BaseException as e:  # noqa: BLE001 — waiters must not hang
+                if self._closed:
+                    return
+                if self.logger is not None:
+                    self.logger.error({"event": "generation loop failed",
+                                       "error": repr(e)})
+                err = GenerationError(f"generation failed: {e!r}")
+                for idx, slot in enumerate(self._slots):
+                    if slot.request is not None:
+                        slot.request.stream._q.put(err)
+                        self._retire(idx, slot)
+
+    def _iteration(self) -> None:
+        self._admit()
+        if not self._active.any():
+            return
+        toks, self.cache = self._step_jit(
+            self.cache, self.params, jnp.asarray(self._last_tokens),
+            jnp.asarray(self._active), jnp.asarray(self._temps),
+            self._next_key())
+        toks_np = np.asarray(jax.device_get(toks))
+        if self.metrics is not None:
+            self.metrics.set_gauge("app_tpu_batch_fill",
+                                   float(self._active.sum()) / self.n_slots,
+                                   program="generate")
+        for idx, slot in enumerate(self._slots):
+            if not self._active[idx]:
+                continue
+            self._last_tokens[idx] = toks_np[idx]
+            self._deliver(idx, slot, int(toks_np[idx]))
