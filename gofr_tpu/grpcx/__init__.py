@@ -1,0 +1,30 @@
+"""grpcx: native gRPC over an in-tree HTTP/2 + HPACK wire layer.
+
+Reference: pkg/gofr/grpc.go (server, grpc-go based) and grpc/log.go
+(logging interceptor). The environment ships no grpc runtime, so the
+transport is part of the framework — which is also what lets it support
+server streaming (needed for token streaming; the reference is unary-only,
+SURVEY §3.3).
+
+Public surface:
+  GRPCService / GRPCError / status codes  — declare services
+  GRPCServer                              — app-run transport (app.py wires it)
+  GRPCChannel / dial                      — client side
+  JSONCodec / ProtoCodec                  — message codecs
+"""
+
+from .client import GRPCChannel, dial
+from .server import GRPCServer
+from .service import (CANCELLED, DEADLINE_EXCEEDED, GRPCContext, GRPCError,
+                      GRPCService, INTERNAL, INVALID_ARGUMENT, JSONCodec,
+                      NOT_FOUND, OK, ProtoCodec, RESOURCE_EXHAUSTED,
+                      STATUS_NAMES, UNAUTHENTICATED, UNAVAILABLE,
+                      UNIMPLEMENTED, UNKNOWN)
+
+__all__ = [
+    "GRPCChannel", "dial", "GRPCServer",
+    "GRPCContext", "GRPCError", "GRPCService", "JSONCodec", "ProtoCodec",
+    "STATUS_NAMES", "OK", "CANCELLED", "UNKNOWN", "INVALID_ARGUMENT",
+    "DEADLINE_EXCEEDED", "NOT_FOUND", "RESOURCE_EXHAUSTED", "UNIMPLEMENTED",
+    "INTERNAL", "UNAVAILABLE", "UNAUTHENTICATED",
+]

@@ -1,0 +1,279 @@
+"""Native gRPC client: unary + server-streaming calls over one HTTP/2 conn.
+
+The reference consumes gRPC through generated grpc-go stubs (e.g.
+examples/grpc-server/main_test.go dials with grpc.Dial); this client is the
+framework-side equivalent for tests and inter-service calls. One connection
+multiplexes concurrent calls (odd client stream ids); a reader thread
+dispatches frames to per-call queues.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+import urllib.parse
+
+from . import http2 as h2
+from . import service as svc
+from .hpack import Decoder, Encoder
+
+
+def _q_get(q: queue.Queue, timeout: float | None):
+    try:
+        return q.get(timeout=timeout)
+    except queue.Empty:
+        raise svc.GRPCError(svc.DEADLINE_EXCEEDED,
+                            f"no response within {timeout}s") from None
+
+
+class _Call:
+    __slots__ = ("sid", "q", "headers", "trailers", "send_window", "buffer",
+                 "done")
+
+    def __init__(self, sid: int, initial_window: int):
+        self.sid = sid
+        self.q: queue.Queue = queue.Queue()  # message bytes | GRPCError | None
+        self.headers: dict[str, str] = {}
+        self.trailers: dict[str, str] = {}
+        self.send_window = h2.FlowWindow(initial_window)
+        self.buffer = bytearray()
+        self.done = threading.Event()
+
+
+class GRPCChannel:
+    """h2c (prior-knowledge) gRPC channel to host:port."""
+
+    def __init__(self, host: str, port: int, connect_timeout: float = 5.0):
+        self.target = f"{host}:{port}"
+        self.sock = socket.create_connection((host, port), connect_timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.io = h2.FrameIO(self.sock)
+        self.encoder = Encoder()
+        self.decoder = Decoder()
+        self._enc_lock = threading.Lock()
+        self.conn_window = h2.FlowWindow(h2.DEFAULT_WINDOW)
+        self.peer_initial_window = h2.DEFAULT_WINDOW
+        self._calls: dict[int, _Call] = {}
+        self._lock = threading.Lock()
+        self._next_sid = 1
+        self._closed = False
+        self._error: Exception | None = None
+
+        with self.io._wlock:
+            self.sock.sendall(h2.CLIENT_PREFACE)
+        self.io.send_frame(h2.SETTINGS, 0, 0, h2.encode_settings({
+            h2.SETTINGS_HEADER_TABLE_SIZE: 4096,
+            h2.SETTINGS_MAX_FRAME_SIZE: h2.DEFAULT_MAX_FRAME,
+        }))
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="gofr-grpc-client", daemon=True)
+        self._reader.start()
+
+    # -- reader --------------------------------------------------------------
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                self._dispatch(self.io.recv_frame())
+        except Exception as e:  # noqa: BLE001
+            self._error = e
+            self._teardown()
+
+    def _teardown(self) -> None:
+        with self._lock:
+            calls = list(self._calls.values())
+            self._calls.clear()
+            self._closed = True
+        for c in calls:
+            c.send_window.kill()
+            c.q.put(None)
+        self.conn_window.kill()
+
+    def _dispatch(self, f: h2.Frame) -> None:
+        if f.type == h2.SETTINGS:
+            if not f.flags & h2.FLAG_ACK:
+                settings = h2.decode_settings(f.payload)
+                if h2.SETTINGS_MAX_FRAME_SIZE in settings:
+                    self.io.peer_max_frame = settings[h2.SETTINGS_MAX_FRAME_SIZE]
+                if h2.SETTINGS_INITIAL_WINDOW_SIZE in settings:
+                    new = settings[h2.SETTINGS_INITIAL_WINDOW_SIZE]
+                    delta = new - self.peer_initial_window
+                    self.peer_initial_window = new
+                    with self._lock:
+                        for c in self._calls.values():
+                            c.send_window.adjust(delta)
+                if (settings.get(h2.SETTINGS_HEADER_TABLE_SIZE, 4096) < 4096):
+                    with self._enc_lock:
+                        self.encoder.indexing = False
+                self.io.send_frame(h2.SETTINGS, h2.FLAG_ACK, 0)
+        elif f.type == h2.HEADERS:
+            self._on_headers(f)
+        elif f.type == h2.DATA:
+            self._on_data(f)
+        elif f.type == h2.WINDOW_UPDATE:
+            inc = int.from_bytes(f.payload, "big") & 0x7FFFFFFF
+            if f.stream_id == 0:
+                self.conn_window.credit(inc)
+            else:
+                call = self._calls.get(f.stream_id)
+                if call is not None:
+                    call.send_window.credit(inc)
+        elif f.type == h2.PING:
+            if not f.flags & h2.FLAG_ACK:
+                self.io.send_frame(h2.PING, h2.FLAG_ACK, 0, f.payload)
+        elif f.type == h2.RST_STREAM:
+            call = self._pop_call(f.stream_id)
+            if call is not None:
+                code = int.from_bytes(f.payload[:4], "big") if f.payload else 0
+                call.q.put(svc.GRPCError(svc.UNAVAILABLE,
+                                         f"stream reset (http2 code {code})"))
+                call.q.put(None)
+        elif f.type == h2.GOAWAY:
+            raise EOFError("server sent GOAWAY")
+
+    def _pop_call(self, sid: int) -> _Call | None:
+        with self._lock:
+            return self._calls.pop(sid, None)
+
+    def _on_headers(self, f: h2.Frame) -> None:
+        call = self._calls.get(f.stream_id)
+        block = h2.strip_padding(f)
+        if not f.flags & h2.FLAG_END_HEADERS:
+            # collect CONTINUATIONs inline (reader thread owns recv)
+            while True:
+                nxt = self.io.recv_frame()
+                if nxt.type != h2.CONTINUATION or nxt.stream_id != f.stream_id:
+                    raise h2.ConnectionError_(h2.PROTOCOL_ERROR,
+                                              "expected CONTINUATION")
+                block += nxt.payload
+                if nxt.flags & h2.FLAG_END_HEADERS:
+                    break
+        headers = {k.decode("ascii"): v.decode("utf-8", "replace")
+                   for k, v in self.decoder.decode(block)}
+        if call is None:
+            return
+        if "grpc-status" in headers:
+            call.trailers.update(headers)
+        else:
+            call.headers.update(headers)
+        if f.flags & h2.FLAG_END_STREAM:
+            self._pop_call(f.stream_id)
+            self._finish_call(call)
+
+    def _finish_call(self, call: _Call) -> None:
+        status = int(call.trailers.get("grpc-status", svc.UNKNOWN))
+        if status != svc.OK:
+            msg = urllib.parse.unquote(call.trailers.get("grpc-message", ""))
+            call.q.put(svc.GRPCError(status, msg))
+        call.q.put(None)
+        call.done.set()
+
+    def _on_data(self, f: h2.Frame) -> None:
+        call = self._calls.get(f.stream_id)
+        if f.payload:
+            n = struct.pack(">I", len(f.payload))
+            self.io.send_frame(h2.WINDOW_UPDATE, 0, 0, n)
+            if call is not None and not f.flags & h2.FLAG_END_STREAM:
+                self.io.send_frame(h2.WINDOW_UPDATE, 0, f.stream_id, n)
+        if call is None:
+            return
+        call.buffer.extend(h2.strip_padding(f))
+        while len(call.buffer) >= 5:
+            length = int.from_bytes(call.buffer[1:5], "big")
+            if len(call.buffer) < 5 + length:
+                break
+            call.q.put(bytes(call.buffer[5 : 5 + length]))
+            del call.buffer[: 5 + length]
+        if f.flags & h2.FLAG_END_STREAM:
+            self._pop_call(f.stream_id)
+            self._finish_call(call)
+
+    # -- calls ---------------------------------------------------------------
+    def _start_call(self, method: str, payload: bytes,
+                    timeout: float | None, metadata=None) -> _Call:
+        if self._closed:
+            raise svc.GRPCError(svc.UNAVAILABLE,
+                                f"channel closed: {self._error!r}")
+        host, _, _ = self.target.partition(":")
+        headers = [(":method", "POST"), (":scheme", "http"),
+                   (":path", method), (":authority", self.target),
+                   ("content-type", "application/grpc"),
+                   ("te", "trailers")]
+        if timeout is not None:
+            headers.append(("grpc-timeout", f"{int(timeout * 1000)}m"))
+        for k, v in (metadata or {}).items():
+            headers.append((k.lower(), v))
+        # Stream ids must reach the server strictly increasing (RFC 9113
+        # §5.1.1): allocate the id and emit HEADERS under one lock so
+        # concurrent calls can't reorder. DATA may interleave freely after.
+        with self._lock:
+            sid = self._next_sid
+            self._next_sid += 2
+            call = _Call(sid, self.peer_initial_window)
+            self._calls[sid] = call
+            with self._enc_lock:
+                block = self.encoder.encode(headers)
+            self.io.send_frame(h2.HEADERS, h2.FLAG_END_HEADERS, sid, block)
+        data = b"\x00" + len(payload).to_bytes(4, "big") + payload
+        view = memoryview(data)
+        while view:
+            want = min(len(view), self.io.peer_max_frame)
+            n_stream = call.send_window.consume(want, timeout=timeout or 30.0)
+            n = self.conn_window.consume(n_stream, timeout=timeout or 30.0)
+            if n < n_stream:  # refund credit the connection couldn't cover
+                call.send_window.credit(n_stream - n)
+            last = n == len(view)
+            self.io.send_frame(h2.DATA,
+                               h2.FLAG_END_STREAM if last else 0, sid,
+                               bytes(view[:n]))
+            view = view[n:]
+        return call
+
+    def unary(self, method: str, request, *, codec=None, response_codec=None,
+              timeout: float | None = 30.0, metadata=None):
+        """Call /pkg.Service/Method; JSON codec unless codecs given."""
+        codec = codec or svc.JSONCodec()
+        response_codec = response_codec or codec
+        call = self._start_call(method, codec.serialize(request), timeout,
+                                metadata)
+        msg = _q_get(call.q, timeout)
+        if isinstance(msg, svc.GRPCError):
+            raise msg
+        if msg is None:
+            raise svc.GRPCError(svc.UNAVAILABLE,
+                                f"connection lost: {self._error!r}")
+        # drain trailers sentinel
+        tail = _q_get(call.q, timeout)
+        if isinstance(tail, svc.GRPCError):
+            raise tail
+        return response_codec.deserialize(msg)
+
+    def server_stream(self, method: str, request, *, codec=None,
+                      response_codec=None, timeout: float | None = 60.0,
+                      metadata=None):
+        """Iterate streamed responses for /pkg.Service/Method."""
+        codec = codec or svc.JSONCodec()
+        response_codec = response_codec or codec
+        call = self._start_call(method, codec.serialize(request), timeout,
+                                metadata)
+        while True:
+            msg = _q_get(call.q, timeout)
+            if isinstance(msg, svc.GRPCError):
+                raise msg
+            if msg is None:
+                if not call.done.is_set() and self._error is not None:
+                    raise svc.GRPCError(svc.UNAVAILABLE,
+                                        f"connection lost: {self._error!r}")
+                return
+            yield response_codec.deserialize(msg)
+
+    def close(self) -> None:
+        self._closed = True
+        self.io.close()
+
+
+def dial(address: str, **kw) -> GRPCChannel:
+    """address "host:port" -> channel (the grpc.Dial shape)."""
+    host, _, port = address.partition(":")
+    return GRPCChannel(host or "127.0.0.1", int(port), **kw)

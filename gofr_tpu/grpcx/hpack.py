@@ -1,0 +1,329 @@
+"""HPACK (RFC 7541) header compression for the native gRPC transport.
+
+Written from the spec: integer/string primitives (§5), indexed and literal
+field representations (§6), the 61-entry static table (Appendix A) and the
+Huffman code (Appendix B, data in ``_hufftable``). The reference framework
+gets HTTP/2 for free from grpc-go (SURVEY §2 #13); this framework carries
+its own wire layer, so compression lives here.
+
+Both peers of this implementation interoperate with any RFC-conformant
+HPACK (dynamic-table size updates honored, Huffman both directions).
+"""
+
+from __future__ import annotations
+
+from ._hufftable import HUFFMAN_CODES
+
+STATIC_TABLE: tuple[tuple[bytes, bytes], ...] = (
+    (b":authority", b""),
+    (b":method", b"GET"),
+    (b":method", b"POST"),
+    (b":path", b"/"),
+    (b":path", b"/index.html"),
+    (b":scheme", b"http"),
+    (b":scheme", b"https"),
+    (b":status", b"200"),
+    (b":status", b"204"),
+    (b":status", b"206"),
+    (b":status", b"304"),
+    (b":status", b"400"),
+    (b":status", b"404"),
+    (b":status", b"500"),
+    (b"accept-charset", b""),
+    (b"accept-encoding", b"gzip, deflate"),
+    (b"accept-language", b""),
+    (b"accept-ranges", b""),
+    (b"accept", b""),
+    (b"access-control-allow-origin", b""),
+    (b"age", b""),
+    (b"allow", b""),
+    (b"authorization", b""),
+    (b"cache-control", b""),
+    (b"content-disposition", b""),
+    (b"content-encoding", b""),
+    (b"content-language", b""),
+    (b"content-length", b""),
+    (b"content-location", b""),
+    (b"content-range", b""),
+    (b"content-type", b""),
+    (b"cookie", b""),
+    (b"date", b""),
+    (b"etag", b""),
+    (b"expect", b""),
+    (b"expires", b""),
+    (b"from", b""),
+    (b"host", b""),
+    (b"if-match", b""),
+    (b"if-modified-since", b""),
+    (b"if-none-match", b""),
+    (b"if-range", b""),
+    (b"if-unmodified-since", b""),
+    (b"last-modified", b""),
+    (b"link", b""),
+    (b"location", b""),
+    (b"max-forwards", b""),
+    (b"proxy-authenticate", b""),
+    (b"proxy-authorization", b""),
+    (b"range", b""),
+    (b"referer", b""),
+    (b"refresh", b""),
+    (b"retry-after", b""),
+    (b"server", b""),
+    (b"set-cookie", b""),
+    (b"strict-transport-security", b""),
+    (b"transfer-encoding", b""),
+    (b"user-agent", b""),
+    (b"vary", b""),
+    (b"via", b""),
+    (b"www-authenticate", b""),
+)
+
+_STATIC_FULL = {entry: i + 1 for i, entry in enumerate(STATIC_TABLE)}
+_STATIC_NAME = {}
+for _i, (_n, _v) in enumerate(STATIC_TABLE):
+    _STATIC_NAME.setdefault(_n, _i + 1)
+
+_ENTRY_OVERHEAD = 32  # RFC 7541 §4.1
+
+
+class HPACKError(Exception):
+    pass
+
+
+# -- integer / string primitives (§5) ----------------------------------------
+
+def encode_int(value: int, prefix_bits: int, flags: int = 0) -> bytearray:
+    limit = (1 << prefix_bits) - 1
+    out = bytearray()
+    if value < limit:
+        out.append(flags | value)
+        return out
+    out.append(flags | limit)
+    value -= limit
+    while value >= 0x80:
+        out.append(0x80 | (value & 0x7F))
+        value >>= 7
+    out.append(value)
+    return out
+
+
+def decode_int(data: bytes, pos: int, prefix_bits: int) -> tuple[int, int]:
+    limit = (1 << prefix_bits) - 1
+    if pos >= len(data):
+        raise HPACKError("truncated integer")
+    value = data[pos] & limit
+    pos += 1
+    if value < limit:
+        return value, pos
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise HPACKError("truncated integer continuation")
+        b = data[pos]
+        pos += 1
+        value += (b & 0x7F) << shift
+        shift += 7
+        if shift > 62:
+            raise HPACKError("integer overflow")
+        if not b & 0x80:
+            return value, pos
+
+
+# -- Huffman (Appendix B) -----------------------------------------------------
+
+_DECODE = {(bits, code): sym for sym, (code, bits) in enumerate(HUFFMAN_CODES)}
+_EOS_PREFIXES = set()
+_eos_code, _eos_bits = HUFFMAN_CODES[256]
+for _n in range(1, 8):
+    _EOS_PREFIXES.add((_n, _eos_code >> (_eos_bits - _n)))
+
+
+def huffman_encode(data: bytes) -> bytes:
+    acc = 0
+    nbits = 0
+    out = bytearray()
+    for sym in data:
+        code, bits = HUFFMAN_CODES[sym]
+        acc = (acc << bits) | code
+        nbits += bits
+        while nbits >= 8:
+            nbits -= 8
+            out.append((acc >> nbits) & 0xFF)
+    if nbits:
+        pad = 8 - nbits
+        out.append(((acc << pad) | ((1 << pad) - 1)) & 0xFF)
+    return bytes(out)
+
+
+def huffman_decode(data: bytes) -> bytes:
+    out = bytearray()
+    code = 0
+    nbits = 0
+    for byte in data:
+        for shift in range(7, -1, -1):
+            code = (code << 1) | ((byte >> shift) & 1)
+            nbits += 1
+            sym = _DECODE.get((nbits, code))
+            if sym is not None:
+                if sym == 256:
+                    raise HPACKError("EOS symbol in huffman stream")
+                out.append(sym)
+                code = 0
+                nbits = 0
+            elif nbits > 30:
+                raise HPACKError("invalid huffman code")
+    if nbits >= 8 or (nbits and (nbits, code) not in _EOS_PREFIXES):
+        raise HPACKError("invalid huffman padding")
+    return bytes(out)
+
+
+def encode_string(data: bytes, huffman: bool = True) -> bytearray:
+    if huffman:
+        encoded = huffman_encode(data)
+        if len(encoded) < len(data):
+            out = encode_int(len(encoded), 7, 0x80)
+            out.extend(encoded)
+            return out
+    out = encode_int(len(data), 7, 0x00)
+    out.extend(data)
+    return out
+
+
+def decode_string(data: bytes, pos: int) -> tuple[bytes, int]:
+    if pos >= len(data):
+        raise HPACKError("truncated string")
+    is_huffman = bool(data[pos] & 0x80)
+    length, pos = decode_int(data, pos, 7)
+    if pos + length > len(data):
+        raise HPACKError("string exceeds block")
+    raw = bytes(data[pos : pos + length])
+    return (huffman_decode(raw) if is_huffman else raw), pos + length
+
+
+# -- dynamic table ------------------------------------------------------------
+
+class _DynamicTable:
+    def __init__(self, max_size: int = 4096):
+        self.entries: list[tuple[bytes, bytes]] = []
+        self.size = 0
+        self.max_size = max_size
+        self.cap = max_size  # protocol ceiling (SETTINGS_HEADER_TABLE_SIZE)
+
+    def add(self, name: bytes, value: bytes) -> None:
+        need = len(name) + len(value) + _ENTRY_OVERHEAD
+        while self.entries and self.size + need > self.max_size:
+            en, ev = self.entries.pop()
+            self.size -= len(en) + len(ev) + _ENTRY_OVERHEAD
+        if need <= self.max_size:
+            self.entries.insert(0, (name, value))
+            self.size += need
+
+    def resize(self, new_max: int) -> None:
+        if new_max > self.cap:
+            raise HPACKError(f"table size {new_max} above ceiling {self.cap}")
+        self.max_size = new_max
+        while self.entries and self.size > self.max_size:
+            en, ev = self.entries.pop()
+            self.size -= len(en) + len(ev) + _ENTRY_OVERHEAD
+
+    def get(self, index: int) -> tuple[bytes, bytes]:
+        # index is 1-based over static + dynamic (§2.3.3)
+        if 1 <= index <= len(STATIC_TABLE):
+            return STATIC_TABLE[index - 1]
+        d = index - len(STATIC_TABLE) - 1
+        if 0 <= d < len(self.entries):
+            return self.entries[d]
+        raise HPACKError(f"invalid index {index}")
+
+    def find(self, name: bytes, value: bytes) -> tuple[int, bool]:
+        """-> (index, exact). index 0 = not found."""
+        exact = _STATIC_FULL.get((name, value))
+        if exact:
+            return exact, True
+        for i, (n, v) in enumerate(self.entries):
+            if n == name and v == value:
+                return len(STATIC_TABLE) + 1 + i, True
+        name_idx = _STATIC_NAME.get(name)
+        if name_idx:
+            return name_idx, False
+        for i, (n, _) in enumerate(self.entries):
+            if n == name:
+                return len(STATIC_TABLE) + 1 + i, False
+        return 0, False
+
+
+# -- encoder / decoder --------------------------------------------------------
+
+def _norm(h: "str | bytes") -> bytes:
+    return h.encode("ascii") if isinstance(h, str) else h
+
+
+class Encoder:
+    def __init__(self, max_table_size: int = 4096):
+        self.table = _DynamicTable(max_table_size)
+        self.huffman = True
+        # When the peer advertises a header table smaller than ours, drop to
+        # literal-without-indexing (§6.2.2) instead of emitting table-size
+        # update bookkeeping — always RFC-valid, marginally less compact.
+        self.indexing = True
+
+    def encode(self, headers) -> bytes:
+        out = bytearray()
+        for name, value in headers:
+            name, value = _norm(name).lower(), _norm(value)
+            idx, exact = self.table.find(name, value)
+            if exact:
+                out.extend(encode_int(idx, 7, 0x80))  # §6.1 indexed
+            elif not self.indexing:
+                out.extend(encode_int(idx, 4, 0x00))  # §6.2.2 (idx may be 0)
+                if not idx:
+                    out.extend(encode_string(name, self.huffman))
+                out.extend(encode_string(value, self.huffman))
+            elif idx:
+                # §6.2.1 literal with incremental indexing, indexed name
+                out.extend(encode_int(idx, 6, 0x40))
+                out.extend(encode_string(value, self.huffman))
+                self.table.add(name, value)
+            else:
+                out.extend(encode_int(0, 6, 0x40))  # new name
+                out.extend(encode_string(name, self.huffman))
+                out.extend(encode_string(value, self.huffman))
+                self.table.add(name, value)
+        return bytes(out)
+
+
+class Decoder:
+    def __init__(self, max_table_size: int = 4096):
+        self.table = _DynamicTable(max_table_size)
+
+    def decode(self, data: bytes) -> list[tuple[bytes, bytes]]:
+        headers: list[tuple[bytes, bytes]] = []
+        pos = 0
+        while pos < len(data):
+            b = data[pos]
+            if b & 0x80:  # §6.1 indexed
+                idx, pos = decode_int(data, pos, 7)
+                if idx == 0:
+                    raise HPACKError("index 0 in indexed representation")
+                headers.append(self.table.get(idx))
+            elif b & 0x40:  # §6.2.1 literal, incremental indexing
+                idx, pos = decode_int(data, pos, 6)
+                name, value, pos = self._literal(data, pos, idx)
+                self.table.add(name, value)
+                headers.append((name, value))
+            elif b & 0x20:  # §6.3 dynamic table size update
+                size, pos = decode_int(data, pos, 5)
+                self.table.resize(size)
+            else:  # §6.2.2/§6.2.3 literal without indexing / never indexed
+                idx, pos = decode_int(data, pos, 4)
+                name, value, pos = self._literal(data, pos, idx)
+                headers.append((name, value))
+        return headers
+
+    def _literal(self, data: bytes, pos: int, idx: int):
+        if idx:
+            name = self.table.get(idx)[0]
+        else:
+            name, pos = decode_string(data, pos)
+        value, pos = decode_string(data, pos)
+        return name, value, pos

@@ -1,0 +1,174 @@
+"""gRPC service model: method registry, codecs, status codes, handler context.
+
+Reference: App.RegisterService (pkg/gofr/gofr.go:49-53) registers
+protoc-generated servers on a grpc-go server. Here a service is declared
+directly in Python — method name, handler, codec — and the transport
+handles the wire. Two codecs:
+
+  - JSON (default): request/response are dicts — the protoless path,
+    symmetric with the HTTP responder envelope.
+  - Protobuf: pass generated message classes (``request_type`` /
+    ``response_type``); any standard ``*_pb2`` module works (the
+    environment ships google.protobuf).
+
+Unlike the reference (unary-only interceptors, grpc.go:22-26), methods may
+be server-streaming — the handler returns/yields an iterator — which is
+what token streaming needs (SURVEY §3.3 note).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Iterator
+
+# gRPC status codes (subset used by the framework)
+OK = 0
+CANCELLED = 1
+UNKNOWN = 2
+INVALID_ARGUMENT = 3
+DEADLINE_EXCEEDED = 4
+NOT_FOUND = 5
+RESOURCE_EXHAUSTED = 8
+UNIMPLEMENTED = 12
+INTERNAL = 13
+UNAVAILABLE = 14
+UNAUTHENTICATED = 16
+
+STATUS_NAMES = {
+    0: "OK", 1: "CANCELLED", 2: "UNKNOWN", 3: "INVALID_ARGUMENT",
+    4: "DEADLINE_EXCEEDED", 5: "NOT_FOUND", 8: "RESOURCE_EXHAUSTED",
+    12: "UNIMPLEMENTED", 13: "INTERNAL", 14: "UNAVAILABLE",
+    16: "UNAUTHENTICATED",
+}
+
+
+class GRPCError(Exception):
+    """Raise from a handler to return a specific gRPC status."""
+
+    def __init__(self, code: int, message: str = ""):
+        super().__init__(message or STATUS_NAMES.get(code, str(code)))
+        self.code = code
+        self.message = message or STATUS_NAMES.get(code, str(code))
+
+
+class JSONCodec:
+    """dict <-> UTF-8 JSON bytes."""
+
+    @staticmethod
+    def serialize(obj: Any) -> bytes:
+        return json.dumps(obj, default=str).encode()
+
+    @staticmethod
+    def deserialize(data: bytes) -> Any:
+        return json.loads(data) if data else None
+
+
+class ProtoCodec:
+    """Codec over a generated protobuf message class."""
+
+    def __init__(self, message_type):
+        self.message_type = message_type
+
+    def serialize(self, msg) -> bytes:
+        return msg.SerializeToString()
+
+    def deserialize(self, data: bytes):
+        return self.message_type.FromString(data)
+
+
+class Method:
+    __slots__ = ("name", "handler", "request_codec", "response_codec",
+                 "server_streaming")
+
+    def __init__(self, name: str, handler: Callable, request_codec,
+                 response_codec, server_streaming: bool):
+        self.name = name
+        self.handler = handler
+        self.request_codec = request_codec
+        self.response_codec = response_codec
+        self.server_streaming = server_streaming
+
+
+class GRPCContext:
+    """Per-RPC context handed to handlers: DI container access + metadata +
+    deadline (richer than the reference, whose gRPC handlers bypass the
+    gofr Context entirely — SURVEY §3.3)."""
+
+    def __init__(self, container, method: str, metadata: dict[str, str],
+                 deadline: float | None = None, peer: str = ""):
+        self.container = container
+        self.method = method
+        self.metadata = metadata
+        self.deadline = deadline  # monotonic deadline or None
+        self.peer = peer
+        self.cancelled = None  # threading.Event set on RST_STREAM
+
+    @property
+    def logger(self):
+        return self.container.logger if self.container else None
+
+    @property
+    def tpu(self):
+        return self.container.tpu if self.container else None
+
+    @property
+    def redis(self):
+        return self.container.redis if self.container else None
+
+    @property
+    def sql(self):
+        return self.container.sql if self.container else None
+
+    def get_http_service(self, name: str):
+        return self.container.get_http_service(name) if self.container else None
+
+    def is_cancelled(self) -> bool:
+        return self.cancelled is not None and self.cancelled.is_set()
+
+
+class GRPCService:
+    """A named service with registered methods.
+
+    svc = GRPCService("demo.Echo")
+
+    @svc.unary("Say")
+    def say(ctx, req): return {"msg": req["msg"]}
+
+    @svc.server_stream("Tokens", request_type=Req, response_type=Tok)
+    def tokens(ctx, req):
+        for t in ...: yield t
+    """
+
+    def __init__(self, name: str):
+        if not name:
+            raise ValueError("service name required")
+        self.name = name
+        self.methods: dict[str, Method] = {}
+
+    def _codecs(self, request_type, response_type):
+        req = ProtoCodec(request_type) if request_type is not None else JSONCodec()
+        res = ProtoCodec(response_type) if response_type is not None else JSONCodec()
+        return req, res
+
+    def _register(self, name: str, fn: Callable, request_type, response_type,
+                  streaming: bool):
+        req_c, res_c = self._codecs(request_type, response_type)
+        self.methods[name] = Method(name, fn, req_c, res_c, streaming)
+        return fn
+
+    def unary(self, name: str, fn: Callable | None = None, *,
+              request_type=None, response_type=None):
+        if fn is None:
+            return lambda f: self._register(name, f, request_type,
+                                            response_type, False)
+        return self._register(name, fn, request_type, response_type, False)
+
+    def server_stream(self, name: str, fn: Callable | None = None, *,
+                      request_type=None, response_type=None):
+        if fn is None:
+            return lambda f: self._register(name, f, request_type,
+                                            response_type, True)
+        return self._register(name, fn, request_type, response_type, True)
+
+    def lookup(self, method: str) -> Method | None:
+        return self.methods.get(method)

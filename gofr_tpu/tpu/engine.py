@@ -38,10 +38,6 @@ DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8)
 DEFAULT_SEQ_BUCKETS = (32, 64, 128, 256, 512)
 
 
-def _round_up(n: int, buckets: Sequence[int]) -> int:
-    return pad_bucket(n, buckets)
-
-
 @dataclass
 class Program:
     """One servable compiled function.
@@ -128,7 +124,7 @@ class TPUEngine:
         def hook(batch_size: int, oldest_wait: float) -> None:
             if self.metrics is None:
                 return
-            bucket = _round_up(batch_size, prog.batch_buckets)
+            bucket = pad_bucket(batch_size, prog.batch_buckets)
             self.metrics.record_histogram("app_tpu_batch_wait_duration",
                                           oldest_wait, program=prog.name)
             self.metrics.set_gauge("app_tpu_batch_fill", batch_size / bucket,
@@ -149,8 +145,8 @@ class TPUEngine:
 
     def _run_tokens(self, prog: Program, items: list) -> list:
         lengths = [int(np.asarray(it).shape[0]) for it in items]
-        Sb = _round_up(max(lengths), prog.seq_buckets)
-        Bb = _round_up(len(items), prog.batch_buckets)
+        Sb = pad_bucket(max(lengths), prog.seq_buckets)
+        Bb = pad_bucket(len(items), prog.batch_buckets)
         tokens = np.zeros((Bb, Sb), np.int32)
         for i, it in enumerate(items):
             tokens[i, : lengths[i]] = np.asarray(it, np.int32)
@@ -162,7 +158,7 @@ class TPUEngine:
         return [jax.tree.map(lambda a: a[i], out) for i in range(len(items))]
 
     def _run_fixed(self, prog: Program, items: list) -> list:
-        Bb = _round_up(len(items), prog.batch_buckets)
+        Bb = pad_bucket(len(items), prog.batch_buckets)
         pad = [items[-1]] * (Bb - len(items))
         batch = jax.tree.map(lambda *xs: np.stack(xs), *(list(items) + pad))
         self._note_shape(prog, (Bb,))
@@ -225,6 +221,12 @@ class TPUEngine:
             if n == 0 or n > limit:
                 raise ValueError(
                     f"program {prog.name!r}: item length {n} outside (0, {limit}]")
+        elif prog.example_item is not None:
+            want = jax.tree.map(lambda a: np.shape(a), prog.example_item)
+            got = jax.tree.map(lambda a: np.shape(a), item)
+            if want != got:
+                raise ValueError(
+                    f"program {prog.name!r}: item shapes {got} != expected {want}")
 
     def generate(self, *args, **kw):
         """Streaming token generation (decoder models). See

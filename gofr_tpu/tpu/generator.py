@@ -217,15 +217,23 @@ class GenerationEngine:
         """Prime every compiled shape (prefill per bucket + the step).
 
         Safe while serving: the device lock excludes the loop thread for
-        the duration (both jits donate the cache buffer), and the cursor
-        snapshot restores any active slots' state afterwards."""
+        the duration (both jits donate the cache buffer); dummy prefills
+        go into a FREE slot only (they overwrite that slot's KV), and the
+        cursor snapshot restores the lengths afterwards. With every slot
+        busy the prefill warmup is skipped — an all-busy engine has those
+        shapes compiled already or will compile them on admission."""
         with self._device_lock:
             cursors = np.asarray(jax.device_get(self.cache.lengths))
-            for b in self.prompt_buckets:
-                toks = jnp.zeros((1, b), jnp.int32)
-                _, self.cache = jax.block_until_ready(self._prefill_jit(
-                    self.cache, self.params, toks, jnp.int32(1), jnp.int32(0),
-                    jnp.float32(0.0), self._key))
+            free = next((i for i, s in enumerate(self._slots) if s.free), None)
+            if free is not None:
+                for b in self.prompt_buckets:
+                    toks = jnp.zeros((1, b), jnp.int32)
+                    _, self.cache = jax.block_until_ready(self._prefill_jit(
+                        self.cache, self.params, toks, jnp.int32(1),
+                        jnp.int32(free), jnp.float32(0.0), self._key))
+            elif self.logger is not None:
+                self.logger.debug({"event": "generator warmup skipped prefill",
+                                   "reason": "no free slot"})
             _, self.cache = jax.block_until_ready(self._step_jit(
                 self.cache, self.params, jnp.asarray(self._last_tokens),
                 jnp.zeros((self.n_slots,), bool), jnp.asarray(self._temps),
@@ -275,10 +283,17 @@ class GenerationEngine:
         padded = np.zeros((1, Sb), np.int32)
         padded[0, :L] = req.prompt
         t0 = time.monotonic()
-        tok, self.cache = self._prefill_jit(
-            self.cache, self.params, jnp.asarray(padded), jnp.int32(L),
-            jnp.int32(idx), jnp.float32(req.temperature), self._next_key())
-        first = int(tok)
+        try:
+            tok, self.cache = self._prefill_jit(
+                self.cache, self.params, jnp.asarray(padded), jnp.int32(L),
+                jnp.int32(idx), jnp.float32(req.temperature), self._next_key())
+            first = int(tok)
+        except BaseException as e:  # noqa: BLE001 — the request is already
+            # off the pending queue and owns no slot: fail ITS stream here,
+            # then let _loop's handler deal with engine-level fallout.
+            req.stream._q.put(GenerationError(f"prefill failed: {e!r}"))
+            req.stream._q.put(None)
+            raise
         if self.metrics is not None:
             self.metrics.record_histogram("app_tpu_batch_wait_duration",
                                           t0 - req.enqueued_at, program="generate")
