@@ -56,6 +56,19 @@ DEFAULT_HISTOGRAM_BUCKETS = (
 )
 
 
+def _new_histogram_series(buckets: Sequence[float]):
+    """Native wait-free histogram when the runtime is available, else the
+    locked python representation [cumulative_counts, sum, count]."""
+    try:
+        from .native import NativeHistogram, available
+
+        if available():
+            return NativeHistogram(buckets)
+    except Exception:
+        pass
+    return [[0] * len(buckets), 0.0, 0]
+
+
 class Manager:
     """Thread-safe metrics registry + recorder.
 
@@ -122,11 +135,17 @@ class Manager:
     def record_histogram(self, name: str, value: float, **labels: str) -> None:
         m = self._get(name, "histogram")
         key = _label_key(labels)
+        entry = m.series.get(key)
+        if entry is None:
+            with m.lock:
+                entry = m.series.get(key)
+                if entry is None:
+                    entry = _new_histogram_series(m.buckets)
+                    m.series[key] = entry
+        if type(entry) is not list:  # native: wait-free, no Python lock
+            entry.record(value)
+            return
         with m.lock:
-            entry = m.series.get(key)
-            if entry is None:
-                entry = [[0] * len(m.buckets), 0.0, 0]
-                m.series[key] = entry
             counts, _, _ = entry
             for i, b in enumerate(m.buckets):
                 if value <= b:
@@ -156,7 +175,14 @@ class Manager:
             for key, val in sorted(series.items()):
                 label_str = _fmt_labels(key)
                 if m.kind == "histogram":
-                    counts, total, count = val  # type: ignore[misc]
+                    if type(val) is not list:  # native snapshot -> cumulative
+                        raw, total, count = val.snapshot()
+                        counts, cum = [], 0
+                        for c in raw[:-1]:
+                            cum += c
+                            counts.append(cum)
+                    else:
+                        counts, total, count = val  # type: ignore[misc]
                     cum = 0
                     for b, c in zip(m.buckets, counts):
                         cum = c

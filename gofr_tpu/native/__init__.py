@@ -1,0 +1,158 @@
+"""ctypes loader for the native runtime (gofr_runtime.cc).
+
+Build model: the shared library is compiled on first import (g++ -O2
+-shared, ~1s) and cached next to the source; environments without a
+toolchain fall back to pure-Python equivalents — every native consumer
+(batcher, metrics) keeps a fallback path, mirroring how the reference
+degrades gracefully when a datasource is absent
+(container/container.go:55-126).
+
+Set GOFR_NATIVE=0 to force the Python paths (useful for debugging).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "gofr_runtime.cc")
+_SO = os.path.join(_DIR, "libgofr_runtime.so")
+
+_lib = None
+_load_lock = threading.Lock()
+_load_attempted = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+             "-o", _SO, _SRC],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u64 = ctypes.c_uint64
+    lib.gq_new.restype = ctypes.c_void_p
+    lib.gq_new.argtypes = [ctypes.c_int, ctypes.c_double]
+    lib.gq_free.argtypes = [ctypes.c_void_p]
+    lib.gq_push.restype = ctypes.c_int
+    lib.gq_push.argtypes = [ctypes.c_void_p, u64]
+    lib.gq_pop_batch.restype = ctypes.c_int
+    lib.gq_pop_batch.argtypes = [ctypes.c_void_p, ctypes.POINTER(u64),
+                                 ctypes.c_int, ctypes.POINTER(ctypes.c_double)]
+    lib.gq_close.argtypes = [ctypes.c_void_p]
+    lib.gq_size.restype = ctypes.c_int
+    lib.gq_size.argtypes = [ctypes.c_void_p]
+    lib.hist_new.restype = ctypes.c_void_p
+    lib.hist_new.argtypes = [ctypes.POINTER(ctypes.c_double), ctypes.c_int]
+    lib.hist_free.argtypes = [ctypes.c_void_p]
+    lib.hist_record.argtypes = [ctypes.c_void_p, ctypes.c_double]
+    lib.hist_snapshot.argtypes = [ctypes.c_void_p, ctypes.POINTER(u64),
+                                  ctypes.POINTER(ctypes.c_double),
+                                  ctypes.POINTER(u64)]
+    return lib
+
+
+def load():
+    """The native library, or None when unavailable."""
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    with _load_lock:
+        if _lib is not None or _load_attempted:
+            return _lib
+        _load_attempted = True
+        if os.environ.get("GOFR_NATIVE", "1") == "0":
+            return None
+        try:
+            if not os.path.exists(_SO) or (
+                    os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                if not _build():
+                    return None
+            _lib = _bind(ctypes.CDLL(_SO))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class NativeBatchQueue:
+    """MPMC coalescing id queue; pop blocks in C with the GIL released."""
+
+    def __init__(self, max_batch: int, max_delay: float):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._q = lib.gq_new(max_batch, max_delay)
+        self.max_batch = max_batch
+        self._out = (ctypes.c_uint64 * max_batch)()
+        self._wait = ctypes.c_double()
+
+    def push(self, item_id: int) -> bool:
+        return self._lib.gq_push(self._q, item_id) == 0
+
+    def pop_batch(self) -> tuple[list[int], float]:
+        """Block until a batch is ready; ([], 0.0) means closed+drained."""
+        n = self._lib.gq_pop_batch(self._q, self._out, self.max_batch,
+                                   ctypes.byref(self._wait))
+        return list(self._out[:n]), self._wait.value
+
+    def close(self) -> None:
+        self._lib.gq_close(self._q)
+
+    def __len__(self) -> int:
+        return self._lib.gq_size(self._q)
+
+    def __del__(self):
+        try:
+            if self._q:
+                self._lib.gq_close(self._q)
+                self._lib.gq_free(self._q)
+                self._q = None
+        except Exception:
+            pass
+
+
+class NativeHistogram:
+    """Wait-free fixed-bucket histogram (record is one C call, no lock)."""
+
+    def __init__(self, bounds):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self.bounds = tuple(bounds)
+        arr = (ctypes.c_double * len(bounds))(*bounds)
+        self._h = lib.hist_new(arr, len(bounds))
+
+    def record(self, value: float) -> None:
+        self._lib.hist_record(self._h, value)
+
+    def snapshot(self) -> tuple[list[int], float, int]:
+        """(per-bucket counts [len(bounds)+1], sum, count). Buffers are
+        allocated per call: concurrent scrape threads must not share them."""
+        counts = (ctypes.c_uint64 * (len(self.bounds) + 1))()
+        total = ctypes.c_double()
+        count = ctypes.c_uint64()
+        self._lib.hist_snapshot(self._h, counts, ctypes.byref(total),
+                                ctypes.byref(count))
+        return list(counts), total.value, count.value
+
+    def __del__(self):
+        try:
+            if self._h:
+                self._lib.hist_free(self._h)
+                self._h = None
+        except Exception:
+            pass

@@ -1,0 +1,169 @@
+// gofr_tpu native runtime: coalescing scheduler + lock-free telemetry.
+//
+// The reference framework's runtime is the Go scheduler + net/http
+// (SURVEY §2: all components pure Go); this framework's Python control
+// plane gets its hot-path primitives from this library instead:
+//
+//   gq_*    coalescing batch queue — the serving scheduler. Handler
+//           threads push request ids; one dispatcher blocks HERE (outside
+//           the GIL) until a batch is ready: full batch -> immediate
+//           flush, else flush when the oldest item has waited max_delay.
+//
+//   hist_*  fixed-bucket histograms with atomic counters — per-op
+//           observability on the µs-scale device path (SURVEY §7 hard
+//           part (d)) without a Python-level lock per record.
+//
+// Pure C ABI for ctypes (no pybind11 in the image). Thread-safety:
+// gq is MPMC-safe; hist_record is wait-free (relaxed atomics), snapshots
+// are eventually consistent which is all Prometheus scrapes need.
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+using Clock = std::chrono::steady_clock;
+
+namespace {
+
+struct Item {
+  uint64_t id;
+  Clock::time_point enqueued;
+};
+
+struct GQueue {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<Item> items;
+  int max_batch;
+  std::chrono::duration<double> max_delay;
+  bool closed = false;
+};
+
+struct Histogram {
+  std::vector<double> bounds;                       // ascending
+  std::vector<std::atomic<uint64_t>> counts;        // bounds.size()+1 (+inf)
+  std::atomic<uint64_t> count{0};
+  std::atomic<uint64_t> sum_bits{0};                // double bits, CAS-accumulated
+
+  explicit Histogram(const double* b, int n)
+      : bounds(b, b + n), counts(n + 1) {}
+
+  void record(double v) {
+    // linear scan: bucket lists are short (<=20) and branch-predictable
+    size_t i = 0;
+    while (i < bounds.size() && v > bounds[i]) ++i;
+    counts[i].fetch_add(1, std::memory_order_relaxed);
+    count.fetch_add(1, std::memory_order_relaxed);
+    uint64_t old = sum_bits.load(std::memory_order_relaxed);
+    double next;
+    uint64_t next_bits;
+    do {
+      double cur;
+      std::memcpy(&cur, &old, sizeof cur);
+      next = cur + v;
+      std::memcpy(&next_bits, &next, sizeof next_bits);
+    } while (!sum_bits.compare_exchange_weak(old, next_bits,
+                                             std::memory_order_relaxed));
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- coalescing queue ------------------------------------------------------
+
+void* gq_new(int max_batch, double max_delay_s) {
+  auto* q = new GQueue();
+  q->max_batch = max_batch < 1 ? 1 : max_batch;
+  q->max_delay = std::chrono::duration<double>(max_delay_s);
+  return q;
+}
+
+void gq_free(void* h) { delete static_cast<GQueue*>(h); }
+
+int gq_push(void* h, uint64_t id) {
+  auto* q = static_cast<GQueue*>(h);
+  {
+    std::lock_guard<std::mutex> lk(q->mu);
+    if (q->closed) return -1;
+    q->items.push_back({id, Clock::now()});
+  }
+  q->cv.notify_one();
+  return 0;
+}
+
+// Blocks until a flush condition holds, then pops up to `cap` ids into
+// `out` and stores the oldest item's wait in seconds. Returns the batch
+// size, or 0 when the queue is closed and drained.
+int gq_pop_batch(void* h, uint64_t* out, int cap, double* oldest_wait_s) {
+  auto* q = static_cast<GQueue*>(h);
+  std::unique_lock<std::mutex> lk(q->mu);
+  for (;;) {
+    if (!q->items.empty()) {
+      auto now = Clock::now();
+      auto oldest = now - q->items.front().enqueued;
+      if (static_cast<int>(q->items.size()) >= q->max_batch ||
+          oldest >= q->max_delay || q->closed) {
+        int n = 0;
+        int limit = cap < q->max_batch ? cap : q->max_batch;
+        while (n < limit && !q->items.empty()) {
+          out[n++] = q->items.front().id;
+          q->items.pop_front();
+        }
+        if (oldest_wait_s)
+          *oldest_wait_s = std::chrono::duration<double>(oldest).count();
+        return n;
+      }
+      q->cv.wait_for(lk, q->max_delay - oldest);
+    } else if (q->closed) {
+      return 0;
+    } else {
+      q->cv.wait(lk);
+    }
+  }
+}
+
+void gq_close(void* h) {
+  auto* q = static_cast<GQueue*>(h);
+  {
+    std::lock_guard<std::mutex> lk(q->mu);
+    q->closed = true;
+  }
+  q->cv.notify_all();
+}
+
+int gq_size(void* h) {
+  auto* q = static_cast<GQueue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return static_cast<int>(q->items.size());
+}
+
+// ---- histograms ------------------------------------------------------------
+
+void* hist_new(const double* bounds, int n) {
+  return new Histogram(bounds, n);
+}
+
+void hist_free(void* h) { delete static_cast<Histogram*>(h); }
+
+void hist_record(void* h, double v) {
+  static_cast<Histogram*>(h)->record(v);
+}
+
+// counts must have room for n_bounds+1 entries (last = +inf bucket).
+void hist_snapshot(void* h, uint64_t* counts, double* sum, uint64_t* count) {
+  auto* hist = static_cast<Histogram*>(h);
+  for (size_t i = 0; i < hist->counts.size(); ++i)
+    counts[i] = hist->counts[i].load(std::memory_order_relaxed);
+  uint64_t bits = hist->sum_bits.load(std::memory_order_relaxed);
+  std::memcpy(sum, &bits, sizeof *sum);
+  *count = hist->count.load(std::memory_order_relaxed);
+}
+
+}  // extern "C"

@@ -51,7 +51,8 @@ class CoalescingBatcher:
 
     def __init__(self, runner: Callable[[list], Sequence], max_batch: int,
                  max_delay: float = 0.005, name: str = "batcher",
-                 on_dispatch: Callable[[int, float], None] | None = None):
+                 on_dispatch: Callable[[int, float], None] | None = None,
+                 use_native: bool = True):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.runner = runner
@@ -63,19 +64,46 @@ class CoalescingBatcher:
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
         self._closed = False
+        # Native scheduler: the dispatcher blocks inside the C library with
+        # the GIL released; the queue itself lives off the Python heap.
+        self._native = None
+        self._items: dict[int, BatchItem] = {}
+        self._next_id = 0
+        if use_native:
+            try:
+                from ..native import NativeBatchQueue, available
+
+                if available():
+                    self._native = NativeBatchQueue(max_batch, max_delay)
+            except Exception:
+                self._native = None
+        # NB: explicit None check — NativeBatchQueue defines __len__, so an
+        # empty queue is falsy.
         self._thread = threading.Thread(
-            target=self._loop, name=f"gofr-{name}", daemon=True)
+            target=self._loop if self._native is None else self._native_loop,
+            name=f"gofr-{name}", daemon=True)
         self._thread.start()
 
     # -- producer side -------------------------------------------------------
     def submit(self, payload: Any, timeout: float | None = None) -> Any:
         """Block until the batched result for ``payload`` is ready."""
         item = BatchItem(payload)
-        with self._lock:
-            if self._closed:
+        if self._native is not None:
+            with self._lock:
+                if self._closed:
+                    raise BatcherClosed(f"{self.name} is closed")
+                self._next_id += 1
+                item_id = self._next_id
+                self._items[item_id] = item
+            if not self._native.push(item_id):
+                self._items.pop(item_id, None)
                 raise BatcherClosed(f"{self.name} is closed")
-            self._queue.append(item)
-            self._nonempty.notify()
+        else:
+            with self._lock:
+                if self._closed:
+                    raise BatcherClosed(f"{self.name} is closed")
+                self._queue.append(item)
+                self._nonempty.notify()
         if not item.done.wait(timeout):
             item.error = TimeoutError(f"{self.name}: no result in {timeout}s")
             raise item.error
@@ -101,37 +129,53 @@ class CoalescingBatcher:
                 else:
                     self._nonempty.wait()
 
+    def _run_one(self, batch: list[BatchItem], oldest_wait: float) -> None:
+        if self.on_dispatch is not None:
+            try:
+                self.on_dispatch(len(batch), oldest_wait)
+            except Exception:
+                pass
+        try:
+            results = self.runner([it.payload for it in batch])
+            if len(results) != len(batch):
+                raise RuntimeError(
+                    f"{self.name}: runner returned {len(results)} results "
+                    f"for a batch of {len(batch)}")
+            for it, res in zip(batch, results):
+                it.result = res
+                it.done.set()
+        except BaseException as e:  # noqa: BLE001 — every waiter must wake
+            for it in batch:
+                it.error = e
+                it.done.set()
+
     def _loop(self) -> None:
         while True:
             batch = self._take_batch()
             if batch is None:
                 return
-            oldest_wait = time.monotonic() - batch[0].enqueued_at
-            if self.on_dispatch is not None:
-                try:
-                    self.on_dispatch(len(batch), oldest_wait)
-                except Exception:
-                    pass
-            try:
-                results = self.runner([it.payload for it in batch])
-                if len(results) != len(batch):
-                    raise RuntimeError(
-                        f"{self.name}: runner returned {len(results)} results "
-                        f"for a batch of {len(batch)}")
-                for it, res in zip(batch, results):
-                    it.result = res
-                    it.done.set()
-            except BaseException as e:  # noqa: BLE001 — every waiter must wake
-                for it in batch:
-                    it.error = e
-                    it.done.set()
+            self._run_one(batch, time.monotonic() - batch[0].enqueued_at)
+
+    def _native_loop(self) -> None:
+        while True:
+            ids, oldest_wait = self._native.pop_batch()  # blocks outside GIL
+            if not ids:
+                return
+            with self._lock:
+                batch = [self._items.pop(i) for i in ids if i in self._items]
+            if batch:
+                self._run_one(batch, oldest_wait)
 
     def close(self, drain: bool = True) -> None:
         with self._lock:
             self._closed = True
             if not drain:
                 pending, self._queue = self._queue, []
+                pending += list(self._items.values())
+                self._items.clear()
             self._nonempty.notify_all()
+        if self._native is not None:
+            self._native.close()
         if not drain:
             for it in pending:
                 it.error = BatcherClosed(f"{self.name} closed")
