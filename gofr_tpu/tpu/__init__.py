@@ -129,7 +129,7 @@ def new_engine_from_config(cfg, logger=None, metrics=None) -> TPUEngine:
         prompt_b = tuple(b for b in seq_buckets if b < max_seq) or (max_seq // 2,)
         engine.generator = GenerationEngine(
             mc, params, slots=slots, max_seq=max_seq, prompt_buckets=prompt_b,
-            logger=logger, metrics=metrics)
+            logger=logger, metrics=metrics, mesh=mesh)
 
         # scoring program: next-token logits at the prompt end (the
         # non-streaming sibling of generate, e.g. for classification heads)

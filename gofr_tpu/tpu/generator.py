@@ -106,7 +106,7 @@ class GenerationEngine:
     def __init__(self, cfg: ModelConfig, params: Any, *, slots: int = 8,
                  max_seq: int | None = None,
                  prompt_buckets: tuple[int, ...] = (32, 64, 128, 256, 512),
-                 logger=None, metrics=None, seed: int = 0):
+                 logger=None, metrics=None, seed: int = 0, mesh=None):
         self.cfg = cfg
         self.params = params
         self.n_slots = slots
@@ -115,6 +115,7 @@ class GenerationEngine:
                                            if b <= self.max_seq)) or (self.max_seq,)
         self.logger = logger
         self.metrics = metrics
+        self.mesh = mesh
         self.rope_tables = llama.get_rope_tables(cfg, self.max_seq)
 
         self.cache = llama.init_cache(cfg, slots, self.max_seq)
@@ -134,8 +135,25 @@ class GenerationEngine:
         self.total_tokens = 0
         self.total_requests = 0
 
-        self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(0,))
-        self._step_jit = jax.jit(self._step_fn, donate_argnums=(0,))
+        if mesh is not None:
+            # ICI-sharded serving (SURVEY §2 last row): KV heads over tp,
+            # slots over the data axes. Params carry their own shardings
+            # (placed by the config wiring); out_shardings pin the cache
+            # layout so donation aliases buffers across steps and XLA never
+            # resharding-copies the cache. Collectives are emitted by XLA
+            # from the specs — nothing here names a device.
+            from ..parallel import kv_cache_specs, replicated
+
+            cache_sh = kv_cache_specs(mesh, self.cache)
+            self.cache = jax.device_put(self.cache, cache_sh)
+            rep = replicated(mesh)
+            self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(0,),
+                                        out_shardings=(rep, cache_sh))
+            self._step_jit = jax.jit(self._step_fn, donate_argnums=(0,),
+                                     out_shardings=(rep, cache_sh))
+        else:
+            self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(0,))
+            self._step_jit = jax.jit(self._step_fn, donate_argnums=(0,))
         self._thread = threading.Thread(target=self._loop, name="gofr-tpu-gen",
                                         daemon=True)
         self._thread.start()
