@@ -1,0 +1,27 @@
+"""gRPC server example (reference examples/grpc-server/grpc/server.go:13-23:
+HelloServer.SayHello) plus a server-streaming method the reference cannot
+express (unary-only, SURVEY §3.3)."""
+
+from gofr_tpu import App
+from gofr_tpu.grpcx import GRPCService
+
+app = App()
+hello = GRPCService("hello.HelloService")
+
+
+@hello.unary("SayHello")
+def say_hello(ctx, req):
+    name = (req or {}).get("name") or "World"
+    return {"message": f"Hello {name}!"}
+
+
+@hello.server_stream("Countdown")
+def countdown(ctx, req):
+    for i in range((req or {}).get("from", 3), 0, -1):
+        yield {"tick": i}
+
+
+app.register_grpc_service(hello)
+
+if __name__ == "__main__":
+    app.run()

@@ -1,0 +1,27 @@
+"""Redis-backed REST server (reference examples/http-server-using-redis):
+GET/POST a config value in Redis through the observable client wrapper."""
+
+from gofr_tpu import App
+from gofr_tpu.errors import HTTPError
+
+app = App()
+
+
+@app.post("/redis")
+def set_key(ctx):
+    body = ctx.bind()
+    for key, value in body.items():
+        ctx.redis.set(key, value)
+    return {"stored": sorted(body)}
+
+
+@app.get("/redis/{key}")
+def get_key(ctx):
+    value = ctx.redis.get(ctx.path_param("key"))
+    if value is None:
+        raise HTTPError("key not found", status_code=404)
+    return {"value": value}
+
+
+if __name__ == "__main__":
+    app.run()

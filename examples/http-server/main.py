@@ -1,0 +1,56 @@
+"""REST server with SQL, tracing and an inter-service call.
+
+Mirrors the reference flagship example (examples/http-server/main.go:14-29:
+redis route, trace route, mysql customer routes, service call)."""
+
+from dataclasses import dataclass
+
+from gofr_tpu import App
+
+
+@dataclass
+class Customer:
+    id: int = 0
+    name: str = ""
+
+
+app = App()
+
+
+@app.get("/hello")
+def hello(ctx):
+    name = ctx.param("name") or "World"
+    ctx.logger.info({"event": "hello", "name": name})
+    return f"Hello {name}!"
+
+
+@app.get("/trace")
+def trace(ctx):
+    # nested user spans (reference examples/http-server: c.Trace("traced job"))
+    with ctx.trace("traced-job"):
+        with ctx.trace("inner-span"):
+            pass
+    svc = ctx.get_http_service("anotherService")
+    if svc is not None:
+        svc.get("search", params={"q": "fast"})
+    return "ok"
+
+
+@app.post("/customer/{name}")
+def create_customer(ctx):
+    name = ctx.path_param("name")
+    ctx.sql.execute("INSERT INTO customers (name) VALUES (?)", name)
+    return None
+
+
+@app.get("/customer")
+def list_customers(ctx):
+    return [c.__dict__ for c in
+            ctx.sql.select(Customer, "SELECT id, name FROM customers")]
+
+
+if __name__ == "__main__":
+    app.container.sql.execute(
+        "CREATE TABLE IF NOT EXISTS customers "
+        "(id INTEGER PRIMARY KEY AUTOINCREMENT, name TEXT)")
+    app.run()

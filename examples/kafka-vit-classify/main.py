@@ -1,0 +1,26 @@
+"""Broker-fed batched ViT classification — BASELINE config #4: the
+subscriber loop (one consumer per topic, commit-on-success) feeds images
+into predict_batch, publishing results back. PUBSUB_BACKEND=MEM runs it
+hermetically; KAFKA in production."""
+
+import numpy as np
+
+from gofr_tpu import App
+
+app = App()
+
+
+@app.subscribe("images")
+def classify(ctx):
+    job = ctx.bind()
+    batch = [np.asarray(img, np.float32) for img in job["images"]]
+    probs = ctx.tpu.predict_batch("classify", batch)
+    ctx.get_publisher().publish("classifications", {
+        "job_id": job.get("job_id"),
+        "labels": [int(np.argmax(p)) for p in probs],
+    })
+    return None  # commit
+
+
+if __name__ == "__main__":
+    app.run()

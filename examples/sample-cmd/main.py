@@ -1,0 +1,21 @@
+"""CLI app example (reference examples/sample-cmd): regex-matched
+sub-commands with flag binding, run via ``python main.py hello -name=X``."""
+
+from gofr_tpu import new_cmd
+
+app = new_cmd()
+
+
+@app.sub_command("hello", description="greet by -name")
+def hello(ctx):
+    name = ctx.param("name") or "World"
+    return f"Hello {name}!"
+
+
+@app.sub_command("params", description="echo parsed flags")
+def params(ctx):
+    return {"name": ctx.param("name"), "id": ctx.param("id")}
+
+
+if __name__ == "__main__":
+    raise SystemExit(app.run_command())

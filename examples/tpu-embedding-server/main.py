@@ -1,0 +1,22 @@
+"""BERT embedding endpoint on one chip — BASELINE config #2, the minimum
+end-to-end TPU slice (SURVEY §7 step 4): HTTP route -> coalescing batcher
+-> compiled program -> JSON, with app_tpu_* metrics and device health in
+/.well-known/health. Concurrent requests share device dispatches."""
+
+import numpy as np
+
+from gofr_tpu import App
+
+app = App()  # configs/.env sets TPU_MODEL=bert-base etc.
+
+
+@app.post("/embed")
+def embed(ctx):
+    body = ctx.bind()
+    tokens = np.asarray(body["tokens"], np.int32)
+    vec = ctx.tpu.predict("embed", tokens)
+    return {"embedding": vec.tolist(), "dim": len(vec)}
+
+
+if __name__ == "__main__":
+    app.run()

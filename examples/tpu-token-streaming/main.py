@@ -1,0 +1,39 @@
+"""Llama token streaming — BASELINE config #3: continuous-batching decode
+streamed over BOTH transports: gRPC server-streaming (the reference can't —
+unary-only, SURVEY §3.3) and HTTP chunked responses."""
+
+import json
+
+from gofr_tpu import App
+from gofr_tpu.grpcx import GRPCService
+
+app = App()  # configs/.env selects the llama model + sharding
+
+llm = GRPCService("llm.Generation")
+
+
+@llm.server_stream("Generate")
+def generate_grpc(ctx, req):
+    stream = ctx.tpu.generate(req["tokens"],
+                              max_new_tokens=req.get("max_new_tokens", 64),
+                              temperature=req.get("temperature", 0.0),
+                              eos_id=req.get("eos_id"))
+    for tok in stream:
+        yield {"token": tok}
+
+
+app.register_grpc_service(llm)
+
+
+@app.post("/generate")
+def generate_http(ctx):
+    body = ctx.bind()
+    stream = ctx.tpu.generate(body["tokens"],
+                              max_new_tokens=body.get("max_new_tokens", 64),
+                              temperature=body.get("temperature", 0.0))
+    ctx.stream((json.dumps({"token": t}) + "\n").encode() for t in stream)
+    return None
+
+
+if __name__ == "__main__":
+    app.run()

@@ -1,0 +1,26 @@
+"""Custom metrics example (reference examples/using-custom-metrics/main.go:
+22-28 registers all 4 metric types and records them from handlers)."""
+
+from gofr_tpu import App
+
+app = App()
+m = app.container.metrics
+m.new_counter("transaction_success", "successful transactions")
+m.new_updown_counter("total_credit_day_sale", "net credit sales today")
+m.new_histogram("transaction_time", "transaction duration in seconds",
+                buckets=(0.001, 0.01, 0.1, 1, 5))
+m.new_gauge("product_stock", "current stock level")
+
+
+@app.post("/transaction")
+def transaction(ctx):
+    t = ctx.bind()
+    ctx.metrics.increment_counter("transaction_success")
+    ctx.metrics.record_histogram("transaction_time", t.get("duration", 0.01))
+    ctx.metrics.delta_updown_counter("total_credit_day_sale", t.get("amount", 0))
+    ctx.metrics.set_gauge("product_stock", t.get("stock", 0))
+    return {"recorded": True}
+
+
+if __name__ == "__main__":
+    app.run()

@@ -1,0 +1,24 @@
+"""Inter-service HTTP client example (reference examples/using-http-service):
+a registered downstream service with circuit breaker + health decorators,
+consumed from a handler via ctx.get_http_service."""
+
+from gofr_tpu import App
+from gofr_tpu.service import CircuitBreakerOption, HealthOption
+
+app = App()
+app.add_http_service(
+    "fact-service", "http://numbersapi.com",
+    CircuitBreakerOption(threshold=4, interval=30.0),
+    HealthOption(endpoint="42"),
+)
+
+
+@app.get("/fact")
+def fact(ctx):
+    svc = ctx.get_http_service("fact-service")
+    resp = svc.get(ctx.param("n") or "42")
+    return {"fact": resp.body.decode(errors="replace"), "status": resp.status_code}
+
+
+if __name__ == "__main__":
+    app.run()

@@ -1,0 +1,31 @@
+"""Migrations example (reference examples/using-migrations): ordered,
+run-once schema changes tracked in the gofr_migrations ledger."""
+
+from gofr_tpu import App
+from gofr_tpu.migration import Migrate
+
+MIGRATIONS = {
+    20240101000001: Migrate(
+        up=lambda ds: ds.sql.execute(
+            "CREATE TABLE IF NOT EXISTS employee "
+            "(id INTEGER PRIMARY KEY, name TEXT, dept TEXT)")),
+    20240101000002: Migrate(
+        up=lambda ds: ds.sql.execute(
+            "ALTER TABLE employee ADD COLUMN phone TEXT")),
+}
+
+app = App()
+app.migrate(MIGRATIONS)
+
+
+@app.post("/employee")
+def add_employee(ctx):
+    e = ctx.bind()
+    ctx.sql.execute(
+        "INSERT INTO employee (id, name, dept, phone) VALUES (?, ?, ?, ?)",
+        e["id"], e["name"], e.get("dept", ""), e.get("phone", ""))
+    return None
+
+
+if __name__ == "__main__":
+    app.run()

@@ -1,0 +1,18 @@
+"""Publisher example (reference examples/using-publisher/main.go): POST
+/publish-order fans an order event into the broker configured by
+PUBSUB_BACKEND (MEM for local runs, KAFKA in production)."""
+
+from gofr_tpu import App
+
+app = App()
+
+
+@app.post("/publish-order")
+def publish_order(ctx):
+    order = ctx.bind()
+    ctx.get_publisher().publish("order-logs", order)
+    return {"published": True}
+
+
+if __name__ == "__main__":
+    app.run()

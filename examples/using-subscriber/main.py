@@ -1,0 +1,23 @@
+"""Subscriber example (reference examples/using-subscriber/main.go:8-46):
+one consumer loop per topic; commit-on-success semantics."""
+
+from gofr_tpu import App
+
+app = App()
+
+
+@app.subscribe("order-logs")
+def on_order(ctx):
+    order = ctx.bind()
+    ctx.logger.info({"event": "order received", "order": order})
+    return None  # nil error -> committed
+
+
+@app.subscribe("products")
+def on_product(ctx):
+    ctx.logger.info({"event": "product received", "product": ctx.bind()})
+    return None
+
+
+if __name__ == "__main__":
+    app.run()

@@ -68,6 +68,20 @@ class Context:
             return self.request.header(key, default)
         return default
 
+    # -- streaming (no reference equivalent: the reference has no HTTP
+    # streaming path; needed for token streaming over chunked responses) ----
+    def stream(self, chunks, content_type: str = "application/x-ndjson") -> None:
+        """Write an iterable of ``bytes`` chunks as a live chunked response.
+
+            ctx.stream(json.dumps(x).encode() + b"\\n" for x in items)
+        """
+        if self._responder is None:
+            raise RuntimeError("streaming is only available on HTTP requests")
+        w = self._responder.writer
+        w.set_header("Content-Type", content_type)
+        for chunk in chunks:
+            w.write_chunk(chunk)
+
     # -- tracing (reference context.go:45-51 Trace) --------------------------
     def trace(self, name: str):
         """Context manager opening a user span:
