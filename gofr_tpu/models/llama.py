@@ -21,7 +21,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import causal_attention, decode_attention
+from ..ops.attention import causal_attention, decode_attention_appended
 from ..ops.norms import rms_norm
 from ..ops.quant import qmatmul
 from ..ops.rope import apply_rope, rope_frequencies
@@ -210,45 +210,50 @@ def prefill_kv(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     return _logits(params, cfg, x), k_stack, v_stack, lengths
 
 
-def _cache_write_at(cache_layer: jnp.ndarray, new: jnp.ndarray,
-                    lengths: jnp.ndarray) -> jnp.ndarray:
-    """Write new [B, 1, KV, hd] at per-slot positions ``lengths`` into
-    [B, Smax, KV, hd]."""
-    def write_one(buf, tok, pos):
-        return jax.lax.dynamic_update_slice(buf, tok.astype(buf.dtype), (pos, 0, 0))
-    return jax.vmap(write_one)(cache_layer, new, lengths)
-
-
 def decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                 cache: KVCache, rope_tables=None) -> tuple[jnp.ndarray, KVCache]:
     """One decode step for tokens [B] against the cache.
 
     Returns (logits [B, V] f32, updated cache with lengths+1).
 
+    Decode is HBM-bound, so the cache is READ-ONLY inside the layer scan
+    (scan ``xs`` slicing reads each layer's [B, Smax, KV, hd] in place; the
+    current token's k/v ride alongside via ``decode_attention_appended``),
+    and the per-layer new-token k/v — the only novel data, [L, B, KV, hd] —
+    is written by ONE scatter into the donated buffers after the scan.
+    Emitting updated cache slices as scan outputs instead would rewrite the
+    entire cache every token and dominate the step's HBM traffic.
+
     CAPACITY CONTRACT: callers must ensure ``lengths < cache capacity``
-    before stepping — at capacity the write position clamps and silently
-    overwrites the last KV entry (no data-dependent errors are possible
-    under jit). The serving engine retires slots before they hit capacity.
+    before stepping — at capacity the scatter index is out of range and the
+    write is dropped (JAX scatter OOB semantics; no data-dependent errors
+    are possible under jit). The serving engine retires slots before they
+    hit capacity.
     """
     B = tokens.shape[0]
     cos, sin = rope_tables or get_rope_tables(cfg, cache.k.shape[2])
-    positions = cache.lengths[:, None]  # [B,1] — write position == current length
-    new_lengths = cache.lengths + 1
+    positions = cache.lengths[:, None]  # [B,1] — this token's position
+    lengths = cache.lengths
 
     x = params["embedding"][tokens[:, None]].astype(cfg.jdtype)  # [B,1,D]
 
     def body(x, xs):
         layer_w, k_layer, v_layer = xs
 
-        def kv_write(k, v):
-            return (_cache_write_at(k_layer, k, cache.lengths),
-                    _cache_write_at(v_layer, v, cache.lengths))
+        def attend(q, k_new, v_new):
+            return decode_attention_appended(q, k_layer, v_layer,
+                                             k_new, v_new, lengths)
 
-        def attend(q, k_all, v_all):
-            return decode_attention(q, k_all, v_all, new_lengths)
+        x, kv_tok = _layer(x, layer_w, cfg, cos, sin, positions,
+                           kv_write=lambda k, v: (k, v), attend=attend)
+        return x, kv_tok
 
-        x, kv = _layer(x, layer_w, cfg, cos, sin, positions, kv_write, attend)
-        return x, kv
-
-    x, (k_new, v_new) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
-    return _logits(params, cfg, x[:, 0]), KVCache(k_new, v_new, new_lengths)
+    x, (k_toks, v_toks) = jax.lax.scan(
+        body, x, (params["layers"], cache.k, cache.v))
+    # one scatter for all layers: [L, B, 1, KV, hd] -> cache[:, b, lengths[b]]
+    slots = jnp.arange(B)
+    k_new = cache.k.at[:, slots, lengths].set(
+        k_toks[:, :, 0].astype(cache.k.dtype), mode="drop")
+    v_new = cache.v.at[:, slots, lengths].set(
+        v_toks[:, :, 0].astype(cache.v.dtype), mode="drop")
+    return _logits(params, cfg, x[:, 0]), KVCache(k_new, v_new, lengths + 1)

@@ -75,6 +75,45 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     return out.reshape(b, 1, h, d)
 
 
+def decode_attention_appended(q: jnp.ndarray, k_cache: jnp.ndarray,
+                              v_cache: jnp.ndarray, k_new: jnp.ndarray,
+                              v_new: jnp.ndarray,
+                              lengths: jnp.ndarray) -> jnp.ndarray:
+    """Decode attention over the cache PLUS the current token's k/v, before
+    that token has been written back.
+
+    Mathematically identical to writing the token at position ``lengths``
+    and calling ``decode_attention`` with lengths+1, but lets the serving
+    step keep the cache read-only inside the layer scan (XLA slices it per
+    layer with zero copies) and defer all writes to one post-scan scatter
+    on the donated buffer — the difference between ~roofline decode and
+    rewriting the whole cache every token.
+
+    q: [B, 1, H, D]; k_cache/v_cache: [B, Smax, KV, D];
+    k_new/v_new: [B, 1, KV, D]; lengths: [B] valid entries (EXCLUDING the
+    current token). Returns [B, 1, H, D].
+    """
+    b, _, h, d = q.shape
+    smax = k_cache.shape[1]
+    n_kv = k_cache.shape[2]
+    scale = d ** -0.5
+
+    qg = _repeat_kv_shape(q * scale, n_kv)[:, 0]  # [B,KV,G,D]
+    scores_c = jnp.einsum("bkgd,btkd->bkgt", qg, k_cache,
+                          preferred_element_type=jnp.float32)
+    valid = jnp.arange(smax)[None, :] < lengths[:, None]
+    scores_c = jnp.where(valid[:, None, None, :], scores_c, NEG_INF)
+    scores_s = jnp.einsum("bkgd,btkd->bkgt", qg, k_new,
+                          preferred_element_type=jnp.float32)  # [B,KV,G,1]
+    probs = jax.nn.softmax(jnp.concatenate([scores_c, scores_s], axis=-1),
+                           axis=-1)
+    out = (jnp.einsum("bkgt,btkd->bkgd", probs[..., :smax].astype(v_cache.dtype),
+                      v_cache)
+           + jnp.einsum("bkgt,btkd->bkgd", probs[..., smax:].astype(v_new.dtype),
+                        v_new))
+    return out.reshape(b, 1, h, d)
+
+
 def full_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    mask: jnp.ndarray | None = None) -> jnp.ndarray:
     """Bidirectional attention (BERT/ViT encoders). Shapes as causal_attention."""
