@@ -21,7 +21,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import causal_attention, decode_attention_appended
+from ..ops.attention import (causal_attention, chunk_attention,
+                             decode_attention_appended)
 from ..ops.norms import rms_norm
 from ..ops.quant import qmatmul
 from ..ops.rope import apply_rope, rope_frequencies
@@ -208,6 +209,52 @@ def prefill_kv(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
         params, cfg, tokens, lengths, rope_max or tokens.shape[1],
         rope_tables, constrain=None, collect_kv=True)
     return _logits(params, cfg, x), k_stack, v_stack, lengths
+
+
+def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
+                  cache: KVCache, start, rope_tables=None,
+                  compute_logits: bool = True):
+    """Process a chunk of C prompt tokens at positions [start, start+C)
+    against the growing cache — the long-prompt path (chunked prefill):
+    prompts of any length up to cache capacity run as a sequence of
+    fixed-shape chunk calls, so XLA compiles one program per chunk size
+    instead of one per prompt length.
+
+    Same HBM discipline as decode_step: the cache is read-only inside the
+    layer scan, the chunk's KV [L, B, C, KV, hd] is written afterwards by
+    one dynamic_update_slice per buffer (in place on donated caches).
+
+    ``cache.lengths`` is NOT advanced (padding inside the final chunk makes
+    the true end caller-known only) — callers set lengths once after the
+    last chunk. Returns (logits [B, C, V] f32 — or None when
+    ``compute_logits`` is False, sparing mid-prompt chunks the lm_head
+    matmul — and the cache with KV written).
+    """
+    B, C = tokens.shape
+    cos, sin = rope_tables or get_rope_tables(cfg, cache.k.shape[2])
+    positions = start + jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32),
+                                         (B, C))
+
+    x = params["embedding"][tokens].astype(cfg.jdtype)
+
+    def body(x, xs):
+        layer_w, k_layer, v_layer = xs
+
+        def attend(q, k_new, v_new):
+            return chunk_attention(q, k_layer, v_layer, k_new, v_new, start)
+
+        x, kv = _layer(x, layer_w, cfg, cos, sin, positions,
+                       kv_write=lambda k, v: (k, v), attend=attend)
+        return x, kv
+
+    x, (k_chunk, v_chunk) = jax.lax.scan(
+        body, x, (params["layers"], cache.k, cache.v))
+    k_new = jax.lax.dynamic_update_slice(
+        cache.k, k_chunk.astype(cache.k.dtype), (0, 0, start, 0, 0))
+    v_new = jax.lax.dynamic_update_slice(
+        cache.v, v_chunk.astype(cache.v.dtype), (0, 0, start, 0, 0))
+    logits = _logits(params, cfg, x) if compute_logits else None
+    return logits, KVCache(k_new, v_new, cache.lengths)
 
 
 def decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,

@@ -114,6 +114,43 @@ def decode_attention_appended(q: jnp.ndarray, k_cache: jnp.ndarray,
     return out.reshape(b, 1, h, d)
 
 
+def chunk_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+                    k_new: jnp.ndarray, v_new: jnp.ndarray,
+                    start: jnp.ndarray) -> jnp.ndarray:
+    """Chunked-prefill attention: a block of C new tokens at positions
+    [start, start+C) attends to the cache prefix (positions < start) plus
+    causally within the chunk — the long-prompt path, processing prompts in
+    fixed-size chunks so arbitrary prompt lengths serve from a small
+    lattice of compiled shapes.
+
+    q: [B, C, H, D]; k_cache/v_cache: [B, Smax, KV, D];
+    k_new/v_new: [B, C, KV, D]; start: scalar int32.
+    Trailing padding inside the chunk is harmless: causality means padded
+    positions are never attended BY valid ones. Returns [B, C, H, D].
+    """
+    b, c, h, d = q.shape
+    smax = k_cache.shape[1]
+    n_kv = k_cache.shape[2]
+    scale = d ** -0.5
+
+    qg = _repeat_kv_shape(q * scale, n_kv)  # [B,C,KV,G,D]
+    scores_c = jnp.einsum("bskgd,btkd->bkgst", qg, k_cache,
+                          preferred_element_type=jnp.float32)  # [B,KV,G,C,Smax]
+    in_prefix = jnp.arange(smax)[None, :] < start  # [1,Smax]
+    scores_c = jnp.where(in_prefix[None, None, None], scores_c, NEG_INF)
+    scores_n = jnp.einsum("bskgd,btkd->bkgst", qg, k_new,
+                          preferred_element_type=jnp.float32)  # [B,KV,G,C,C]
+    causal = jnp.tril(jnp.ones((c, c), dtype=bool))
+    scores_n = jnp.where(causal[None, None, None], scores_n, NEG_INF)
+    probs = jax.nn.softmax(
+        jnp.concatenate([scores_c, scores_n], axis=-1), axis=-1)
+    out = (jnp.einsum("bkgst,btkd->bskgd",
+                      probs[..., :smax].astype(v_cache.dtype), v_cache)
+           + jnp.einsum("bkgst,btkd->bskgd",
+                        probs[..., smax:].astype(v_new.dtype), v_new))
+    return out.reshape(b, c, h, d)
+
+
 def full_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    mask: jnp.ndarray | None = None) -> jnp.ndarray:
     """Bidirectional attention (BERT/ViT encoders). Shapes as causal_attention."""

@@ -135,6 +135,10 @@ class GenerationEngine:
         self.total_tokens = 0
         self.total_requests = 0
 
+        import functools
+
+        self._chunk_mid = functools.partial(self._chunk_fn, sample=False)
+        self._chunk_final = functools.partial(self._chunk_fn, sample=True)
         if mesh is not None:
             # ICI-sharded serving (SURVEY §2 last row): KV heads over tp,
             # slots over the data axes. Params carry their own shardings
@@ -151,9 +155,17 @@ class GenerationEngine:
                                         out_shardings=(rep, cache_sh))
             self._step_jit = jax.jit(self._step_fn, donate_argnums=(0,),
                                      out_shardings=(rep, cache_sh))
+            self._chunk_mid_jit = jax.jit(self._chunk_mid, donate_argnums=(0,),
+                                          out_shardings=cache_sh)
+            self._chunk_final_jit = jax.jit(self._chunk_final,
+                                            donate_argnums=(0,),
+                                            out_shardings=(rep, cache_sh))
         else:
             self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(0,))
             self._step_jit = jax.jit(self._step_fn, donate_argnums=(0,))
+            self._chunk_mid_jit = jax.jit(self._chunk_mid, donate_argnums=(0,))
+            self._chunk_final_jit = jax.jit(self._chunk_final,
+                                            donate_argnums=(0,))
         self._thread = threading.Thread(target=self._loop, name="gofr-tpu-gen",
                                         daemon=True)
         self._thread.start()
@@ -181,6 +193,30 @@ class GenerationEngine:
             cache.v, v.astype(cache.v.dtype), (0, slot, 0, 0, 0))
         lengths = cache.lengths.at[slot].set(length)
         last = jnp.take(logits[0], length - 1, axis=0)  # [V] at the true end
+        tok = self._sample(last[None, :], temp[None], key)[0]
+        return tok, llama.KVCache(k_new, v_new, lengths)
+
+    def _chunk_fn(self, cache, params, tokens, start, slot, total_len,
+                  pos_in_chunk, temp, key, sample: bool):
+        """Chunked prefill for prompts longer than the largest bucket:
+        slice the slot's cache view, run one chunk against it, write back.
+        The final chunk (``sample=True``) also sets the slot's cursor to
+        ``total_len`` and samples the first token at ``pos_in_chunk``."""
+        L, _, Smax, KV, hd = cache.k.shape
+        k_slot = jax.lax.dynamic_slice(cache.k, (0, slot, 0, 0, 0),
+                                       (L, 1, Smax, KV, hd))
+        v_slot = jax.lax.dynamic_slice(cache.v, (0, slot, 0, 0, 0),
+                                       (L, 1, Smax, KV, hd))
+        small = llama.KVCache(k_slot, v_slot, jnp.zeros((1,), jnp.int32))
+        logits, small = llama.prefill_chunk(
+            params, self.cfg, tokens, small, start,
+            rope_tables=self.rope_tables, compute_logits=sample)
+        k_new = jax.lax.dynamic_update_slice(cache.k, small.k, (0, slot, 0, 0, 0))
+        v_new = jax.lax.dynamic_update_slice(cache.v, small.v, (0, slot, 0, 0, 0))
+        if not sample:
+            return llama.KVCache(k_new, v_new, cache.lengths)
+        lengths = cache.lengths.at[slot].set(total_len)
+        last = jnp.take(logits[0], pos_in_chunk, axis=0)
         tok = self._sample(last[None, :], temp[None], key)[0]
         return tok, llama.KVCache(k_new, v_new, lengths)
 
