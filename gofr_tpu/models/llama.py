@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from ..ops.attention import (causal_attention, chunk_attention,
                              decode_attention_appended)
 from ..ops.norms import rms_norm
-from ..ops.quant import qmatmul
+from ..ops.quant import qmatmul, quantize_kv
 from ..ops.rope import apply_rope, rope_frequencies
 from .common import ModelConfig, dense_init
 
@@ -50,20 +50,37 @@ def get_rope_tables(cfg: ModelConfig, max_seq: int):
 
 
 class KVCache(NamedTuple):
+    """Preallocated decode cache. ``k``/``v`` are bf16 — or int8 when the
+    per-vector ``k_scale``/``v_scale`` [L, B, Smax, KV] are present (decode
+    is HBM-bound on cache+weight streaming; int8 KV halves the cache half
+    of that traffic — see ops.quant.quantize_kv for the fused-dequant
+    scheme)."""
+
     k: jnp.ndarray        # [L, B, Smax, KV, hd]
     v: jnp.ndarray        # [L, B, Smax, KV, hd]
     lengths: jnp.ndarray  # [B] int32 — valid entries per slot
+    k_scale: jnp.ndarray | None = None  # [L, B, Smax, KV] f32 (int8 caches)
+    v_scale: jnp.ndarray | None = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_seq: int | None = None,
                dtype=None) -> KVCache:
+    """``dtype=jnp.int8`` allocates a quantized cache (with scale planes);
+    anything else is a plain dense cache in that dtype."""
     max_seq = max_seq or cfg.max_seq
     dtype = dtype or cfg.jdtype
     shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    quant = jnp.dtype(dtype) == jnp.int8
     return KVCache(
         k=jnp.zeros(shape, dtype),
         v=jnp.zeros(shape, dtype),
         lengths=jnp.zeros((batch,), jnp.int32),
+        k_scale=jnp.zeros(shape[:-1], jnp.float32) if quant else None,
+        v_scale=jnp.zeros(shape[:-1], jnp.float32) if quant else None,
     )
 
 
@@ -185,11 +202,30 @@ def prefill(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     # k_stack: [L, B, S, KV, hd] -> write into the cache's first S slots
     if S > cache.k.shape[2]:
         raise ValueError(f"prompt length {S} exceeds cache capacity {cache.k.shape[2]}")
-    k_full = jax.lax.dynamic_update_slice(
-        cache.k, k_stack.astype(cache.k.dtype), (0, 0, 0, 0, 0))
-    v_full = jax.lax.dynamic_update_slice(
-        cache.v, v_stack.astype(cache.v.dtype), (0, 0, 0, 0, 0))
-    return _logits(params, cfg, x), KVCache(k_full, v_full, lengths)
+    cache = write_kv(cache, k_stack, v_stack, (0, 0, 0, 0, 0), lengths)
+    return _logits(params, cfg, x), cache
+
+
+def write_kv(cache: KVCache, k_stack, v_stack, index5, lengths) -> KVCache:
+    """Write bf16 KV stacks [L, B', S', KV, hd] into the cache at ``index5``
+    (a 5-tuple of start indices), quantizing on write for int8 caches.
+    Returns the cache with ``lengths`` replaced."""
+    if cache.quantized:
+        qk, sk = quantize_kv(k_stack)
+        qv, sv = quantize_kv(v_stack)
+        return KVCache(
+            k=jax.lax.dynamic_update_slice(cache.k, qk, index5),
+            v=jax.lax.dynamic_update_slice(cache.v, qv, index5),
+            lengths=lengths,
+            k_scale=jax.lax.dynamic_update_slice(cache.k_scale, sk, index5[:-1]),
+            v_scale=jax.lax.dynamic_update_slice(cache.v_scale, sv, index5[:-1]),
+        )
+    return KVCache(
+        k=jax.lax.dynamic_update_slice(
+            cache.k, k_stack.astype(cache.k.dtype), index5),
+        v=jax.lax.dynamic_update_slice(
+            cache.v, v_stack.astype(cache.v.dtype), index5),
+        lengths=lengths)
 
 
 def prefill_kv(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
@@ -238,23 +274,23 @@ def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     x = params["embedding"][tokens].astype(cfg.jdtype)
 
     def body(x, xs):
-        layer_w, k_layer, v_layer = xs
+        layer_w, k_layer, v_layer, ks_layer, vs_layer = xs
 
         def attend(q, k_new, v_new):
-            return chunk_attention(q, k_layer, v_layer, k_new, v_new, start)
+            return chunk_attention(q, k_layer, v_layer, k_new, v_new, start,
+                                   ks_layer, vs_layer)
 
         x, kv = _layer(x, layer_w, cfg, cos, sin, positions,
                        kv_write=lambda k, v: (k, v), attend=attend)
         return x, kv
 
     x, (k_chunk, v_chunk) = jax.lax.scan(
-        body, x, (params["layers"], cache.k, cache.v))
-    k_new = jax.lax.dynamic_update_slice(
-        cache.k, k_chunk.astype(cache.k.dtype), (0, 0, start, 0, 0))
-    v_new = jax.lax.dynamic_update_slice(
-        cache.v, v_chunk.astype(cache.v.dtype), (0, 0, start, 0, 0))
+        body, x, (params["layers"], cache.k, cache.v,
+                  cache.k_scale, cache.v_scale))
+    cache = write_kv(cache, k_chunk, v_chunk, (0, 0, start, 0, 0),
+                     cache.lengths)
     logits = _logits(params, cfg, x) if compute_logits else None
-    return logits, KVCache(k_new, v_new, cache.lengths)
+    return logits, cache
 
 
 def decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
@@ -285,22 +321,37 @@ def decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     x = params["embedding"][tokens[:, None]].astype(cfg.jdtype)  # [B,1,D]
 
     def body(x, xs):
-        layer_w, k_layer, v_layer = xs
+        layer_w, k_layer, v_layer, ks_layer, vs_layer = xs
 
         def attend(q, k_new, v_new):
             return decode_attention_appended(q, k_layer, v_layer,
-                                             k_new, v_new, lengths)
+                                             k_new, v_new, lengths,
+                                             ks_layer, vs_layer)
 
         x, kv_tok = _layer(x, layer_w, cfg, cos, sin, positions,
                            kv_write=lambda k, v: (k, v), attend=attend)
         return x, kv_tok
 
     x, (k_toks, v_toks) = jax.lax.scan(
-        body, x, (params["layers"], cache.k, cache.v))
+        body, x, (params["layers"], cache.k, cache.v,
+                  cache.k_scale, cache.v_scale))
     # one scatter for all layers: [L, B, 1, KV, hd] -> cache[:, b, lengths[b]]
     slots = jnp.arange(B)
-    k_new = cache.k.at[:, slots, lengths].set(
-        k_toks[:, :, 0].astype(cache.k.dtype), mode="drop")
-    v_new = cache.v.at[:, slots, lengths].set(
-        v_toks[:, :, 0].astype(cache.v.dtype), mode="drop")
-    return _logits(params, cfg, x[:, 0]), KVCache(k_new, v_new, lengths + 1)
+    k_tok, v_tok = k_toks[:, :, 0], v_toks[:, :, 0]  # [L, B, KV, hd]
+    if cache.quantized:
+        qk, sk = quantize_kv(k_tok)
+        qv, sv = quantize_kv(v_tok)
+        new = KVCache(
+            k=cache.k.at[:, slots, lengths].set(qk, mode="drop"),
+            v=cache.v.at[:, slots, lengths].set(qv, mode="drop"),
+            lengths=lengths + 1,
+            k_scale=cache.k_scale.at[:, slots, lengths].set(sk, mode="drop"),
+            v_scale=cache.v_scale.at[:, slots, lengths].set(sv, mode="drop"))
+    else:
+        new = KVCache(
+            k=cache.k.at[:, slots, lengths].set(
+                k_tok.astype(cache.k.dtype), mode="drop"),
+            v=cache.v.at[:, slots, lengths].set(
+                v_tok.astype(cache.v.dtype), mode="drop"),
+            lengths=lengths + 1)
+    return _logits(params, cfg, x[:, 0]), new

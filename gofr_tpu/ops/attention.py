@@ -77,8 +77,9 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
 
 def decode_attention_appended(q: jnp.ndarray, k_cache: jnp.ndarray,
                               v_cache: jnp.ndarray, k_new: jnp.ndarray,
-                              v_new: jnp.ndarray,
-                              lengths: jnp.ndarray) -> jnp.ndarray:
+                              v_new: jnp.ndarray, lengths: jnp.ndarray,
+                              k_scale: jnp.ndarray | None = None,
+                              v_scale: jnp.ndarray | None = None) -> jnp.ndarray:
     """Decode attention over the cache PLUS the current token's k/v, before
     that token has been written back.
 
@@ -92,6 +93,14 @@ def decode_attention_appended(q: jnp.ndarray, k_cache: jnp.ndarray,
     q: [B, 1, H, D]; k_cache/v_cache: [B, Smax, KV, D];
     k_new/v_new: [B, 1, KV, D]; lengths: [B] valid entries (EXCLUDING the
     current token). Returns [B, 1, H, D].
+
+    INT8 cache: when ``k_scale``/``v_scale`` [B, Smax, KV] are given the
+    cache tensors are per-vector int8 (ops.quant.quantize_kv). The scale is
+    constant over the contracted head_dim, so it is applied to the SCORES
+    (k side) and folded into the probabilities (v side) — both tiny
+    [B,KV,G,Smax] tensors — and the int8->bf16 upcast fuses into the
+    einsum: the cache is never materialized in bf16, halving decode's
+    dominant HBM stream. k_new/v_new stay bf16 (fresh this step).
     """
     b, _, h, d = q.shape
     smax = k_cache.shape[1]
@@ -99,16 +108,23 @@ def decode_attention_appended(q: jnp.ndarray, k_cache: jnp.ndarray,
     scale = d ** -0.5
 
     qg = _repeat_kv_shape(q * scale, n_kv)[:, 0]  # [B,KV,G,D]
-    scores_c = jnp.einsum("bkgd,btkd->bkgt", qg, k_cache,
+    scores_c = jnp.einsum("bkgd,btkd->bkgt", qg, k_cache.astype(qg.dtype),
                           preferred_element_type=jnp.float32)
+    if k_scale is not None:
+        # k_scale [B,Smax,KV] -> [B,KV,1,Smax] to match scores [B,KV,G,Smax]
+        scores_c = scores_c * jnp.transpose(k_scale, (0, 2, 1))[:, :, None, :]
     valid = jnp.arange(smax)[None, :] < lengths[:, None]
     scores_c = jnp.where(valid[:, None, None, :], scores_c, NEG_INF)
     scores_s = jnp.einsum("bkgd,btkd->bkgt", qg, k_new,
                           preferred_element_type=jnp.float32)  # [B,KV,G,1]
     probs = jax.nn.softmax(jnp.concatenate([scores_c, scores_s], axis=-1),
                            axis=-1)
-    out = (jnp.einsum("bkgt,btkd->bkgd", probs[..., :smax].astype(v_cache.dtype),
-                      v_cache)
+    probs_c = probs[..., :smax]
+    if v_scale is not None:
+        probs_c = probs_c * jnp.transpose(v_scale, (0, 2, 1))[:, :, None, :]
+    vdt = q.dtype if v_scale is not None else v_cache.dtype
+    out = (jnp.einsum("bkgt,btkd->bkgd", probs_c.astype(vdt),
+                      v_cache.astype(vdt))
            + jnp.einsum("bkgt,btkd->bkgd", probs[..., smax:].astype(v_new.dtype),
                         v_new))
     return out.reshape(b, 1, h, d)
@@ -116,7 +132,9 @@ def decode_attention_appended(q: jnp.ndarray, k_cache: jnp.ndarray,
 
 def chunk_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
                     k_new: jnp.ndarray, v_new: jnp.ndarray,
-                    start: jnp.ndarray) -> jnp.ndarray:
+                    start: jnp.ndarray,
+                    k_scale: jnp.ndarray | None = None,
+                    v_scale: jnp.ndarray | None = None) -> jnp.ndarray:
     """Chunked-prefill attention: a block of C new tokens at positions
     [start, start+C) attends to the cache prefix (positions < start) plus
     causally within the chunk — the long-prompt path, processing prompts in
@@ -125,6 +143,8 @@ def chunk_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
 
     q: [B, C, H, D]; k_cache/v_cache: [B, Smax, KV, D];
     k_new/v_new: [B, C, KV, D]; start: scalar int32.
+    ``k_scale``/``v_scale`` [B, Smax, KV]: per-vector scales for int8
+    caches (see decode_attention_appended — same fused-dequant scheme).
     Trailing padding inside the chunk is harmless: causality means padded
     positions are never attended BY valid ones. Returns [B, C, H, D].
     """
@@ -134,8 +154,10 @@ def chunk_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     scale = d ** -0.5
 
     qg = _repeat_kv_shape(q * scale, n_kv)  # [B,C,KV,G,D]
-    scores_c = jnp.einsum("bskgd,btkd->bkgst", qg, k_cache,
+    scores_c = jnp.einsum("bskgd,btkd->bkgst", qg, k_cache.astype(qg.dtype),
                           preferred_element_type=jnp.float32)  # [B,KV,G,C,Smax]
+    if k_scale is not None:
+        scores_c = scores_c * jnp.transpose(k_scale, (0, 2, 1))[:, :, None, None, :]
     in_prefix = jnp.arange(smax)[None, :] < start  # [1,Smax]
     scores_c = jnp.where(in_prefix[None, None, None], scores_c, NEG_INF)
     scores_n = jnp.einsum("bskgd,btkd->bkgst", qg, k_new,
@@ -144,8 +166,12 @@ def chunk_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     scores_n = jnp.where(causal[None, None, None], scores_n, NEG_INF)
     probs = jax.nn.softmax(
         jnp.concatenate([scores_c, scores_n], axis=-1), axis=-1)
+    probs_c = probs[..., :smax]
+    if v_scale is not None:
+        probs_c = probs_c * jnp.transpose(v_scale, (0, 2, 1))[:, :, None, None, :]
+    vdt = q.dtype if v_scale is not None else v_cache.dtype
     out = (jnp.einsum("bkgst,btkd->bskgd",
-                      probs[..., :smax].astype(v_cache.dtype), v_cache)
+                      probs_c.astype(vdt), v_cache.astype(vdt))
            + jnp.einsum("bkgst,btkd->bskgd",
                         probs[..., smax:].astype(v_new.dtype), v_new))
     return out.reshape(b, c, h, d)

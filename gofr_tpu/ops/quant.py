@@ -52,6 +52,31 @@ def dequantize(qw: QuantizedLinear, dtype=jnp.bfloat16) -> jnp.ndarray:
     return (qw.w.astype(jnp.float32) * qw.scale).astype(dtype)
 
 
+def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-vector symmetric int8 over the LAST axis (the head_dim of a
+    K/V tensor): x [..., hd] -> (int8 [..., hd], f32 scale [...]).
+
+    This is the KV-cache quantizer: decode attention streams the whole
+    valid cache every step, so int8 storage halves that HBM traffic. One
+    scale per (position, head) vector keeps the dequant a cheap rank-1
+    broadcast that XLA fuses into the attention einsum — scores and
+    weighted sums apply the scale AFTER the contraction (it is constant
+    over the contracted head_dim axis), so the MXU sees int8 data upcast
+    in-register, never a materialized bf16 copy of the cache.
+    """
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(absmax / 127.0, 1e-10)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray,
+                  dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Inverse of quantize_kv (test oracle / slow path)."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def maybe_quantize_tree(params, quantize: bool, *, min_size: int = 1 << 16):
     """Quantize projection-weight leaves: plain [in, out] 2-D mats and
     stacked [L, in, out] 3-D layer mats (reduce over the ``in`` axis either

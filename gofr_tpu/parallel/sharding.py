@@ -150,13 +150,23 @@ def activation_constraint(mesh: Mesh) -> Callable:
 
 def kv_cache_specs(mesh: Mesh, cache) -> Any:
     """Shardings for a models.llama.KVCache: [L, B, Smax, KV, hd] — batch
-    over data axes, kv-heads over tp, everything else local."""
+    over data axes, kv-heads over tp, everything else local. Int8 caches
+    carry per-vector scale planes [L, B, Smax, KV] that shard identically
+    (same axes minus head_dim)."""
     kv = P(None, DATA_AXES, None, AXIS_TP, None)
+    sc = P(None, DATA_AXES, None, AXIS_TP)
     ln = P(DATA_AXES)
+
+    def fit(spec, leaf):
+        return NamedSharding(mesh, fit_spec(spec, leaf.shape, mesh))
+
+    quant = getattr(cache, "k_scale", None) is not None
     return type(cache)(
-        k=NamedSharding(mesh, fit_spec(kv, cache.k.shape, mesh)),
-        v=NamedSharding(mesh, fit_spec(kv, cache.v.shape, mesh)),
-        lengths=NamedSharding(mesh, fit_spec(ln, cache.lengths.shape, mesh)),
+        k=fit(kv, cache.k),
+        v=fit(kv, cache.v),
+        lengths=fit(ln, cache.lengths),
+        k_scale=fit(sc, cache.k_scale) if quant else None,
+        v_scale=fit(sc, cache.v_scale) if quant else None,
     )
 
 

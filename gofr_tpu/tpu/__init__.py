@@ -13,7 +13,14 @@ Config keys (reference config style, pkg/gofr/config/config.go:3):
   TPU_WEIGHTS         checkpoint path (.npz or orbax dir); absent = random
                       init (smoke/serving-bringup mode)
   TPU_QUANT           "int8" to quantize projection weights on load
-  TPU_SLOTS           decode batch slots for generation (default 8)
+  TPU_KV_DTYPE        KV-cache dtype for generation: "int8" (default —
+                      halves decode's cache HBM stream; quantize-on-write,
+                      dequant fused into attention) or "bf16"/"model" for
+                      the exact dense cache
+  TPU_SLOTS           decode batch slots for generation (default 48 —
+                      decode streams the full weight set per step, so
+                      throughput scales with tokens per weight pass until
+                      HBM runs out; shrink for small-HBM chips)
   TPU_MAX_SEQ         serving KV capacity (default min(model max, 2048))
   TPU_BATCH_BUCKETS   csv of predict batch buckets (default 1,2,4,8)
   TPU_SEQ_BUCKETS     csv of token-length buckets  (default 32..512)
@@ -125,11 +132,13 @@ def new_engine_from_config(cfg, logger=None, metrics=None) -> TPUEngine:
                            f"{sorted(LLAMA_CONFIGS) + sorted(BERT_CONFIGS) + sorted(VIT_CONFIGS)}")
         params = params_for(mc, llama.init)
         max_seq = cfg.get_int("TPU_MAX_SEQ", min(mc.max_seq, 2048))
-        slots = cfg.get_int("TPU_SLOTS", 8)
+        slots = cfg.get_int("TPU_SLOTS", 48)
+        kv_choice = (cfg.get("TPU_KV_DTYPE") or "int8").lower()
+        kv_dtype = jnp.int8 if kv_choice == "int8" else None
         prompt_b = tuple(b for b in seq_buckets if b < max_seq) or (max_seq // 2,)
         engine.generator = GenerationEngine(
             mc, params, slots=slots, max_seq=max_seq, prompt_buckets=prompt_b,
-            logger=logger, metrics=metrics, mesh=mesh)
+            logger=logger, metrics=metrics, mesh=mesh, kv_dtype=kv_dtype)
 
         # scoring program: next-token logits at the prompt end (the
         # non-streaming sibling of generate, e.g. for classification heads)

@@ -106,7 +106,8 @@ class GenerationEngine:
     def __init__(self, cfg: ModelConfig, params: Any, *, slots: int = 8,
                  max_seq: int | None = None,
                  prompt_buckets: tuple[int, ...] = (32, 64, 128, 256, 512),
-                 logger=None, metrics=None, seed: int = 0, mesh=None):
+                 logger=None, metrics=None, seed: int = 0, mesh=None,
+                 kv_dtype=None):
         self.cfg = cfg
         self.params = params
         self.n_slots = slots
@@ -118,7 +119,11 @@ class GenerationEngine:
         self.mesh = mesh
         self.rope_tables = llama.get_rope_tables(cfg, self.max_seq)
 
-        self.cache = llama.init_cache(cfg, slots, self.max_seq)
+        # kv_dtype=jnp.int8 halves decode's cache HBM stream (quantize on
+        # write, dequant fused into attention) — the default for serving
+        # big models; None keeps the model dtype (exact numerics).
+        self.cache = llama.init_cache(cfg, slots, self.max_seq,
+                                      dtype=kv_dtype)
         self._slots = [_Slot() for _ in range(slots)]
         self._last_tokens = np.zeros((slots,), np.int32)
         self._active = np.zeros((slots,), bool)
@@ -187,14 +192,11 @@ class GenerationEngine:
         logits, k, v, _ = llama.prefill_kv(
             params, self.cfg, tokens, jnp.asarray([length]),
             rope_max=self.max_seq, rope_tables=self.rope_tables)
-        k_new = jax.lax.dynamic_update_slice(
-            cache.k, k.astype(cache.k.dtype), (0, slot, 0, 0, 0))
-        v_new = jax.lax.dynamic_update_slice(
-            cache.v, v.astype(cache.v.dtype), (0, slot, 0, 0, 0))
         lengths = cache.lengths.at[slot].set(length)
+        cache = llama.write_kv(cache, k, v, (0, slot, 0, 0, 0), lengths)
         last = jnp.take(logits[0], length - 1, axis=0)  # [V] at the true end
         tok = self._sample(last[None, :], temp[None], key)[0]
-        return tok, llama.KVCache(k_new, v_new, lengths)
+        return tok, cache
 
     def _chunk_fn(self, cache, params, tokens, start, slot, total_len,
                   pos_in_chunk, temp, key, sample: bool):
@@ -203,22 +205,33 @@ class GenerationEngine:
         The final chunk (``sample=True``) also sets the slot's cursor to
         ``total_len`` and samples the first token at ``pos_in_chunk``."""
         L, _, Smax, KV, hd = cache.k.shape
-        k_slot = jax.lax.dynamic_slice(cache.k, (0, slot, 0, 0, 0),
-                                       (L, 1, Smax, KV, hd))
-        v_slot = jax.lax.dynamic_slice(cache.v, (0, slot, 0, 0, 0),
-                                       (L, 1, Smax, KV, hd))
-        small = llama.KVCache(k_slot, v_slot, jnp.zeros((1,), jnp.int32))
+        quant = cache.quantized
+
+        def slot_view(a, rank5: bool):
+            size = (L, 1, Smax, KV, hd) if rank5 else (L, 1, Smax, KV)
+            idx = (0, slot, 0, 0, 0)[: len(size)]
+            return jax.lax.dynamic_slice(a, idx, size)
+
+        small = llama.KVCache(
+            slot_view(cache.k, True), slot_view(cache.v, True),
+            jnp.zeros((1,), jnp.int32),
+            slot_view(cache.k_scale, False) if quant else None,
+            slot_view(cache.v_scale, False) if quant else None)
         logits, small = llama.prefill_chunk(
             params, self.cfg, tokens, small, start,
             rope_tables=self.rope_tables, compute_logits=sample)
         k_new = jax.lax.dynamic_update_slice(cache.k, small.k, (0, slot, 0, 0, 0))
         v_new = jax.lax.dynamic_update_slice(cache.v, small.v, (0, slot, 0, 0, 0))
+        ks, vs = cache.k_scale, cache.v_scale
+        if quant:
+            ks = jax.lax.dynamic_update_slice(ks, small.k_scale, (0, slot, 0, 0))
+            vs = jax.lax.dynamic_update_slice(vs, small.v_scale, (0, slot, 0, 0))
         if not sample:
-            return llama.KVCache(k_new, v_new, cache.lengths)
+            return llama.KVCache(k_new, v_new, cache.lengths, ks, vs)
         lengths = cache.lengths.at[slot].set(total_len)
         last = jnp.take(logits[0], pos_in_chunk, axis=0)
         tok = self._sample(last[None, :], temp[None], key)[0]
-        return tok, llama.KVCache(k_new, v_new, lengths)
+        return tok, llama.KVCache(k_new, v_new, lengths, ks, vs)
 
     def _step_fn(self, cache, params, last_tokens, active, temps, key):
         """One decode step over all slots; inactive cursors frozen."""
@@ -226,7 +239,7 @@ class GenerationEngine:
                                             cache, rope_tables=self.rope_tables)
         lengths = jnp.where(active, stepped.lengths, cache.lengths)
         toks = self._sample(logits, temps, key)
-        return toks, llama.KVCache(stepped.k, stepped.v, lengths)
+        return toks, stepped._replace(lengths=lengths)
 
     # -- public API ----------------------------------------------------------
     def generate(self, prompt, max_new_tokens: int = 128,

@@ -1,4 +1,7 @@
-"""Driver-contract checks: entry() compiles, dryrun_multichip executes."""
+"""Driver-contract checks: entry() compiles, dryrun_multichip executes
+inside the driver's wall-clock budget."""
+
+import time
 
 import jax
 
@@ -11,8 +14,27 @@ def test_entry_jits():
     assert out.shape == (2, 64, graft._SMOKE.vocab_size)
 
 
-def test_dryrun_multichip_8():
+def test_dryrun_multichip_8_within_budget():
+    # The driver runs dryrun_multichip(8) with a hard timeout on a slow
+    # virtual-CPU box (~1 core). Round 1 timed out there (MULTICHIP_r01
+    # rc=124); the budget assertion keeps the dryrun honest. The bound is
+    # machine-dependent by nature — override GOFR_DRYRUN_BUDGET_S on
+    # slower CI boxes (the driver's real cap is 120 s on its own box).
+    import os
+    budget = float(os.environ.get("GOFR_DRYRUN_BUDGET_S", "90"))
+    t0 = time.time()
     graft.dryrun_multichip(8)
+    took = time.time() - t0
+    assert took < budget, f"dryrun_multichip(8) took {took:.0f}s > {budget:.0f}s"
+
+
+def test_dryrun_plan_has_no_sp():
+    # sp resharding is GSPMD-hostile on the CPU mesh (involuntary full
+    # rematerialization) — the dryrun plan must never put a factor on it.
+    for n in (2, 4, 8, 16):
+        plan = graft._plan_for(n)
+        assert plan.sp == 1
+        assert plan.n_devices == n
 
 
 def test_dryrun_multichip_2():

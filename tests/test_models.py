@@ -71,6 +71,56 @@ def test_llama_quantized_decode_is_close():
     assert corr > 0.99
 
 
+def test_int8_kv_cache_decode_matches_bf16():
+    """The quantized KV cache (quantize-on-write, dequant fused into
+    attention) must track the dense cache: greedy tokens equal, logits
+    within int8 tolerance, and the cursor/scale planes maintained."""
+    params = llama.init(TINY, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                TINY.vocab_size)
+    dense = llama.init_cache(TINY, 2, 16)
+    quant = llama.init_cache(TINY, 2, 16, dtype=jnp.int8)
+    assert quant.quantized and quant.k.dtype == jnp.int8
+    assert quant.k_scale.shape == quant.k.shape[:-1]
+
+    ld, dense = llama.prefill(params, TINY, tokens, dense)
+    lq, quant = llama.prefill(params, TINY, tokens, quant)
+    # prefill logits come from activations, not the cache: exact match
+    np.testing.assert_allclose(np.asarray(ld), np.asarray(lq),
+                               rtol=1e-5, atol=1e-5)
+    for t in [3, 1, 4]:
+        step = jnp.full((2,), t, jnp.int32)
+        dd, dense = llama.decode_step(params, TINY, step, dense)
+        dq, quant = llama.decode_step(params, TINY, step, quant)
+        assert np.array_equal(np.argmax(dd, -1), np.argmax(dq, -1))
+        assert float(np.abs(np.asarray(dd) - np.asarray(dq)).max()) < 0.15
+    assert list(quant.lengths) == [11, 11]
+
+
+def test_int8_kv_cache_chunked_prefill():
+    """Chunked prefill through an int8 cache matches whole-prompt prefill
+    (the long-prompt admission path with the production cache dtype)."""
+    params = llama.init(TINY, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 8), 0,
+                                TINY.vocab_size)
+    whole = llama.init_cache(TINY, 1, 16, dtype=jnp.int8)
+    lw, whole = llama.prefill(params, TINY, tokens, whole)
+
+    chunked = llama.init_cache(TINY, 1, 16, dtype=jnp.int8)
+    _, chunked = llama.prefill_chunk(params, TINY, tokens[:, :4], chunked,
+                                     0, compute_logits=False)
+    lg, chunked = llama.prefill_chunk(params, TINY, tokens[:, 4:], chunked, 4)
+    assert float(np.abs(np.asarray(lg) - np.asarray(lw[:, 4:])).max()) < 0.15
+    # stored K must match between the two admission paths (dequantized —
+    # float summation order may flip an odd int8 bucket by one)
+    from gofr_tpu.ops.quant import dequantize_kv
+    dq_chunk = np.asarray(dequantize_kv(chunked.k, chunked.k_scale,
+                                        jnp.float32))[:, :, :8]
+    dq_whole = np.asarray(dequantize_kv(whole.k, whole.k_scale,
+                                        jnp.float32))[:, :, :8]
+    np.testing.assert_allclose(dq_chunk, dq_whole, atol=5e-2)
+
+
 def test_llama_jit_decode_no_retrace():
     params = llama.init(TINY, jax.random.PRNGKey(0))
     cache = llama.init_cache(TINY, 2, 16)
