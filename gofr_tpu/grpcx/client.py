@@ -103,9 +103,10 @@ class GRPCChannel:
                     with self._lock:
                         for c in self._calls.values():
                             c.send_window.adjust(delta)
-                if (settings.get(h2.SETTINGS_HEADER_TABLE_SIZE, 4096) < 4096):
+                if h2.SETTINGS_HEADER_TABLE_SIZE in settings:
                     with self._enc_lock:
-                        self.encoder.indexing = False
+                        self.encoder.set_max_table_size(
+                            settings[h2.SETTINGS_HEADER_TABLE_SIZE])
                 self.io.send_frame(h2.SETTINGS, h2.FLAG_ACK, 0)
         elif f.type == h2.HEADERS:
             self._on_headers(f)
@@ -135,6 +136,20 @@ class GRPCChannel:
     def _pop_call(self, sid: int) -> _Call | None:
         with self._lock:
             return self._calls.pop(sid, None)
+
+    def _cancel_call(self, call: _Call) -> None:
+        """Release a call the consumer abandoned (iterator dropped, timeout,
+        deserialization error): RST_STREAM(CANCEL) tells the server to stop
+        generating into the dead stream, and popping the entry stops it
+        consuming window credit. No-op if the call already finished."""
+        if self._pop_call(call.sid) is None:
+            return
+        try:
+            self.io.send_frame(h2.RST_STREAM, 0, call.sid,
+                               h2.CANCEL.to_bytes(4, "big"))
+        except OSError:
+            pass  # connection already gone — nothing to release
+        call.done.set()
 
     def _on_headers(self, f: h2.Frame) -> None:
         call = self._calls.get(f.stream_id)
@@ -237,17 +252,20 @@ class GRPCChannel:
         response_codec = response_codec or codec
         call = self._start_call(method, codec.serialize(request), timeout,
                                 metadata)
-        msg = _q_get(call.q, timeout)
-        if isinstance(msg, svc.GRPCError):
-            raise msg
-        if msg is None:
-            raise svc.GRPCError(svc.UNAVAILABLE,
-                                f"connection lost: {self._error!r}")
-        # drain trailers sentinel
-        tail = _q_get(call.q, timeout)
-        if isinstance(tail, svc.GRPCError):
-            raise tail
-        return response_codec.deserialize(msg)
+        try:
+            msg = _q_get(call.q, timeout)
+            if isinstance(msg, svc.GRPCError):
+                raise msg
+            if msg is None:
+                raise svc.GRPCError(svc.UNAVAILABLE,
+                                    f"connection lost: {self._error!r}")
+            # drain trailers sentinel
+            tail = _q_get(call.q, timeout)
+            if isinstance(tail, svc.GRPCError):
+                raise tail
+            return response_codec.deserialize(msg)
+        finally:
+            self._cancel_call(call)  # no-op unless the call is still open
 
     def server_stream(self, method: str, request, *, codec=None,
                       response_codec=None, timeout: float | None = 60.0,
@@ -257,16 +275,21 @@ class GRPCChannel:
         response_codec = response_codec or codec
         call = self._start_call(method, codec.serialize(request), timeout,
                                 metadata)
-        while True:
-            msg = _q_get(call.q, timeout)
-            if isinstance(msg, svc.GRPCError):
-                raise msg
-            if msg is None:
-                if not call.done.is_set() and self._error is not None:
-                    raise svc.GRPCError(svc.UNAVAILABLE,
-                                        f"connection lost: {self._error!r}")
-                return
-            yield response_codec.deserialize(msg)
+        try:
+            while True:
+                msg = _q_get(call.q, timeout)
+                if isinstance(msg, svc.GRPCError):
+                    raise msg
+                if msg is None:
+                    if not call.done.is_set() and self._error is not None:
+                        raise svc.GRPCError(svc.UNAVAILABLE,
+                                            f"connection lost: {self._error!r}")
+                    return
+                yield response_codec.deserialize(msg)
+        finally:
+            # GeneratorExit (consumer stopped iterating), _q_get timeout, or
+            # any downstream error: cancel so the server releases its slot
+            self._cancel_call(call)
 
     def close(self) -> None:
         self._closed = True

@@ -262,13 +262,33 @@ class Encoder:
     def __init__(self, max_table_size: int = 4096):
         self.table = _DynamicTable(max_table_size)
         self.huffman = True
-        # When the peer advertises a header table smaller than ours, drop to
-        # literal-without-indexing (§6.2.2) instead of emitting table-size
-        # update bookkeeping — always RFC-valid, marginally less compact.
         self.indexing = True
+        self._pending_size_update: int | None = None
+        self._pending_size_min: int | None = None
+
+    def set_max_table_size(self, size: int) -> None:
+        """Apply the peer's SETTINGS_HEADER_TABLE_SIZE: shrink our encoding
+        table to fit and schedule the §6.3 dynamic-table-size update that
+        must open the next header block (RFC 7541 §4.2). Entries over the
+        new size are evicted here, so find() can never emit an indexed
+        reference the peer's shrunken table cannot resolve. Several changes
+        between header blocks track the MINIMUM too — §4.2 requires the
+        smallest intermediate size be signaled (so a shrink-then-grow still
+        flushes the peer's table) before the final one."""
+        size = min(size, self.table.cap)
+        self.table.resize(size)
+        self._pending_size_min = (size if self._pending_size_min is None
+                                  else min(self._pending_size_min, size))
+        self._pending_size_update = size
 
     def encode(self, headers) -> bytes:
         out = bytearray()
+        if self._pending_size_update is not None:
+            if self._pending_size_min < self._pending_size_update:
+                out.extend(encode_int(self._pending_size_min, 5, 0x20))
+            out.extend(encode_int(self._pending_size_update, 5, 0x20))
+            self._pending_size_update = None
+            self._pending_size_min = None
         for name, value in headers:
             name, value = _norm(name).lower(), _norm(value)
             idx, exact = self.table.find(name, value)

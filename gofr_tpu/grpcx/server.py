@@ -154,9 +154,9 @@ class _Connection:
         if h2.SETTINGS_MAX_FRAME_SIZE in settings:
             self.io.peer_max_frame = settings[h2.SETTINGS_MAX_FRAME_SIZE]
         if h2.SETTINGS_HEADER_TABLE_SIZE in settings:
-            if settings[h2.SETTINGS_HEADER_TABLE_SIZE] < 4096:
-                with self._enc_lock:
-                    self.encoder.indexing = False
+            with self._enc_lock:
+                self.encoder.set_max_table_size(
+                    settings[h2.SETTINGS_HEADER_TABLE_SIZE])
         if h2.SETTINGS_INITIAL_WINDOW_SIZE in settings:
             new = settings[h2.SETTINGS_INITIAL_WINDOW_SIZE]
             if new > h2.MAX_WINDOW:

@@ -181,6 +181,11 @@ class Manager:
                         for c in raw[:-1]:
                             cum += c
                             counts.append(cum)
+                        # +Inf/_count from the SAME snapshot's buckets (incl.
+                        # overflow), not the independent count atomic: a
+                        # scrape racing record() must never show a le-bucket
+                        # above +Inf (Prometheus monotonicity).
+                        count = cum + raw[-1]
                     else:
                         counts, total, count = val  # type: ignore[misc]
                     cum = 0

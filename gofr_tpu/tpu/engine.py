@@ -207,8 +207,9 @@ class TPUEngine:
         for i in range(0, len(items), prog.max_batch):
             out.extend(self._run_batch(prog, items[i : i + prog.max_batch]))
         if self.metrics is not None:
-            self.metrics.increment_counter("app_tpu_requests_total",
-                                           program=program)
+            for _ in items:  # one request per ITEM (the unit predict counts)
+                self.metrics.increment_counter("app_tpu_requests_total",
+                                               program=program)
         return out
 
     def _validate_item(self, prog: Program, item: Any) -> None:
@@ -289,6 +290,9 @@ class TPUEngine:
         if self.generator is not None:
             details["generator"] = self.generator.stats()
         if self._closed:
+            return Health(STATUS_DOWN, details)
+        if self.generator is not None and self.generator.down is not None:
+            # device loop bricked (donated cache lost and unrecoverable)
             return Health(STATUS_DOWN, details)
         # A live engine with no programs can't serve yet.
         status = STATUS_UP if (self._programs or self.generator) else STATUS_DEGRADED

@@ -122,6 +122,9 @@ class GenerationEngine:
         # kv_dtype=jnp.int8 halves decode's cache HBM stream (quantize on
         # write, dequant fused into attention) — the default for serving
         # big models; None keeps the model dtype (exact numerics).
+        self._kv_dtype = kv_dtype
+        self._cache_sh = None  # set below for mesh engines
+        self.down: str | None = None  # set when the device loop is bricked
         self.cache = llama.init_cache(cfg, slots, self.max_seq,
                                       dtype=kv_dtype)
         self._slots = [_Slot() for _ in range(slots)]
@@ -154,6 +157,7 @@ class GenerationEngine:
             from ..parallel import kv_cache_specs, replicated
 
             cache_sh = kv_cache_specs(mesh, self.cache)
+            self._cache_sh = cache_sh
             self.cache = jax.device_put(self.cache, cache_sh)
             rep = replicated(mesh)
             self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(0,),
@@ -248,6 +252,8 @@ class GenerationEngine:
         yielding generated ids as the device produces them."""
         if self._closed:
             raise GenerationError("generation engine is closed")
+        if self.down is not None:
+            raise GenerationError(f"generation engine is down: {self.down}")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         stream = GenStream(next(_REQ_IDS), self)
         stream.prompt_len = len(prompt)
@@ -255,7 +261,10 @@ class GenerationEngine:
             stream._q.put(GenerationError("empty prompt"))
             stream._q.put(None)
             return stream
-        limit = min(self.prompt_buckets[-1], self.max_seq - 1)
+        # Prompts longer than the largest bucket run through chunked
+        # prefill at admission (see _start); the only hard limit is cache
+        # capacity minus one position for the first generated token.
+        limit = self.max_seq - 1
         if len(prompt) > limit:
             stream._q.put(GenerationError(
                 f"prompt length {len(prompt)} exceeds serving limit {limit}"))
@@ -270,6 +279,8 @@ class GenerationEngine:
         return stream
 
     def stats(self) -> dict:
+        if self.down is not None:
+            return {"down": self.down, "slots": self.n_slots}
         return {
             "slots": self.n_slots,
             "active": int(self._active.sum()),
@@ -293,11 +304,27 @@ class GenerationEngine:
             cursors = np.asarray(jax.device_get(self.cache.lengths))
             free = next((i for i, s in enumerate(self._slots) if s.free), None)
             if free is not None:
+                C = self.prompt_buckets[-1]
+                chunked_reachable = self.max_seq - 1 > C
                 for b in self.prompt_buckets:
                     toks = jnp.zeros((1, b), jnp.int32)
                     _, self.cache = jax.block_until_ready(self._prefill_jit(
                         self.cache, self.params, toks, jnp.int32(1),
                         jnp.int32(free), jnp.float32(0.0), self._key))
+                    if chunked_reachable:
+                        # chunked-admission lattice: the final chunk
+                        # compiles per bucket, mid chunks only at C
+                        _, self.cache = jax.block_until_ready(
+                            self._chunk_final_jit(
+                                self.cache, self.params, toks, jnp.int32(0),
+                                jnp.int32(free), jnp.int32(1), jnp.int32(0),
+                                jnp.float32(0.0), self._key))
+                if chunked_reachable:
+                    toks = jnp.zeros((1, C), jnp.int32)
+                    self.cache = jax.block_until_ready(self._chunk_mid_jit(
+                        self.cache, self.params, toks, jnp.int32(0),
+                        jnp.int32(free), jnp.int32(0), jnp.int32(0),
+                        jnp.float32(0.0), self._key))
             elif self.logger is not None:
                 self.logger.debug({"event": "generator warmup skipped prefill",
                                    "reason": "no free slot"})
@@ -344,17 +371,55 @@ class GenerationEngine:
                 continue
             self._start(idx, slot, req)
 
-    def _start(self, idx: int, slot: _Slot, req: _Request) -> None:
+    def _admit_prefill(self, idx: int, req: _Request) -> int:
+        """Run the request's prompt through prefill into slot ``idx`` and
+        return the first sampled token.
+
+        Prompts within the bucket lattice go through one padded prefill
+        dispatch. Longer prompts run CHUNKED: full chunks of the largest
+        bucket size C from position 0, then a final chunk of bucket size
+        Sb that ENDS exactly at the prompt end — it may overlap the tail
+        of the last full chunk (those positions recompute to identical
+        KV: same tokens, same positions, same prefix visibility), which
+        keeps every dispatch on the compiled lattice with zero padding
+        waste in the cache: capacity used == prompt length."""
         L = len(req.prompt)
-        Sb = pad_bucket(L, self.prompt_buckets)
-        padded = np.zeros((1, Sb), np.int32)
-        padded[0, :L] = req.prompt
-        t0 = time.monotonic()
-        try:
+        C = self.prompt_buckets[-1]
+        if L <= C:
+            Sb = pad_bucket(L, self.prompt_buckets)
+            padded = np.zeros((1, Sb), np.int32)
+            padded[0, :L] = req.prompt
             tok, self.cache = self._prefill_jit(
                 self.cache, self.params, jnp.asarray(padded), jnp.int32(L),
                 jnp.int32(idx), jnp.float32(req.temperature), self._next_key())
-            first = int(tok)
+            return int(tok)
+        mid_count = (L - 1) // C
+        for i in range(mid_count):
+            if req.stream.cancelled.is_set():
+                break
+            chunk = req.prompt[i * C:(i + 1) * C]
+            self.cache = self._chunk_mid_jit(
+                self.cache, self.params, jnp.asarray(chunk[None, :]),
+                jnp.int32(i * C), jnp.int32(idx), jnp.int32(0),
+                jnp.int32(0), jnp.float32(0.0), self._key)
+        if req.stream.cancelled.is_set():
+            # token is discarded anyway (_deliver retires cancelled slots
+            # before use) — skip the final-chunk dispatch entirely
+            return 0
+        rem = L - mid_count * C
+        Sb = pad_bucket(rem, self.prompt_buckets)
+        final = req.prompt[L - Sb:]
+        tok, self.cache = self._chunk_final_jit(
+            self.cache, self.params, jnp.asarray(final[None, :]),
+            jnp.int32(L - Sb), jnp.int32(idx), jnp.int32(L),
+            jnp.int32(Sb - 1), jnp.float32(req.temperature),
+            self._next_key())
+        return int(tok)
+
+    def _start(self, idx: int, slot: _Slot, req: _Request) -> None:
+        t0 = time.monotonic()
+        try:
+            first = self._admit_prefill(idx, req)
         except BaseException as e:  # noqa: BLE001 — the request is already
             # off the pending queue and owns no slot: fail ITS stream here,
             # then let _loop's handler deal with engine-level fallout.
@@ -418,6 +483,40 @@ class GenerationEngine:
                     if slot.request is not None:
                         slot.request.stream._q.put(err)
                         self._retire(idx, slot)
+                # A failed prefill/step may have consumed the DONATED cache
+                # buffer; continuing would serve every later request an
+                # opaque "donated buffer" error. Reallocate the cache to
+                # recover; if even that fails, mark the engine DOWN so
+                # health reports it instead of serving a bricked cache.
+                try:
+                    with self._device_lock:
+                        cache = llama.init_cache(self.cfg, self.n_slots,
+                                                 self.max_seq,
+                                                 dtype=self._kv_dtype)
+                        if self._cache_sh is not None:
+                            cache = jax.device_put(cache, self._cache_sh)
+                        self.cache = jax.block_until_ready(cache)
+                    if self.logger is not None:
+                        self.logger.warn({"event": "generation cache "
+                                          "reallocated after device failure"})
+                except BaseException as e2:  # noqa: BLE001
+                    self.down = f"cache reallocation failed: {e2!r} " \
+                                f"(after: {e!r})"
+                    if self.logger is not None:
+                        self.logger.error({"event": "generation engine down",
+                                           "error": self.down})
+                    # fail queued requests too — their consumers block on
+                    # the stream and no later iteration will admit them
+                    down_err = GenerationError(
+                        f"generation engine is down: {self.down}")
+                    while True:
+                        try:
+                            req = self._pending.get_nowait()
+                        except queue.Empty:
+                            break
+                        req.stream._q.put(down_err)
+                        req.stream._q.put(None)
+                    return
 
     def _iteration(self) -> None:
         self._admit()

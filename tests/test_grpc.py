@@ -89,6 +89,61 @@ def test_hpack_no_indexing_mode():
     assert not dec.table.entries
 
 
+def test_hpack_table_size_downgrade_emits_update():
+    """RFC 7541 §4.2: when the peer shrinks SETTINGS_HEADER_TABLE_SIZE the
+    encoder must evict beyond the new size and open the next header block
+    with a dynamic-table-size update — stale indexed refs would otherwise
+    point into entries the peer's shrunken table already dropped."""
+    enc, dec = Encoder(), Decoder()
+    headers = [("x-custom", "v1"), ("x-other", "v2")]
+    dec.decode(enc.encode(headers))  # both now in the dynamic tables
+    assert len(enc.table.entries) == 2
+
+    enc.set_max_table_size(0)  # peer shrank its table to nothing
+    assert not enc.table.entries  # evicted immediately
+    block = enc.encode(headers)
+    assert block[0] & 0xE0 == 0x20 and block[0] & 0x1F == 0  # §6.3 update
+    dec2 = Decoder()  # a fresh peer with a 0-size table decodes cleanly
+    dec2.table.resize(0)
+    assert dec2.decode(block) == [(b"x-custom", b"v1"), (b"x-other", b"v2")]
+    assert not dec2.table.entries
+
+    enc.set_max_table_size(4096)  # grow back: update emitted, indexing resumes
+    block = enc.encode(headers)
+    assert dec.decode(block) == [(b"x-custom", b"v1"), (b"x-other", b"v2")]
+
+
+def test_hpack_shrink_then_grow_signals_minimum():
+    """RFC 7541 §4.2: size drops to 0 then back up BETWEEN header blocks
+    must still signal the intermediate minimum so the peer flushes."""
+    enc, dec = Encoder(), Decoder()
+    headers = [("x-a", "1")]
+    dec.decode(enc.encode(headers))
+    assert dec.table.entries
+    enc.set_max_table_size(0)
+    enc.set_max_table_size(4096)
+    block = enc.encode(headers)
+    # two §6.3 updates open the block: 0, then 4096
+    assert block[0] == 0x20
+    got = dec.decode(block)
+    assert got == [(b"x-a", b"1")]
+    assert dec.table.max_size == 4096
+    # the 0-update flushed, then the literal was re-added
+    assert len(dec.table.entries) == 1
+
+
+def test_server_stream_abandoned_iterator_sends_rst(channel, server):
+    """Dropping a server-stream iterator mid-stream must RST the stream so
+    the server stops generating and the call entry is released."""
+    it = channel.server_stream("/test.Echo/Count", {"n": 50000})
+    got = [next(it) for _ in range(3)]
+    assert got == [{"i": 0}, {"i": 1}, {"i": 2}]
+    it.close()  # abandon -> GeneratorExit -> RST_STREAM(CANCEL)
+    assert not channel._calls  # local entry released
+    # channel still healthy for new calls on the same connection
+    assert channel.unary("/test.Echo/Say", {"msg": "after"})["msg"] == "after"
+
+
 # -- end-to-end RPC -----------------------------------------------------------
 
 @pytest.fixture(scope="module")
