@@ -55,8 +55,22 @@ HandlerFunc = Callable[[Context], Any]
 class App:
     def __init__(self, config: Config | None = None, config_folder: str = "./configs"):
         self.config: Config = config if config is not None else EnvConfig(config_folder)
+        # Multi-host bootstrap FIRST (reference lifecycle precedent:
+        # gofr.go:108-164 owns all process-wide setup): joining the PJRT
+        # distributed runtime must precede any backend use, or the TPU
+        # datasource wired below would see only this host's chips.
+        from .parallel.distributed import maybe_initialize
+
+        self._distributed = maybe_initialize(self.config)
         self.container = Container(self.config)
         self.logger = self.container.logger
+        if self._distributed:
+            import jax
+
+            self.logger.info({"event": "distributed runtime joined",
+                              "process_id": jax.process_index(),
+                              "num_processes": jax.process_count(),
+                              "global_devices": jax.device_count()})
 
         self.router = Router()
         self._http_registered = False

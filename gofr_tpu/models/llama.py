@@ -146,13 +146,19 @@ def _logits(params, cfg: ModelConfig, x):
 
 def _causal_scan(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                  lengths: jnp.ndarray | None, rope_max: int, rope_tables,
-                 constrain, collect_kv: bool):
+                 constrain, collect_kv: bool, flash: bool = False):
     """Shared causal body for forward/prefill: embed, mask, scan layers.
 
     Returns (x [B,S,D], kv  — stacked [L,B,S,KV,hd] pair when
     ``collect_kv`` else None, lengths [B]). ``constrain`` is an optional
     activation-sharding hook (x -> x) applied to the embedded input and
     each layer output — a stable GSPMD anchor for dp/sp layouts.
+
+    ``flash=True`` (the serving prefill paths) routes attention through
+    the Pallas flash kernel when backend+shapes allow — no S² scores, the
+    long-prompt/TTFT path; ops.flash falls back to the reference
+    otherwise. Training keeps the jnp reference: its backward is the
+    differentiation target and XLA's fusion is fine at train batch sizes.
     """
     B, S = tokens.shape
     if lengths is None:
@@ -162,13 +168,21 @@ def _causal_scan(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     valid = positions < lengths[:, None]
     constrain = constrain or (lambda x: x)
 
+    if flash:
+        from ..ops.flash import causal_attention_auto
+
+        def attend(q, k, v):
+            return causal_attention_auto(q, k, v, lengths=lengths,
+                                         mask=valid)
+    else:
+        def attend(q, k, v):
+            return causal_attention(q, k, v, mask=valid)
+
     x = constrain(params["embedding"][tokens].astype(cfg.jdtype))
 
     def body(x, layer_w):
         x, kv = _layer(x, layer_w, cfg, cos, sin, positions,
-                       kv_write=lambda k, v: (k, v),
-                       attend=lambda q, k, v: causal_attention(q, k, v,
-                                                               mask=valid))
+                       kv_write=lambda k, v: (k, v), attend=attend)
         # Training drops the per-layer k/v so the scan never materializes
         # the [L,B,S,KV,hd] stacks it would otherwise carry.
         return constrain(x), (kv if collect_kv else None)
@@ -189,16 +203,19 @@ def forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
 
 def prefill(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
             cache: KVCache, lengths: jnp.ndarray | None = None,
-            rope_tables=None) -> tuple[jnp.ndarray, KVCache]:
+            rope_tables=None, flash: bool = False) -> tuple[jnp.ndarray, KVCache]:
     """Process prompts [B, S] (right-padded), fill the cache.
 
     ``lengths`` [B]: true prompt lengths (defaults to full S).
     Returns (logits [B, S, V] in f32, cache with lengths set).
+    ``flash=True`` is an explicit single-device opt-in (the serving
+    engine sets it when mesh is None): Pallas calls do not partition
+    under GSPMD, so the default stays safe for sharded jits.
     """
     S = tokens.shape[1]
     x, (k_stack, v_stack), lengths = _causal_scan(
         params, cfg, tokens, lengths, cache.k.shape[2], rope_tables,
-        constrain=None, collect_kv=True)
+        constrain=None, collect_kv=True, flash=flash)
     # k_stack: [L, B, S, KV, hd] -> write into the cache's first S slots
     if S > cache.k.shape[2]:
         raise ValueError(f"prompt length {S} exceeds cache capacity {cache.k.shape[2]}")
@@ -230,7 +247,7 @@ def write_kv(cache: KVCache, k_stack, v_stack, index5, lengths) -> KVCache:
 
 def prefill_kv(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                lengths: jnp.ndarray | None = None, rope_max: int | None = None,
-               rope_tables=None):
+               rope_tables=None, flash: bool = False):
     """Causal forward returning the raw KV stacks instead of a filled cache.
 
     The continuous-batching serving engine prefills ONE sequence at a time
@@ -243,7 +260,7 @@ def prefill_kv(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     """
     x, (k_stack, v_stack), lengths = _causal_scan(
         params, cfg, tokens, lengths, rope_max or tokens.shape[1],
-        rope_tables, constrain=None, collect_kv=True)
+        rope_tables, constrain=None, collect_kv=True, flash=flash)
     return _logits(params, cfg, x), k_stack, v_stack, lengths
 
 

@@ -7,6 +7,8 @@ over the slice (ICI) or pod (DCN), PartitionSpec rules per model family,
 and jitted steps whose collectives XLA derives from the specs.
 """
 
+from .distributed import (is_coordinator, is_initialized, maybe_initialize,
+                          process_count, process_index)
 from .mesh import (AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP, DATA_AXES,
                    MESH_AXES, MeshPlan, auto_plan, make_mesh,
                    single_device_mesh)
@@ -17,6 +19,8 @@ from .train import (TrainState, default_optimizer, init_train_state,
                     make_train_step, next_token_loss, state_shardings)
 
 __all__ = [
+    "is_coordinator", "is_initialized", "maybe_initialize",
+    "process_count", "process_index",
     "AXIS_DP", "AXIS_FSDP", "AXIS_SP", "AXIS_TP", "DATA_AXES", "MESH_AXES",
     "MeshPlan", "auto_plan", "make_mesh", "single_device_mesh",
     "activation_constraint", "activation_spec", "batch_spec", "fit_spec",

@@ -1,0 +1,103 @@
+"""Multi-host bootstrap: the DCN half of the distributed backend.
+
+The reference's whole deployment model is multi-process services talking
+over HTTP/gRPC (pkg/gofr/gofr.go:108-164); its TPU-native equivalent is
+the PJRT distributed runtime: process 0 runs the coordinator, every
+process connects to it, and `jax.devices()` becomes the GLOBAL device
+list — after which the mesh/sharding layer (parallel.mesh/sharding) works
+unchanged, with XLA routing collectives over ICI within a slice and DCN
+across hosts. Nothing else in the framework knows about hosts.
+
+Config keys (read by `maybe_initialize`, wired in App startup BEFORE any
+datasource touches the backend):
+
+  TPU_COORDINATOR     "host:port" of process 0's coordinator service.
+                      Unset => single-process (no-op).
+  TPU_PROCESS_ID      this process's rank (0..N-1). Defaults to 0.
+  TPU_NUM_PROCESSES   world size N. Defaults to 1.
+  TPU_COORDINATOR_TIMEOUT_S  seconds to wait for the coordinator
+                      (default 60).
+
+On TPU pods the three values come from the deployment layer (one process
+per host); the same keys drive multi-process CPU testing
+(tests/test_distributed.py spawns two local processes against a
+127.0.0.1 coordinator).
+"""
+
+from __future__ import annotations
+
+import jax
+
+_initialized = False  # set by maybe_initialize; survives jax-internal moves
+
+
+def is_initialized() -> bool:
+    """True once this process joined a distributed runtime."""
+    if _initialized:
+        return True
+    try:  # best-effort probe (private API — the module flag above is the
+        # durable signal; this catches out-of-band jax.distributed use)
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
+    except Exception:
+        return False
+
+
+def maybe_initialize(cfg, logger=None) -> bool:
+    """Join the PJRT distributed runtime if TPU_COORDINATOR is configured.
+
+    Returns True when this call initialized (or a prior call already had);
+    False for the single-process default. Safe to call more than once.
+    Must run before the first backend use in the process — jax backends
+    initialized pre-join would see only local devices.
+    """
+    coordinator = (cfg.get("TPU_COORDINATOR") or "").strip()
+    if not coordinator:
+        return False
+    if is_initialized():
+        return True
+    process_id = cfg.get_int("TPU_PROCESS_ID", 0)
+    num_processes = cfg.get_int("TPU_NUM_PROCESSES", 1)
+    timeout_s = cfg.get_int("TPU_COORDINATOR_TIMEOUT_S", 60)
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        initialization_timeout=timeout_s,
+    )
+    global _initialized
+    _initialized = True
+    if logger is not None:
+        logger.info({
+            "event": "distributed runtime joined",
+            "coordinator": coordinator,
+            "process_id": process_id,
+            "num_processes": num_processes,
+            "global_devices": jax.device_count(),
+            "local_devices": jax.local_device_count(),
+        })
+    return True
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """Process 0 owns singleton side effects (metrics export, ledger
+    writes, checkpoint manifests) in multi-host serving."""
+    return jax.process_index() == 0
+
+
+def shutdown() -> None:
+    """Leave the distributed runtime (test teardown; production processes
+    exit instead)."""
+    global _initialized
+    if is_initialized():
+        jax.distributed.shutdown()
+    _initialized = False

@@ -193,9 +193,13 @@ class GenerationEngine:
     def _prefill_fn(self, cache, params, tokens, length, slot, temp, key):
         """tokens [1, Sb] (padded), length/slot scalars. Writes the slot's
         KV, sets its cursor, returns (first_token scalar, cache)."""
+        # flash prefill only off-mesh: a Pallas call inside a GSPMD-sharded
+        # jit does not partition (custom calls are opaque to the
+        # partitioner) — sharded engines keep the fusable jnp reference.
         logits, k, v, _ = llama.prefill_kv(
             params, self.cfg, tokens, jnp.asarray([length]),
-            rope_max=self.max_seq, rope_tables=self.rope_tables)
+            rope_max=self.max_seq, rope_tables=self.rope_tables,
+            flash=self.mesh is None)
         lengths = cache.lengths.at[slot].set(length)
         cache = llama.write_kv(cache, k, v, (0, slot, 0, 0, 0), lengths)
         last = jnp.take(logits[0], length - 1, axis=0)  # [V] at the true end
