@@ -22,6 +22,24 @@ def generate_grpc(ctx, req):
         yield {"token": tok}
 
 
+@llm.bidi_stream("Chat")
+def chat_grpc(ctx, requests):
+    """Multi-turn generation on ONE stream: each request is a prompt turn,
+    tokens stream back between turns, and a client cancel (RST_STREAM)
+    mid-turn releases the decode slot immediately."""
+    for req in requests:
+        stream = ctx.tpu.generate(req["tokens"],
+                                  max_new_tokens=req.get("max_new_tokens", 64),
+                                  temperature=req.get("temperature", 0.0),
+                                  eos_id=req.get("eos_id"))
+        try:
+            for tok in stream:
+                yield {"token": tok}
+        finally:
+            stream.cancel()
+        yield {"turn_done": True}
+
+
 app.register_grpc_service(llm)
 
 

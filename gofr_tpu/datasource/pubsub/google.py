@@ -17,21 +17,31 @@ from . import Message
 
 
 class GooglePubSubClient:
+    """Seam: ``publisher``/``subscriber`` are injectable objects exposing
+    the narrow client surface this driver uses (topic_path, create_topic,
+    publish, subscription_path, create_subscription, subscribe,
+    delete_topic, list_topics, close) — the reference tests its google
+    driver against exactly such mock clients (google/mock_interfaces.go).
+    Default: the real google-cloud-pubsub clients (gated import)."""
+
     def __init__(self, project_id: str, subscription_name: str = "gofr-sub",
-                 logger=None):
-        try:
-            from google.cloud import pubsub_v1  # gated import
-        except ImportError as e:
-            raise RuntimeError(
-                "GOOGLE backend requires the google-cloud-pubsub package") from e
+                 logger=None, publisher=None, subscriber=None):
         if not project_id:
             raise ValueError("GOOGLE_PROJECT_ID is required")
-        self._pubsub = pubsub_v1
+        if publisher is None or subscriber is None:
+            try:
+                from google.cloud import pubsub_v1  # gated import
+            except ImportError as e:
+                raise RuntimeError(
+                    "GOOGLE backend requires the google-cloud-pubsub "
+                    "package") from e
+            publisher = publisher or pubsub_v1.PublisherClient()
+            subscriber = subscriber or pubsub_v1.SubscriberClient()
         self.project_id = project_id
         self.subscription_name = subscription_name
         self.logger = logger
-        self._publisher = pubsub_v1.PublisherClient()
-        self._subscriber = pubsub_v1.SubscriberClient()
+        self._publisher = publisher
+        self._subscriber = subscriber
         self._known_topics: set[str] = set()
         self._known_subs: set[str] = set()
 

@@ -19,13 +19,24 @@ from . import Message
 
 
 class MQTTClient:
+    """Seam: ``client_factory(client_id)`` returns a paho-shaped client
+    (connect, loop_start/stop, subscribe/unsubscribe, publish,
+    message_callback_add, is_connected, disconnect, settable
+    ``on_message``) — the reference's mqtt/interface.go mock seam. Default
+    builds the real paho client (gated import)."""
+
     def __init__(self, broker: str = "broker.hivemq.com", port: int = 1883,
                  client_id: str = "gofr-mqtt", qos: int = 0,
-                 retained: bool = False, logger=None):
-        try:
-            import paho.mqtt.client as mqtt  # gated import
-        except ImportError as e:
-            raise RuntimeError("MQTT backend requires the paho-mqtt package") from e
+                 retained: bool = False, logger=None, client_factory=None):
+        if client_factory is None:
+            try:
+                import paho.mqtt.client as mqtt  # gated import
+            except ImportError as e:
+                raise RuntimeError(
+                    "MQTT backend requires the paho-mqtt package") from e
+
+            def client_factory(cid):
+                return mqtt.Client(client_id=cid)
         self.broker = broker
         self.port = port
         self.qos = qos
@@ -34,7 +45,7 @@ class MQTTClient:
         # reference mqtt.go:150-157: per-topic buffered channel, size 10
         self._queues: dict[str, queue.Queue] = {}
         self._lock = threading.Lock()
-        self._client = mqtt.Client(client_id=client_id)
+        self._client = client_factory(client_id)
         self._client.on_message = self._on_message
         self._client.connect(broker, port)
         self._client.loop_start()

@@ -13,7 +13,7 @@ Public surface:
   JSONCodec / ProtoCodec                  — message codecs
 """
 
-from .client import GRPCChannel, dial
+from .client import BidiCall, GRPCChannel, dial
 from .server import GRPCServer
 from .service import (CANCELLED, DEADLINE_EXCEEDED, GRPCContext, GRPCError,
                       GRPCService, INTERNAL, INVALID_ARGUMENT, JSONCodec,
@@ -22,7 +22,7 @@ from .service import (CANCELLED, DEADLINE_EXCEEDED, GRPCContext, GRPCError,
                       UNIMPLEMENTED, UNKNOWN)
 
 __all__ = [
-    "GRPCChannel", "dial", "GRPCServer",
+    "BidiCall", "GRPCChannel", "dial", "GRPCServer",
     "GRPCContext", "GRPCError", "GRPCService", "JSONCodec", "ProtoCodec",
     "STATUS_NAMES", "OK", "CANCELLED", "UNKNOWN", "INVALID_ARGUMENT",
     "DEADLINE_EXCEEDED", "NOT_FOUND", "RESOURCE_EXHAUSTED", "UNIMPLEMENTED",

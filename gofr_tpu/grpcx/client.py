@@ -205,8 +205,10 @@ class GRPCChannel:
             self._finish_call(call)
 
     # -- calls ---------------------------------------------------------------
-    def _start_call(self, method: str, payload: bytes,
-                    timeout: float | None, metadata=None) -> _Call:
+    def _open_call(self, method: str, timeout: float | None,
+                   metadata=None) -> _Call:
+        """Allocate a stream and send HEADERS (no END_STREAM): the request
+        side stays open for streaming sends."""
         if self._closed:
             raise svc.GRPCError(svc.UNAVAILABLE,
                                 f"channel closed: {self._error!r}")
@@ -230,6 +232,16 @@ class GRPCChannel:
             with self._enc_lock:
                 block = self.encoder.encode(headers)
             self.io.send_frame(h2.HEADERS, h2.FLAG_END_HEADERS, sid, block)
+        return call
+
+    def _half_close(self, call: _Call) -> None:
+        """End the request side (empty DATA + END_STREAM)."""
+        self.io.send_frame(h2.DATA, h2.FLAG_END_STREAM, call.sid)
+
+    def _send_message(self, call: _Call, payload: bytes, *,
+                      end: bool, timeout: float | None) -> None:
+        """One gRPC length-prefixed message as flow-controlled DATA;
+        ``end=True`` half-closes the request side with the final frame."""
         data = b"\x00" + len(payload).to_bytes(4, "big") + payload
         view = memoryview(data)
         while view:
@@ -238,11 +250,16 @@ class GRPCChannel:
             n = self.conn_window.consume(n_stream, timeout=timeout or 30.0)
             if n < n_stream:  # refund credit the connection couldn't cover
                 call.send_window.credit(n_stream - n)
-            last = n == len(view)
+            last = end and n == len(view)
             self.io.send_frame(h2.DATA,
-                               h2.FLAG_END_STREAM if last else 0, sid,
+                               h2.FLAG_END_STREAM if last else 0, call.sid,
                                bytes(view[:n]))
             view = view[n:]
+
+    def _start_call(self, method: str, payload: bytes,
+                    timeout: float | None, metadata=None) -> _Call:
+        call = self._open_call(method, timeout, metadata)
+        self._send_message(call, payload, end=True, timeout=timeout)
         return call
 
     def unary(self, method: str, request, *, codec=None, response_codec=None,
@@ -291,9 +308,92 @@ class GRPCChannel:
             # any downstream error: cancel so the server releases its slot
             self._cancel_call(call)
 
+    def client_stream(self, method: str, requests, *, codec=None,
+                      response_codec=None, timeout: float | None = 30.0,
+                      metadata=None):
+        """Stream ``requests`` (an iterable) in, receive ONE response."""
+        codec = codec or svc.JSONCodec()
+        response_codec = response_codec or codec
+        call = self._open_call(method, timeout, metadata)
+        try:
+            for r in requests:
+                self._send_message(call, codec.serialize(r), end=False,
+                                   timeout=timeout)
+            self._half_close(call)
+            msg = _q_get(call.q, timeout)
+            if isinstance(msg, svc.GRPCError):
+                raise msg
+            if msg is None:
+                raise svc.GRPCError(svc.UNAVAILABLE,
+                                    f"connection lost: {self._error!r}")
+            tail = _q_get(call.q, timeout)
+            if isinstance(tail, svc.GRPCError):
+                raise tail
+            return response_codec.deserialize(msg)
+        finally:
+            self._cancel_call(call)  # no-op unless the call is still open
+
+    def bidi_stream(self, method: str, *, codec=None, response_codec=None,
+                    timeout: float | None = 60.0, metadata=None) -> "BidiCall":
+        """Open a bidirectional stream: returns a handle with ``send()``,
+        ``close_send()``, iteration over responses, and ``cancel()`` —
+        requests and responses interleave freely (incremental prompts in,
+        tokens out, mid-stream cancel)."""
+        codec = codec or svc.JSONCodec()
+        response_codec = response_codec or codec
+        call = self._open_call(method, timeout, metadata)
+        return BidiCall(self, call, codec, response_codec, timeout)
+
     def close(self) -> None:
         self._closed = True
         self.io.close()
+
+
+class BidiCall:
+    """Client handle for one bidi RPC. Thread-safe for one sender + one
+    receiver; dropping the response iterator (or ``cancel()``) sends
+    RST_STREAM so the server releases whatever the stream holds."""
+
+    def __init__(self, channel: GRPCChannel, call: _Call, codec,
+                 response_codec, timeout: float | None):
+        self._channel = channel
+        self._call = call
+        self._codec = codec
+        self._response_codec = response_codec
+        self._timeout = timeout
+        self._send_closed = False
+
+    def send(self, msg) -> None:
+        if self._send_closed:
+            raise svc.GRPCError(svc.INTERNAL, "send side already closed")
+        self._channel._send_message(self._call, self._codec.serialize(msg),
+                                    end=False, timeout=self._timeout)
+
+    def close_send(self) -> None:
+        """Half-close: no more requests; responses keep flowing."""
+        if not self._send_closed:
+            self._send_closed = True
+            self._channel._half_close(self._call)
+
+    def cancel(self) -> None:
+        self._channel._cancel_call(self._call)
+
+    def __iter__(self):
+        try:
+            while True:
+                msg = _q_get(self._call.q, self._timeout)
+                if isinstance(msg, svc.GRPCError):
+                    raise msg
+                if msg is None:
+                    if (not self._call.done.is_set()
+                            and self._channel._error is not None):
+                        raise svc.GRPCError(
+                            svc.UNAVAILABLE,
+                            f"connection lost: {self._channel._error!r}")
+                    return
+                yield self._response_codec.deserialize(msg)
+        finally:
+            self._channel._cancel_call(self._call)
 
 
 def dial(address: str, **kw) -> GRPCChannel:

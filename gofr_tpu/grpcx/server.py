@@ -418,29 +418,51 @@ class GRPCServer:
                               peer=f"{conn.addr[0]}:{conn.addr[1]}")
         ctx.cancelled = st.cancelled
 
-        # unary request message (server-streaming is still single-request)
-        try:
-            msg = st.recv_q.get(timeout=timeout or 60.0)
-        except queue.Empty:
-            raise svc.GRPCError(svc.DEADLINE_EXCEEDED,
-                                "no request message before deadline") from None
-        if isinstance(msg, svc.GRPCError):
-            raise msg
-        if msg is None:
-            raise svc.GRPCError(svc.INVALID_ARGUMENT, "no request message")
-        try:
-            request = method.request_codec.deserialize(msg)
-        except Exception as e:
-            raise svc.GRPCError(svc.INVALID_ARGUMENT, f"bad request: {e!r}")
-
         def check_alive():
             if st.cancelled.is_set():
                 raise svc.GRPCError(svc.CANCELLED, "client cancelled")
             if deadline is not None and time.monotonic() > deadline:
                 raise svc.GRPCError(svc.DEADLINE_EXCEEDED, "deadline exceeded")
 
-        check_alive()
-        result = method.handler(ctx, request)
+        def one_message():
+            try:
+                msg = st.recv_q.get(timeout=timeout or 60.0)
+            except queue.Empty:
+                raise svc.GRPCError(
+                    svc.DEADLINE_EXCEEDED,
+                    "no request message before deadline") from None
+            if isinstance(msg, svc.GRPCError):
+                raise msg
+            if msg is None:
+                return None
+            try:
+                return method.request_codec.deserialize(msg)
+            except Exception as e:
+                raise svc.GRPCError(svc.INVALID_ARGUMENT,
+                                    f"bad request: {e!r}") from None
+
+        if method.client_streaming:
+            # handler receives a lazy iterator over the request stream; it
+            # ends at the client's half-close (END_STREAM), errors surface
+            # in-loop, and cancellation/deadline are re-checked per message
+            def request_iter():
+                while True:
+                    check_alive()
+                    msg = one_message()
+                    if msg is None:
+                        return
+                    yield msg
+
+            check_alive()
+            result = method.handler(ctx, request_iter())
+        else:
+            request = one_message()
+            if request is None:
+                raise svc.GRPCError(svc.INVALID_ARGUMENT,
+                                    "no request message")
+            check_alive()
+            result = method.handler(ctx, request)
+
         if method.server_streaming:
             for item in result:
                 check_alive()

@@ -78,15 +78,17 @@ class ProtoCodec:
 
 class Method:
     __slots__ = ("name", "handler", "request_codec", "response_codec",
-                 "server_streaming")
+                 "server_streaming", "client_streaming")
 
     def __init__(self, name: str, handler: Callable, request_codec,
-                 response_codec, server_streaming: bool):
+                 response_codec, server_streaming: bool,
+                 client_streaming: bool = False):
         self.name = name
         self.handler = handler
         self.request_codec = request_codec
         self.response_codec = response_codec
         self.server_streaming = server_streaming
+        self.client_streaming = client_streaming
 
 
 class GRPCContext:
@@ -151,24 +153,46 @@ class GRPCService:
         return req, res
 
     def _register(self, name: str, fn: Callable, request_type, response_type,
-                  streaming: bool):
+                  streaming: bool, client_streaming: bool = False):
         req_c, res_c = self._codecs(request_type, response_type)
-        self.methods[name] = Method(name, fn, req_c, res_c, streaming)
+        self.methods[name] = Method(name, fn, req_c, res_c, streaming,
+                                    client_streaming)
         return fn
+
+    def _decorator(self, name, fn, request_type, response_type,
+                   server_streaming, client_streaming):
+        if fn is None:
+            return lambda f: self._register(name, f, request_type,
+                                            response_type, server_streaming,
+                                            client_streaming)
+        return self._register(name, fn, request_type, response_type,
+                              server_streaming, client_streaming)
 
     def unary(self, name: str, fn: Callable | None = None, *,
               request_type=None, response_type=None):
-        if fn is None:
-            return lambda f: self._register(name, f, request_type,
-                                            response_type, False)
-        return self._register(name, fn, request_type, response_type, False)
+        return self._decorator(name, fn, request_type, response_type,
+                               False, False)
 
     def server_stream(self, name: str, fn: Callable | None = None, *,
                       request_type=None, response_type=None):
-        if fn is None:
-            return lambda f: self._register(name, f, request_type,
-                                            response_type, True)
-        return self._register(name, fn, request_type, response_type, True)
+        return self._decorator(name, fn, request_type, response_type,
+                               True, False)
+
+    def client_stream(self, name: str, fn: Callable | None = None, *,
+                      request_type=None, response_type=None):
+        """handler(ctx, request_iterator) -> single response. The iterator
+        yields deserialized messages as the client sends them and ends at
+        the client's half-close."""
+        return self._decorator(name, fn, request_type, response_type,
+                               False, True)
+
+    def bidi_stream(self, name: str, fn: Callable | None = None, *,
+                    request_type=None, response_type=None):
+        """handler(ctx, request_iterator) -> yields responses. Requests and
+        responses interleave freely on one stream — the shape for
+        incremental prompts / cancellable token generation."""
+        return self._decorator(name, fn, request_type, response_type,
+                               True, True)
 
     def lookup(self, method: str) -> Method | None:
         return self.methods.get(method)

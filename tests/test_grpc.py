@@ -293,6 +293,78 @@ def test_protobuf_codec_roundtrip():
         srv.stop()
 
 
+# -- client / bidi streaming --------------------------------------------------
+
+@pytest.fixture(scope="module")
+def stream_server():
+    agg = GRPCService("test.Stream")
+
+    @agg.client_stream("Sum")
+    def sum_(ctx, requests):
+        return {"total": sum(r["n"] for r in requests)}
+
+    @agg.bidi_stream("EchoUpper")
+    def echo_upper(ctx, requests):
+        for r in requests:
+            yield {"msg": r["msg"].upper()}
+
+    @agg.bidi_stream("Forever")
+    def forever(ctx, requests):
+        next(iter(requests))  # one request, then stream until cancelled
+        i = 0
+        while not ctx.is_cancelled():
+            yield {"i": i}
+            i += 1
+
+    srv = GRPCServer([agg], port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_client_streaming_aggregates(stream_server):
+    ch = dial(f"127.0.0.1:{stream_server.port}")
+    try:
+        out = ch.client_stream("/test.Stream/Sum",
+                               ({"n": i} for i in range(10)))
+        assert out == {"total": 45}
+    finally:
+        ch.close()
+
+
+def test_bidi_streaming_interleaves(stream_server):
+    ch = dial(f"127.0.0.1:{stream_server.port}")
+    try:
+        call = ch.bidi_stream("/test.Stream/EchoUpper")
+        it = iter(call)
+        # request/response strictly interleaved: each reply arrives before
+        # the next request is sent — a genuinely bidirectional exchange
+        for word in ("alpha", "beta", "gamma"):
+            call.send({"msg": word})
+            assert next(it)["msg"] == word.upper()
+        call.close_send()
+        assert list(it) == []  # server generator ends at half-close
+    finally:
+        ch.close()
+
+
+def test_bidi_mid_stream_cancel(stream_server):
+    ch = dial(f"127.0.0.1:{stream_server.port}")
+    try:
+        call = ch.bidi_stream("/test.Stream/Forever")
+        call.send({"go": True})
+        it = iter(call)
+        got = [next(it)["i"] for _ in range(3)]
+        assert got == [0, 1, 2]
+        call.cancel()  # RST_STREAM: server's ctx.is_cancelled() goes true
+        assert not ch._calls
+        # channel unharmed: a fresh RPC on the same connection works
+        assert ch.client_stream("/test.Stream/Sum",
+                                [{"n": 2}, {"n": 3}]) == {"total": 5}
+    finally:
+        ch.close()
+
+
 # -- app integration: token streaming over gRPC -------------------------------
 
 def test_app_grpc_token_streaming():
@@ -319,6 +391,62 @@ def test_app_grpc_token_streaming():
             "/llm.Generation/Generate", {"tokens": [5, 17, 42], "max_new_tokens": 6})]
         assert len(toks) == 6
         assert all(isinstance(t, int) for t in toks)
+        ch.close()
+    finally:
+        app.stop()
+
+
+def test_app_grpc_bidi_generation_cancel_releases_slot():
+    """The cancellable generation RPC (SURVEY §7 step 5): prompts stream
+    in, tokens stream out on the same call, and a mid-stream client cancel
+    frees the engine slot for the next request."""
+    from gofr_tpu import App
+    from gofr_tpu.config import MapConfig
+
+    app = App(MapConfig({"GRPC_PORT": "0", "METRICS_PORT": "0",
+                         "TPU_MODEL": "tiny", "TPU_MAX_SEQ": "64",
+                         "TPU_SLOTS": "1", "TPU_SEQ_BUCKETS": "8,16"}))
+    llm = GRPCService("llm.Generation")
+
+    @llm.bidi_stream("Chat")
+    def chat(ctx, requests):
+        for req in requests:  # each request = one prompt turn
+            stream = ctx.tpu.generate(req["tokens"],
+                                      max_new_tokens=req.get("max_new", 8))
+            try:
+                for tok in stream:
+                    yield {"token": tok}
+            finally:
+                stream.cancel()  # client RST mid-turn frees the slot
+            yield {"turn_done": True}
+
+    app.register_grpc_service(llm)
+    app.run(block=False)
+    gen = app.container.tpu.generator
+    try:
+        ch = dial(f"127.0.0.1:{app.grpc_port}")
+        call = ch.bidi_stream("/llm.Generation/Chat")
+        it = iter(call)
+        # turn 1: full generation, then the turn marker
+        call.send({"tokens": [5, 17, 42], "max_new": 4})
+        msgs = [next(it) for _ in range(5)]
+        assert [m for m in msgs if "token" in m] and msgs[-1] == {"turn_done": True}
+        # turn 2: cancel mid-generation — with ONE slot, the engine can
+        # only serve the follow-up if the cancel released it
+        call.send({"tokens": [1, 2, 3], "max_new": 1000})
+        assert "token" in next(it)
+        call.cancel()
+        for _ in range(200):
+            if gen.stats()["active"] == 0 and gen._pending.qsize() == 0:
+                break
+            time.sleep(0.01)
+        assert gen.stats()["active"] == 0
+        # a fresh turn on a NEW call must get the (only) slot
+        call2 = ch.bidi_stream("/llm.Generation/Chat")
+        call2.send({"tokens": [9, 9], "max_new": 3})
+        call2.close_send()
+        toks = [m["token"] for m in call2 if "token" in m]
+        assert len(toks) == 3
         ch.close()
     finally:
         app.stop()
