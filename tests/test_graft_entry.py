@@ -39,3 +39,25 @@ def test_dryrun_plan_has_no_sp():
 
 def test_dryrun_multichip_2():
     graft.dryrun_multichip(2)
+
+
+def test_dryrun_self_provisions_in_driver_environment():
+    # Simulate the driver EXACTLY (MULTICHIP_r02.json: fresh interpreter,
+    # no conftest, no XLA_FLAGS, possibly a 1-device TPU platform from
+    # sitecustomize): dryrun_multichip(8) must self-provision its own
+    # 8-device virtual CPU mesh via subprocess re-exec and exit 0.
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_NUM_CPU_DEVICES",
+                        "_GOFR_DRYRUN_CHILD")}
+    budget = float(os.environ.get("GOFR_DRYRUN_BUDGET_S", "90")) + 30
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=budget)
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-2000:])
+    assert "OK" in r.stdout
