@@ -22,6 +22,10 @@ Config keys (reference config style, pkg/gofr/config/config.go:3):
                       throughput scales with tokens per weight pass until
                       HBM runs out; shrink for small-HBM chips)
   TPU_MAX_SEQ         serving KV capacity (default min(model max, 2048))
+  TPU_DECODE_BLOCK    decode steps fused per device dispatch (default 4 —
+                      the stream sees K tokens per roundtrip; raise on
+                      high-latency links, lower toward 1 for tightest
+                      per-token latency)
   TPU_BATCH_BUCKETS   csv of predict batch buckets (default 1,2,4,8)
   TPU_SEQ_BUCKETS     csv of token-length buckets  (default 32..512)
   TPU_MAX_BATCH_DELAY coalescing window in seconds (default 0.004)
@@ -138,7 +142,8 @@ def new_engine_from_config(cfg, logger=None, metrics=None) -> TPUEngine:
         prompt_b = tuple(b for b in seq_buckets if b < max_seq) or (max_seq // 2,)
         engine.generator = GenerationEngine(
             mc, params, slots=slots, max_seq=max_seq, prompt_buckets=prompt_b,
-            logger=logger, metrics=metrics, mesh=mesh, kv_dtype=kv_dtype)
+            logger=logger, metrics=metrics, mesh=mesh, kv_dtype=kv_dtype,
+            decode_block=cfg.get_int("TPU_DECODE_BLOCK", 4))
 
         # scoring program: next-token logits at the prompt end (the
         # non-streaming sibling of generate, e.g. for classification heads)
