@@ -1,0 +1,36 @@
+# gofr_tpu serving image (reference parity: /root/reference/Dockerfile —
+# theirs copies a compiled Go binary; ours ships the Python package onto
+# a JAX TPU base).
+#
+# Build:  docker build -t gofr-tpu-app .
+# Run  :  docker run -p 8000:8000 -p 2121:2121 \
+#            -e TPU_MODEL=llama3-8b -e TPU_QUANT=int8 \
+#            --privileged gofr-tpu-app          # TPU VMs need /dev access
+#
+# The base image must provide jax with the TPU PJRT plugin (on Cloud TPU
+# VMs use the preinstalled environment; this python:slim base covers
+# CPU/dev deployments out of the box).
+FROM python:3.12-slim
+
+WORKDIR /srv
+
+# jax[tpu] resolves the PJRT TPU plugin on TPU VMs; plain jax elsewhere.
+ARG JAX_EXTRA=tpu
+RUN pip install --no-cache-dir "jax[${JAX_EXTRA}]" flax optax orbax-checkpoint einops || \
+    pip install --no-cache-dir jax flax optax orbax-checkpoint einops
+
+COPY gofr_tpu/ ./gofr_tpu/
+COPY examples/ ./examples/
+
+# Default app: the token-streaming server (BASELINE config #3). Override
+# APP_DIR to serve a different example or mount your own app.
+ENV APP_DIR=examples/tpu-token-streaming
+ENV PYTHONPATH=/srv
+ENV HTTP_PORT=8000 METRICS_PORT=2121 GRPC_PORT=9000
+
+EXPOSE 8000 2121 9000
+
+HEALTHCHECK --interval=15s --timeout=3s --start-period=120s \
+  CMD python -c "import os,urllib.request;urllib.request.urlopen('http://127.0.0.1:'+os.environ.get('HTTP_PORT','8000')+'/.well-known/alive',timeout=2)"
+
+CMD ["sh", "-c", "cd ${APP_DIR} && exec python main.py"]

@@ -1,6 +1,14 @@
 """gRPC server example (reference examples/grpc-server/grpc/server.go:13-23:
 HelloServer.SayHello) plus a server-streaming method the reference cannot
-express (unary-only, SURVEY §3.3)."""
+express (unary-only, SURVEY §3.3).
+
+Two services: JSON-codec (zero setup) and the SAME methods over compiled
+protobuf classes (proto/hello.proto -> hello_pb2.py, wire-compatible with
+any stock grpc client; reference examples/grpc-server/grpc/hello.pb.go).
+"""
+
+import os
+import sys
 
 from gofr_tpu import App
 from gofr_tpu.grpcx import GRPCService
@@ -22,6 +30,40 @@ def countdown(ctx, req):
 
 
 app.register_grpc_service(hello)
+
+# -- proto-typed sibling: handlers receive/return generated pb2 messages --
+# Loaded by file path (no sys.path mutation — a process-wide path entry
+# with a generic module name invites shadowing).
+_pb2_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "proto", "hello_pb2.py")
+if "hello_pb2" not in sys.modules:
+    import importlib.util
+
+    _spec = importlib.util.spec_from_file_location("hello_pb2", _pb2_path)
+    _mod = importlib.util.module_from_spec(_spec)
+    sys.modules["hello_pb2"] = _mod
+    _spec.loader.exec_module(_mod)
+from hello_pb2 import (CountdownRequest, CountdownTick,  # noqa: E402
+                       HelloReply, HelloRequest)
+
+hello_pb = GRPCService("hello.HelloProtoService")
+
+
+@hello_pb.unary("SayHello", request_type=HelloRequest,
+                response_type=HelloReply)
+def say_hello_pb(ctx, req):
+    return HelloReply(message=f"Hello {req.name or 'World'}!")
+
+
+@hello_pb.server_stream("Countdown", request_type=CountdownRequest,
+                        response_type=CountdownTick)
+def countdown_pb(ctx, req):
+    # proto3 unset int -> 0: default to 3 like the JSON sibling
+    for i in range(getattr(req, "from") or 3, 0, -1):
+        yield CountdownTick(tick=i)
+
+
+app.register_grpc_service(hello_pb)
 
 if __name__ == "__main__":
     app.run()

@@ -1,0 +1,45 @@
+"""BASELINE config #5, gateway half: fronts the sharded model server
+with a circuit breaker + custom health probe (reference
+service/circuit_breaker.go:42-54 + health_config.go:5-23). When the
+model server goes down — device failure, deploy, OOM — the breaker
+opens after 3 transport failures and /chat degrades in microseconds
+instead of stacking requests into a dead backend; the recovery probe
+re-closes it when the model's health endpoint answers again.
+"""
+
+import json
+
+from gofr_tpu import App
+from gofr_tpu.errors import HTTPError, ServiceUnavailable
+from gofr_tpu.service import (CircuitBreakerOption, CircuitOpenError,
+                              HealthOption)
+
+app = App()
+
+app.add_http_service(
+    "llm",
+    app.config.get_or_default("LLM_ADDRESS", "http://127.0.0.1:8000"),
+    CircuitBreakerOption(threshold=3, interval=5.0),
+    HealthOption("/.well-known/health"),
+)
+
+
+@app.post("/chat")
+def chat(ctx):
+    body = ctx.bind()
+    try:
+        r = ctx.get_http_service("llm").post("/generate", body=body)
+    except CircuitOpenError:
+        raise ServiceUnavailable("model backend circuit open")
+    except Exception as e:  # transport failure (counts toward the breaker)
+        raise HTTPError(f"model backend unreachable: {type(e).__name__}",
+                        status_code=502)
+    if not r.ok:
+        raise HTTPError(f"model backend {r.status_code}", status_code=502)
+    tokens = [json.loads(line)["token"]
+              for line in r.body.decode().splitlines() if line]
+    return {"tokens": tokens}
+
+
+if __name__ == "__main__":
+    app.run()

@@ -1,0 +1,37 @@
+"""BASELINE config #5, model half: Llama-3-70B sharded 8-way over ICI
+(tp=8) serving token generation with health aggregation. The breaker
+sits in the GATEWAY (gateway.py) — the reference's circuit breaker is a
+client-side decorator (service/circuit_breaker.go:42-54), so the model
+server's job is to make failure VISIBLE (health DOWN, 5xx) and the
+gateway's job is to shed load fast.
+
+configs/.env selects the production shape (llama3-70b, tp=8, int8);
+tests drive the same app with a tiny model on a CPU mesh.
+"""
+
+import json
+
+from gofr_tpu import App
+
+app = App()
+
+
+@app.post("/generate")
+def generate(ctx):
+    """Stream generated tokens as NDJSON chunks."""
+    body = ctx.bind()
+    stream = ctx.tpu.generate(body["tokens"],
+                              max_new_tokens=body.get("max_new_tokens", 64),
+                              temperature=body.get("temperature", 0.0),
+                              eos_id=body.get("eos_id"))
+    ctx.stream((json.dumps({"token": t}) + "\n").encode() for t in stream)
+    return None
+
+
+@app.get("/stats")
+def stats(ctx):
+    return ctx.tpu.generator.stats()
+
+
+if __name__ == "__main__":
+    app.run()
