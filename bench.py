@@ -161,18 +161,23 @@ def bench_decode(cfg, batch: int, cache_len: int, steps: int = 64,
         logits, cache = llama.decode_step(params, cfg, tokens, cache, rope)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
-    @functools.partial(jax.jit, donate_argnums=(3,))
-    def multistep(params, rope, tokens, cache):
-        def body(carry, _):
-            tokens, cache = carry
-            logits, cache = llama.decode_step(params, cfg, tokens, cache,
-                                              rope)
-            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return (tok, cache), tok
+    def make_multistep(flash: bool):
+        @functools.partial(jax.jit, donate_argnums=(3,))
+        def multistep(params, rope, tokens, cache):
+            def body(carry, _):
+                tokens, cache = carry
+                logits, cache = llama.decode_step(params, cfg, tokens,
+                                                  cache, rope, flash=flash)
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (tok, cache), tok
 
-        (tokens, cache), toks = jax.lax.scan(body, (tokens, cache), None,
-                                             length=decode_block)
-        return tokens, cache, toks
+            (tokens, cache), toks = jax.lax.scan(body, (tokens, cache),
+                                                 None, length=decode_block)
+            return tokens, cache, toks
+
+        return multistep
+
+    multistep = make_multistep(flash=False)
 
     # NOTE: through the axon tunnel, block_until_ready alone does not prove
     # execution finished — fetch actual result bytes inside the timed
@@ -210,8 +215,31 @@ def bench_decode(cfg, batch: int, cache_len: int, steps: int = 64,
         f"K={decode_block}: {n_fused} fused steps in {dt:.3f}s -> "
         f"{tok_s:.0f} tok/s ({fused_step_ms:.2f} ms/step fused, "
         f"{dispatch_step_ms:.2f} ms/step per-dispatch)")
-    return {"tok_s": tok_s, "fused_step_ms": fused_step_ms,
-            "dispatch_step_ms": dispatch_step_ms, "batch": batch}
+    out = {"tok_s": tok_s, "fused_step_ms": fused_step_ms,
+           "dispatch_step_ms": dispatch_step_ms, "batch": batch}
+
+    # A/B the flash-decode kernel (ops.flash_decode) on TPU backends:
+    # reuses the live params/cache, one extra compile. Failures report —
+    # the kernel is opt-in in serving until this number wins.
+    if jax.devices()[0].platform in ("tpu", "axon"):
+        try:
+            ms_flash = make_multistep(flash=True)
+            tokens, cache, toks = ms_flash(params, rope, tokens, cache)
+            np.asarray(toks)
+            t0 = time.perf_counter()
+            for _ in range(max(1, blocks // 2)):
+                tokens, cache, toks = ms_flash(params, rope, tokens, cache)
+            np.asarray(toks)
+            fdt = time.perf_counter() - t0
+            n = max(1, blocks // 2) * decode_block
+            out["flash_decode_tok_s"] = batch * n / fdt
+            out["flash_decode_step_ms"] = fdt / n * 1e3
+            log(f"  flash-decode kernel: {out['flash_decode_tok_s']:.0f} "
+                f"tok/s ({out['flash_decode_step_ms']:.2f} ms/step)")
+        except Exception as e:
+            out["flash_decode_error"] = f"{type(e).__name__}: {str(e)[:160]}"
+            log(f"  flash-decode A/B failed: {out['flash_decode_error']}")
+    return out
 
 
 def _is_oom(e: BaseException) -> bool:
@@ -435,6 +463,16 @@ def main() -> None:
     if "fused_step_ms" in res:
         payload["fused_step_ms"] = round(res["fused_step_ms"], 2)
         payload["dispatch_step_ms"] = round(res["dispatch_step_ms"], 2)
+    # flash-decode numbers ride along as separate fields — the headline
+    # stays the path the DEFAULT engine actually runs (jnp reference);
+    # promoting the kernel to headline requires flipping the engine
+    # default first (it is opt-in via GOFR_FLASH_DECODE until hardware
+    # timings validate it).
+    for k in ("flash_decode_tok_s", "flash_decode_step_ms"):
+        if k in res:
+            payload[k] = round(res[k], 2)
+    if "flash_decode_error" in res:
+        payload["flash_decode_error"] = res["flash_decode_error"]
     try:
         payload["flash_smoke"] = flash_smoke()
     except Exception as e:

@@ -311,10 +311,17 @@ def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
 
 
 def decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
-                cache: KVCache, rope_tables=None) -> tuple[jnp.ndarray, KVCache]:
+                cache: KVCache, rope_tables=None,
+                flash: bool = False) -> tuple[jnp.ndarray, KVCache]:
     """One decode step for tokens [B] against the cache.
 
     Returns (logits [B, V] f32, updated cache with lengths+1).
+
+    ``flash=True`` routes attention through the Pallas flash-decode
+    kernel (ops.flash_decode) when backend+shapes allow — the cache
+    streams from HBM exactly once, int8 on the wire. Single-device
+    engines only (a pallas_call does not partition under GSPMD); the
+    jnp reference stays the default and the fallback.
 
     Decode is HBM-bound, so the cache is READ-ONLY inside the layer scan
     (scan ``xs`` slicing reads each layer's [B, Smax, KV, hd] in place; the
@@ -337,13 +344,17 @@ def decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
 
     x = params["embedding"][tokens[:, None]].astype(cfg.jdtype)  # [B,1,D]
 
+    if flash:
+        from ..ops.flash_decode import decode_attention_auto as _decode_attn
+    else:
+        _decode_attn = decode_attention_appended
+
     def body(x, xs):
         layer_w, k_layer, v_layer, ks_layer, vs_layer = xs
 
         def attend(q, k_new, v_new):
-            return decode_attention_appended(q, k_layer, v_layer,
-                                             k_new, v_new, lengths,
-                                             ks_layer, vs_layer)
+            return _decode_attn(q, k_layer, v_layer, k_new, v_new,
+                                lengths, ks_layer, vs_layer)
 
         x, kv_tok = _layer(x, layer_w, cfg, cos, sin, positions,
                            kv_write=lambda k, v: (k, v), attend=attend)

@@ -176,19 +176,25 @@ def flash_causal_prefill(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.transpose(0, 2, 1, 3)                        # [B, S, H, D]
 
 
-def _kernel_ok(q: jnp.ndarray, block_q: int, block_k: int) -> bool:
-    b, s, h, d = q.shape
+def tpu_backend_ok() -> bool:
+    """Shared Mosaic-target gate for all Pallas kernels in ops/:
+    GOFR_DISABLE_FLASH kills every kernel path; the ALLOWLIST covers
+    "tpu" proper and the axon PJRT plugin — GPU/other backends cannot
+    lower these kernels."""
     if os.environ.get("GOFR_DISABLE_FLASH"):
-        return False
-    if d % 128 or s < 2 * block_q or s % block_q or s % block_k:
         return False
     try:
         platform = jax.devices()[0].platform
     except Exception:
         return False
-    # ALLOWLIST of TPU backends (Mosaic targets): "tpu" proper and the
-    # axon PJRT plugin. GPU/other backends cannot lower this kernel.
     return platform in ("tpu", "axon")
+
+
+def _kernel_ok(q: jnp.ndarray, block_q: int, block_k: int) -> bool:
+    b, s, h, d = q.shape
+    if d % 128 or s < 2 * block_q or s % block_q or s % block_k:
+        return False
+    return tpu_backend_ok()
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))

@@ -1,0 +1,217 @@
+"""Pallas TPU flash-decode attention: stream the int8 KV cache once.
+
+Decode attention is the least XLA-friendly part of the serving step: the
+cache slice [B, S, KV, hd] is int8 with per-vector scales, and the jnp
+path (ops.attention.decode_attention_appended) leaves it to the compiler
+to keep the int8->bf16 upcast fused into the einsums. When XLA instead
+materializes dequantized copies, decode pays the cache stream ~3x
+(int8 read + bf16 write + bf16 read) — at 8B/batch-64 shapes that is
+~20 ms/step of avoidable HBM traffic (see PERF.md roofline).
+
+This kernel makes the single-pass guarantee structural: a
+(B, S/BLOCK_S) grid streams each [BLOCK_S, KV, hd] cache tile from HBM
+into VMEM exactly once (int8 on the wire, upcast in-register), runs the
+online-softmax recurrence per kv-head group, and emits UNNORMALIZED
+(acc, m, l) running stats. The current token's k/v — not yet written to
+the cache (llama.decode_step defers the write to one post-scan scatter)
+— folds in afterwards with the standard flash combination, in jnp:
+
+    m_t = max(m_c, s_new);  l_t = l_c*e^(m_c-m_t) + e^(s_new-m_t)
+    out = (acc_c*e^(m_c-m_t) + e^(s_new-m_t) * v_new) / l_t
+
+which is exact, costs O(B*H*D), and cleanly handles empty slots
+(length 0 => l_c = 0 => out = v_new's softmax of one element).
+
+Sharding caveat (same as ops.flash): a pallas_call is opaque to the
+GSPMD partitioner — single-device engines only; mesh engines keep the
+jnp reference. Dispatch via ``decode_attention_auto``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import NEG_INF, decode_attention_appended
+
+_LANES = 128
+
+
+def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                   acc_ref, m_ref, l_ref, *,
+                   block_s: int, n_kv: int, scale: float, quant: bool):
+    """One (batch, s-block) step. Scratchless: acc/m/l ARE the outputs,
+    revisited across the sequential s dimension (the output block index
+    map ignores si, so the tiles stay resident in VMEM until the last
+    s-block flushes them)."""
+    si = pl.program_id(1)
+    length = lengths_ref[pl.program_id(0)]
+    h = q_ref.shape[1]
+    g = h // n_kv
+
+    @pl.when(si == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # blocks entirely past the valid prefix skip compute (the runtime
+    # still streams them; skipping the math is the available win)
+    @pl.when(si * block_s < length)
+    def _compute():
+        k_blk = k_ref[0]                                   # [BS, KV, D]
+        v_blk = v_ref[0]
+        pos = si * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_s), 1)                     # [1, BS]
+        valid = pos < length
+
+        for kv in range(n_kv):
+            qg = q_ref[0, kv * g:(kv + 1) * g, :] * scale   # [G, D]
+            k_kv = k_blk[:, kv, :]                          # [BS, D]
+            s = jax.lax.dot_general(
+                qg, k_kv.astype(qg.dtype),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)         # [G, BS]
+            if quant:
+                s = s * ks_ref[0][:, kv][None, :]
+            s = jnp.where(valid, s, NEG_INF)
+
+            rows = slice(kv * g, (kv + 1) * g)
+            m_prev = m_ref[0, rows, :1]                     # [G, 1]
+            l_prev = l_ref[0, rows, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)                          # [G, BS]
+            # fully-masked blocks never reach here (pl.when), and within
+            # a reached block masked positions give exp(NEG_INF - m) = 0
+            corr = jnp.exp(m_prev - m_new)                  # [G, 1]
+            l_ref[0, rows, :] = jnp.broadcast_to(
+                l_prev * corr + jnp.sum(p, axis=-1, keepdims=True),
+                (g, _LANES))
+            m_ref[0, rows, :] = jnp.broadcast_to(m_new, (g, _LANES))
+            if quant:
+                p = p * vs_ref[0][:, kv][None, :]
+            # pv contraction in q's dtype (bf16 in serving, f32 in the
+            # numerics tests) — matches decode_attention_appended's vdt
+            acc_ref[0, rows, :] = (
+                acc_ref[0, rows, :] * corr + jax.lax.dot_general(
+                    p.astype(qg.dtype),
+                    v_blk[:, kv, :].astype(qg.dtype),
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32))    # [G, D]
+
+
+@functools.partial(jax.jit, static_argnames=("block_s", "interpret"))
+def _flash_decode_cache(q, k_cache, v_cache, lengths, k_scale, v_scale,
+                        *, block_s: int = 128, interpret: bool = False):
+    """Cache-side running stats: returns (acc [B,H,D] f32 unnormalized,
+    m [B,H,LANES] f32, l [B,H,LANES] f32) over valid cache positions.
+
+    q: [B, H, D]; k_cache/v_cache: [B, S, KV, D] (int8 with scales
+    [B, S, KV], or dense); lengths: [B] int32 valid entries."""
+    b, h, d = q.shape
+    smax, n_kv = k_cache.shape[1], k_cache.shape[2]
+    if smax % block_s:
+        raise ValueError(f"S={smax} not divisible by block_s={block_s}")
+    quant = k_scale is not None
+    if not quant:  # uniform kernel signature: dummy scale planes
+        k_scale = jnp.ones((b, smax, n_kv), jnp.float32)
+        v_scale = jnp.ones((b, smax, n_kv), jnp.float32)
+    grid = (b, smax // block_s)
+
+    kernel = functools.partial(_decode_kernel, block_s=block_s,
+                               n_kv=n_kv, scale=d ** -0.5,
+                               quant=quant)
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,  # lengths
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, h, d), lambda bi, si, lens: (bi, 0, 0)),
+                pl.BlockSpec((1, block_s, n_kv, d),
+                             lambda bi, si, lens: (bi, si, 0, 0)),
+                pl.BlockSpec((1, block_s, n_kv, d),
+                             lambda bi, si, lens: (bi, si, 0, 0)),
+                pl.BlockSpec((1, block_s, n_kv),
+                             lambda bi, si, lens: (bi, si, 0)),
+                pl.BlockSpec((1, block_s, n_kv),
+                             lambda bi, si, lens: (bi, si, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, h, d), lambda bi, si, lens: (bi, 0, 0)),
+                pl.BlockSpec((1, h, _LANES), lambda bi, si, lens: (bi, 0, 0)),
+                pl.BlockSpec((1, h, _LANES), lambda bi, si, lens: (bi, 0, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), q, k_cache, v_cache, k_scale, v_scale)
+    return acc, m, l
+
+
+def flash_decode_appended(q, k_cache, v_cache, k_new, v_new, lengths,
+                          k_scale=None, v_scale=None, *,
+                          block_s: int = 128,
+                          interpret: bool = False) -> jnp.ndarray:
+    """Drop-in for ops.attention.decode_attention_appended on TPU.
+
+    q: [B, 1, H, D]; k_cache/v_cache: [B, Smax, KV, D];
+    k_new/v_new: [B, 1, KV, D] (bf16, fresh this step); lengths [B]
+    EXCLUDING the current token. Returns [B, 1, H, D] in q.dtype.
+    """
+    b, _, h, d = q.shape
+    n_kv = k_cache.shape[2]
+    g = h // n_kv
+    acc, m, l = _flash_decode_cache(
+        q[:, 0], k_cache, v_cache, lengths, k_scale, v_scale,
+        block_s=block_s, interpret=interpret)
+    m = m[..., 0]                                           # [B, H]
+    l = l[..., 0]
+
+    # fold the appended token (exact flash combination, O(B*H*D) jnp)
+    qh = (q[:, 0] * (d ** -0.5)).reshape(b, n_kv, g, d)
+    s_new = jnp.einsum("bkgd,bkd->bkg", qh,
+                       k_new[:, 0].astype(qh.dtype),
+                       preferred_element_type=jnp.float32).reshape(b, h)
+    m_t = jnp.maximum(m, s_new)
+    alpha = jnp.exp(m - m_t)                                # [B, H]
+    beta = jnp.exp(s_new - m_t)
+    l_t = l * alpha + beta
+    v_rep = jnp.repeat(v_new[:, 0], g, axis=1)              # [B, H, D]
+    out = (acc * alpha[..., None]
+           + beta[..., None] * v_rep.astype(jnp.float32)) / l_t[..., None]
+    return out.astype(q.dtype).reshape(b, 1, h, d)
+
+
+def _kernel_ok(q, k_cache, block_s: int) -> bool:
+    from .flash import tpu_backend_ok
+
+    b, _, h, d = q.shape
+    smax, n_kv = k_cache.shape[1], k_cache.shape[2]
+    if d % _LANES or smax % block_s or h % n_kv or smax < block_s:
+        return False
+    return tpu_backend_ok()
+
+
+def decode_attention_auto(q, k_cache, v_cache, k_new, v_new, lengths,
+                          k_scale=None, v_scale=None, *,
+                          block_s: int = 128,
+                          interpret: bool = False) -> jnp.ndarray:
+    """Flash-decode kernel when backend+shapes allow, jnp reference
+    otherwise. Same contract as decode_attention_appended."""
+    if interpret or _kernel_ok(q, k_cache, block_s):
+        return flash_decode_appended(q, k_cache, v_cache, k_new, v_new,
+                                     lengths, k_scale, v_scale,
+                                     block_s=block_s, interpret=interpret)
+    return decode_attention_appended(q, k_cache, v_cache, k_new, v_new,
+                                     lengths, k_scale, v_scale)

@@ -28,6 +28,7 @@ stream; one background thread owns the device loop.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 import time
@@ -116,6 +117,11 @@ class GenerationEngine:
         # dispatch/tunnel latency K-fold. Cost: a finished stream wastes at
         # most K-1 slot-steps, and admission waits at most one block.
         self.decode_block = max(1, int(decode_block))
+        # flash-decode kernel (ops.flash_decode): single-device only
+        # (pallas is opaque to GSPMD) and opt-in while hardware timings
+        # are being validated — GOFR_FLASH_DECODE=1 enables.
+        self._flash_decode = (mesh is None
+                              and os.environ.get("GOFR_FLASH_DECODE") == "1")
         self.max_seq = min(max_seq or cfg.max_seq, cfg.max_seq)
         self.prompt_buckets = tuple(sorted(b for b in prompt_buckets
                                            if b <= self.max_seq)) or (self.max_seq,)
@@ -266,7 +272,7 @@ class GenerationEngine:
             tokens, cache = carry
             logits, stepped = llama.decode_step(
                 params, self.cfg, tokens, cache,
-                rope_tables=self.rope_tables)
+                rope_tables=self.rope_tables, flash=self._flash_decode)
             lengths = jnp.where(active, stepped.lengths, cache.lengths)
             stepped = stepped._replace(lengths=lengths)
             toks = self._sample(logits, temps, step_key)

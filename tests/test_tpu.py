@@ -223,6 +223,27 @@ def test_long_prompt_exact_chunk_multiple(gen_engine, tiny_llama):
     assert got == _reference_greedy(tiny_llama, prompt, 4)
 
 
+def test_decode_block_size_is_numerically_invisible(tiny_llama):
+    """Fusing K decode steps per dispatch must not change what a stream
+    yields: same greedy tokens, same stream lengths, EOS honored
+    mid-block (post-EOS device tokens discarded on host)."""
+    outs = {}
+    for K in (1, 3, 8):
+        eng = GenerationEngine(TINY, tiny_llama, slots=2, max_seq=64,
+                               prompt_buckets=(8,), decode_block=K)
+        try:
+            outs[K] = eng.generate([5, 17, 42, 7], max_new_tokens=11).tokens()
+            eos = outs[K][2]  # pick a token mid-sequence as eos
+            stopped = eng.generate([5, 17, 42, 7], max_new_tokens=50,
+                                   eos_id=eos).tokens()
+            # the stream ends at the FIRST occurrence of eos
+            want = outs[K][:outs[K].index(eos) + 1]
+            assert stopped == want, f"K={K} EOS handling"
+        finally:
+            eng.close()
+    assert outs[1] == outs[3] == outs[8]
+
+
 def test_chunked_admission_keeps_decode_flowing():
     """A long chunked admission must not stall active decode streams:
     decode blocks interleave between prompt chunks (VERDICT r2 weak #5 —
