@@ -220,8 +220,19 @@ def bench_decode(cfg, batch: int, cache_len: int, steps: int = 64,
 
     # A/B the flash-decode kernel (ops.flash_decode) on TPU backends:
     # reuses the live params/cache, one extra compile. Failures report —
-    # the kernel is opt-in in serving until this number wins.
-    if jax.devices()[0].platform in ("tpu", "axon"):
+    # the kernel is opt-in in serving until this number wins. The gate
+    # must be the KERNEL's own (decode_attention_auto silently falls
+    # back on disabled/odd shapes — numbers from the fallback would be
+    # baseline timings mislabeled as kernel timings).
+    from gofr_tpu.ops.flash_decode import _kernel_ok as _flash_decode_ok
+
+    q_probe = jax.ShapeDtypeStruct((batch, 1, cfg.n_heads, cfg.head_dim),
+                                   jnp.bfloat16)
+    k_probe = jax.ShapeDtypeStruct(
+        (batch, cache_len, cfg.n_kv_heads, cfg.head_dim), jnp.int8)
+    if not _flash_decode_ok(q_probe, k_probe, 128):
+        out["flash_decode_skipped"] = "kernel gate rejected backend/shapes"
+    else:
         try:
             ms_flash = make_multistep(flash=True)
             tokens, cache, toks = ms_flash(params, rope, tokens, cache)

@@ -146,7 +146,8 @@ def _logits(params, cfg: ModelConfig, x):
 
 def _causal_scan(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                  lengths: jnp.ndarray | None, rope_max: int, rope_tables,
-                 constrain, collect_kv: bool, flash: bool = False):
+                 constrain, collect_kv: bool, flash: bool = False,
+                 attend_override=None):
     """Shared causal body for forward/prefill: embed, mask, scan layers.
 
     Returns (x [B,S,D], kv  — stacked [L,B,S,KV,hd] pair when
@@ -159,6 +160,10 @@ def _causal_scan(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     long-prompt/TTFT path; ops.flash falls back to the reference
     otherwise. Training keeps the jnp reference: its backward is the
     differentiation target and XLA's fusion is fine at train batch sizes.
+
+    ``attend_override(q, k, v, lengths)``: replaces the attention
+    entirely — the hook sequence-parallel training uses to route through
+    ring attention (ops.ring_attention) on sp>1 meshes.
     """
     B, S = tokens.shape
     if lengths is None:
@@ -168,7 +173,10 @@ def _causal_scan(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     valid = positions < lengths[:, None]
     constrain = constrain or (lambda x: x)
 
-    if flash:
+    if attend_override is not None:
+        def attend(q, k, v):
+            return attend_override(q, k, v, lengths)
+    elif flash:
         from ..ops.flash import causal_attention_auto
 
         def attend(q, k, v):
@@ -193,11 +201,13 @@ def _causal_scan(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
 
 def forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
             lengths: jnp.ndarray | None = None, rope_tables=None,
-            constrain=None) -> jnp.ndarray:
+            constrain=None, attend_override=None) -> jnp.ndarray:
     """Cache-free causal forward over [B, S] tokens -> [B, S, V] f32 logits.
-    The training/scoring path: no KV-cache allocation or writes."""
+    The training/scoring path: no KV-cache allocation or writes.
+    ``attend_override``: see _causal_scan (ring attention hook)."""
     x, _, _ = _causal_scan(params, cfg, tokens, lengths, tokens.shape[1],
-                           rope_tables, constrain, collect_kv=False)
+                           rope_tables, constrain, collect_kv=False,
+                           attend_override=attend_override)
     return _logits(params, cfg, x)
 
 

@@ -10,6 +10,7 @@ from .norms import rms_norm, layer_norm
 from .rope import rope_frequencies, apply_rope
 from .attention import causal_attention, decode_attention
 from .quant import quantize_int8, QuantizedLinear, qmatmul
+from .ring_attention import make_ring_attention, ring_causal_attention
 
 __all__ = [
     "rms_norm",
@@ -21,4 +22,6 @@ __all__ = [
     "quantize_int8",
     "QuantizedLinear",
     "qmatmul",
+    "make_ring_attention",
+    "ring_causal_attention",
 ]

@@ -25,7 +25,7 @@ import optax
 
 from ..models import llama
 from ..models.common import ModelConfig
-from .mesh import Mesh
+from .mesh import AXIS_DP, AXIS_FSDP, AXIS_SP, Mesh
 from .sharding import (activation_constraint, batch_spec, fit_spec,
                        param_specs, shardings_for)
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -113,16 +113,35 @@ def state_shardings(state_like: Any, mesh: Mesh) -> Any:
 
 
 def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
-                    mesh: Mesh, *, remat: bool = True) -> Callable:
+                    mesh: Mesh, *, remat: bool = True,
+                    seq_parallel: str = "auto") -> Callable:
     """Build the jitted sharded train step:
-    step(state, tokens [B,S], lengths [B]) -> (state, metrics dict)."""
+    step(state, tokens [B,S], lengths [B]) -> (state, metrics dict).
+
+    ``seq_parallel``: "ring" routes attention through ring attention
+    (ops.ring_attention — sequence shards pinned, K/V rotating over the
+    sp axis with ppermute); "dense" keeps the fusable jnp attention;
+    "auto" (default) picks ring exactly when the mesh has sp > 1, where
+    GSPMD's dense partition degrades into full-rematerialization
+    reshards (the spmd_partitioner warnings the dryrun notes)."""
     constrain = activation_constraint(mesh)
 
-    fwd = (jax.checkpoint(llama.forward, static_argnums=(1, 5))
+    use_ring = (seq_parallel == "ring"
+                or (seq_parallel == "auto"
+                    and mesh.shape.get(AXIS_SP, 1) > 1))
+    attend_override = None
+    if use_ring:
+        from ..ops.ring_attention import make_ring_attention
+
+        attend_override = make_ring_attention(
+            mesh, axis_name=AXIS_SP, batch_axes=(AXIS_DP, AXIS_FSDP))
+
+    fwd = (jax.checkpoint(llama.forward, static_argnums=(1, 5, 6))
            if remat else llama.forward)
 
     def loss_fn(params, tokens, lengths):
-        logits = fwd(params, cfg, tokens, lengths, None, constrain)
+        logits = fwd(params, cfg, tokens, lengths, None, constrain,
+                     attend_override)
         return next_token_loss(logits, tokens, lengths)
 
     def step(state: TrainState, tokens, lengths):

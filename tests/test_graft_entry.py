@@ -28,13 +28,14 @@ def test_dryrun_multichip_8_within_budget():
     assert took < budget, f"dryrun_multichip(8) took {took:.0f}s > {budget:.0f}s"
 
 
-def test_dryrun_plan_has_no_sp():
-    # sp resharding is GSPMD-hostile on the CPU mesh (involuntary full
-    # rematerialization) — the dryrun plan must never put a factor on it.
+def test_dryrun_plan_covers_all_axes_at_8():
+    # with ring attention handling sp (seq_parallel="auto"), the dryrun
+    # demonstrates all four mesh axes once enough devices exist
     for n in (2, 4, 8, 16):
         plan = graft._plan_for(n)
-        assert plan.sp == 1
         assert plan.n_devices == n
+    assert graft._plan_for(8).sp == 2
+    assert graft._plan_for(4).sp == 1  # tp/fsdp first: the shipping axes
 
 
 def test_dryrun_multichip_2():
