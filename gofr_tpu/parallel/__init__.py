@@ -15,8 +15,9 @@ from .mesh import (AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP, DATA_AXES,
 from .sharding import (activation_constraint, activation_spec, batch_spec,
                        fit_spec, kv_cache_specs, param_specs, replicated,
                        shard_params, shardings_for, spec_for)
-from .train import (TrainState, default_optimizer, init_train_state,
-                    make_train_step, next_token_loss, state_shardings)
+from .train import (TrainState, abstract_train_state, default_optimizer,
+                    init_train_state, make_train_step, next_token_loss,
+                    restore_train_state, save_train_state, state_shardings)
 
 __all__ = [
     "is_coordinator", "is_initialized", "maybe_initialize",
@@ -26,6 +27,7 @@ __all__ = [
     "activation_constraint", "activation_spec", "batch_spec", "fit_spec",
     "kv_cache_specs", "param_specs", "replicated", "shard_params",
     "shardings_for", "spec_for",
-    "TrainState", "default_optimizer", "init_train_state", "make_train_step",
-    "next_token_loss", "state_shardings",
+    "TrainState", "abstract_train_state", "default_optimizer",
+    "init_train_state", "make_train_step", "next_token_loss",
+    "restore_train_state", "save_train_state", "state_shardings",
 ]
