@@ -1,6 +1,13 @@
 """Redis-backed REST server (reference examples/http-server-using-redis):
 GET/POST a config value in Redis through the observable client wrapper."""
 
+import os as _os
+import sys as _sys
+
+# appended (not prepended): an installed gofr_tpu always wins
+_sys.path.append(_os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                               "..", ".."))
+
 from gofr_tpu import App
 from gofr_tpu.errors import HTTPError
 

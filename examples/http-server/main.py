@@ -5,6 +5,13 @@ redis route, trace route, mysql customer routes, service call)."""
 
 from dataclasses import dataclass
 
+import os as _os
+import sys as _sys
+
+# appended (not prepended): an installed gofr_tpu always wins
+_sys.path.append(_os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                               "..", ".."))
+
 from gofr_tpu import App
 
 

@@ -5,6 +5,13 @@ hermetically; KAFKA in production."""
 
 import numpy as np
 
+import os as _os
+import sys as _sys
+
+# appended (not prepended): an installed gofr_tpu always wins
+_sys.path.append(_os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                               "..", ".."))
+
 from gofr_tpu import App
 
 app = App()

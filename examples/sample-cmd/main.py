@@ -1,6 +1,13 @@
 """CLI app example (reference examples/sample-cmd): regex-matched
 sub-commands with flag binding, run via ``python main.py hello -name=X``."""
 
+import os as _os
+import sys as _sys
+
+# appended (not prepended): an installed gofr_tpu always wins
+_sys.path.append(_os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                               "..", ".."))
+
 from gofr_tpu import new_cmd
 
 app = new_cmd()

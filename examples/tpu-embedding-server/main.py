@@ -5,6 +5,13 @@ end-to-end TPU slice (SURVEY §7 step 4): HTTP route -> coalescing batcher
 
 import numpy as np
 
+import os as _os
+import sys as _sys
+
+# appended (not prepended): an installed gofr_tpu always wins
+_sys.path.append(_os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                               "..", ".."))
+
 from gofr_tpu import App
 
 app = App()  # configs/.env sets TPU_MODEL=bert-base etc.

@@ -9,6 +9,13 @@ re-closes it when the model's health endpoint answers again.
 
 import json
 
+import os as _os
+import sys as _sys
+
+# appended (not prepended): an installed gofr_tpu always wins
+_sys.path.append(_os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                               "..", ".."))
+
 from gofr_tpu import App
 from gofr_tpu.errors import HTTPError, ServiceUnavailable
 from gofr_tpu.service import (CircuitBreakerOption, CircuitOpenError,

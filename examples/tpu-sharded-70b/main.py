@@ -11,6 +11,13 @@ tests drive the same app with a tiny model on a CPU mesh.
 
 import json
 
+import os as _os
+import sys as _sys
+
+# appended (not prepended): an installed gofr_tpu always wins
+_sys.path.append(_os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                               "..", ".."))
+
 from gofr_tpu import App
 
 app = App()

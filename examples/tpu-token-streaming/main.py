@@ -4,6 +4,13 @@ unary-only, SURVEY §3.3) and HTTP chunked responses."""
 
 import json
 
+import os as _os
+import sys as _sys
+
+# appended (not prepended): an installed gofr_tpu always wins
+_sys.path.append(_os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                               "..", ".."))
+
 from gofr_tpu import App
 from gofr_tpu.grpcx import GRPCService
 

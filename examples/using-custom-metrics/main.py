@@ -1,6 +1,13 @@
 """Custom metrics example (reference examples/using-custom-metrics/main.go:
 22-28 registers all 4 metric types and records them from handlers)."""
 
+import os as _os
+import sys as _sys
+
+# appended (not prepended): an installed gofr_tpu always wins
+_sys.path.append(_os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                               "..", ".."))
+
 from gofr_tpu import App
 
 app = App()

@@ -2,6 +2,13 @@
 a registered downstream service with circuit breaker + health decorators,
 consumed from a handler via ctx.get_http_service."""
 
+import os as _os
+import sys as _sys
+
+# appended (not prepended): an installed gofr_tpu always wins
+_sys.path.append(_os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                               "..", ".."))
+
 from gofr_tpu import App
 from gofr_tpu.service import CircuitBreakerOption, HealthOption
 

@@ -1,6 +1,13 @@
 """Migrations example (reference examples/using-migrations): ordered,
 run-once schema changes tracked in the gofr_migrations ledger."""
 
+import os as _os
+import sys as _sys
+
+# appended (not prepended): an installed gofr_tpu always wins
+_sys.path.append(_os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                               "..", ".."))
+
 from gofr_tpu import App
 from gofr_tpu.migration import Migrate
 

@@ -2,6 +2,13 @@
 /publish-order fans an order event into the broker configured by
 PUBSUB_BACKEND (MEM for local runs, KAFKA in production)."""
 
+import os as _os
+import sys as _sys
+
+# appended (not prepended): an installed gofr_tpu always wins
+_sys.path.append(_os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                               "..", ".."))
+
 from gofr_tpu import App
 
 app = App()

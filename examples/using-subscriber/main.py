@@ -1,6 +1,13 @@
 """Subscriber example (reference examples/using-subscriber/main.go:8-46):
 one consumer loop per topic; commit-on-success semantics."""
 
+import os as _os
+import sys as _sys
+
+# appended (not prepended): an installed gofr_tpu always wins
+_sys.path.append(_os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                               "..", ".."))
+
 from gofr_tpu import App
 
 app = App()

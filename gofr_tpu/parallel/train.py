@@ -62,17 +62,25 @@ def next_token_loss(logits: jnp.ndarray, tokens: jnp.ndarray,
     return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 
-def init_train_state(cfg: ModelConfig, key, mesh: Mesh,
-                     optimizer: optax.GradientTransformation) -> TrainState:
-    """Init params + optimizer state DIRECTLY sharded on the mesh: the init
-    itself is jitted with out_shardings, so no host-side full copy of the
-    model ever exists (required for 70B-class runs)."""
+def _build_state(cfg: ModelConfig,
+                 optimizer: optax.GradientTransformation) -> Callable:
+    """The ONE definition of a fresh TrainState's structure — init and
+    the checkpoint-restore skeleton must never drift apart."""
 
     def build(key):
         params = llama.init(cfg, key)
         return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                           opt_state=optimizer.init(params))
 
+    return build
+
+
+def init_train_state(cfg: ModelConfig, key, mesh: Mesh,
+                     optimizer: optax.GradientTransformation) -> TrainState:
+    """Init params + optimizer state DIRECTLY sharded on the mesh: the init
+    itself is jitted with out_shardings, so no host-side full copy of the
+    model ever exists (required for 70B-class runs)."""
+    build = _build_state(cfg, optimizer)
     shapes = jax.eval_shape(build, key)
     out_sh = state_shardings(shapes, mesh)
     return jax.jit(build, out_shardings=out_sh)(key)
@@ -187,13 +195,8 @@ def abstract_train_state(cfg: ModelConfig, mesh: Mesh,
     """The TrainState's shape/dtype/sharding skeleton WITHOUT allocating
     anything — the restore target for checkpoint resume (and a free
     spec-validation artifact, like tests/test_70b_sharded.py uses)."""
-
-    def build(key):
-        params = llama.init(cfg, key)
-        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                          opt_state=optimizer.init(params))
-
-    shapes = jax.eval_shape(build, jax.random.PRNGKey(0))
+    shapes = jax.eval_shape(_build_state(cfg, optimizer),
+                            jax.random.PRNGKey(0))
     shardings = state_shardings(shapes, mesh)
     return jax.tree.map(
         lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
@@ -205,21 +208,20 @@ def save_train_state(path: str, state: TrainState) -> None:
     moments) with orbax — the resume story the reference's migration
     ledger plays for schema (SURVEY §5 checkpoint/resume; the reference
     itself is stateless and has no analogue). Delegates to the one
-    orbax save path (tpu.checkpoint.save_orbax)."""
+    orbax save path (tpu.checkpoint.save_orbax); force=True because a
+    resume loop saves back to its own output path repeatedly."""
     from ..tpu.checkpoint import save_orbax
 
-    save_orbax(path, state)
+    save_orbax(path, state, force=True)
 
 
 def restore_train_state(path: str, cfg: ModelConfig, mesh: Mesh,
                         optimizer: optax.GradientTransformation) -> TrainState:
     """Restore a TrainState DIRECTLY sharded onto ``mesh`` (each leaf
     lands at its canonical NamedSharding — resuming on a different
-    topology reshards at load, no host-side full copy)."""
-    import os
+    topology reshards at load, no host-side full copy). Delegates to the
+    one orbax restore path (tpu.checkpoint.load_orbax)."""
+    from ..tpu.checkpoint import load_orbax
 
-    import orbax.checkpoint as ocp
-
-    target = abstract_train_state(cfg, mesh, optimizer)
-    with ocp.StandardCheckpointer() as ckptr:
-        return ckptr.restore(os.path.abspath(path), target)
+    return load_orbax(path, target=abstract_train_state(cfg, mesh,
+                                                        optimizer))

@@ -74,18 +74,29 @@ def load_npz(path: str) -> Any:
         return _unflatten({k: f[k] for k in f.files})
 
 
-def save_orbax(path: str, params: Any) -> None:
+def save_orbax(path: str, params: Any, *, force: bool = False) -> None:
+    """``force=True`` overwrites an existing checkpoint at ``path`` —
+    "save latest" semantics for resume loops saving back to their own
+    output. The default stays refuse-to-overwrite so a mispointed path
+    can't silently destroy existing weights."""
     import orbax.checkpoint as ocp
 
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(os.path.abspath(path), params)
+        ckptr.save(os.path.abspath(path), params, force=force)
 
 
-def load_orbax(path: str) -> Any:
+def load_orbax(path: str, target: Any = None) -> Any:
+    """``target``: optional abstract pytree (ShapeDtypeStructs, possibly
+    with shardings) — restores each leaf to that shape/sharding (the
+    sharded-resume path, parallel.restore_train_state)."""
     import orbax.checkpoint as ocp
 
     with ocp.StandardCheckpointer() as ckptr:
+        if target is not None:
+            return ckptr.restore(os.path.abspath(path), target)
         return ckptr.restore(os.path.abspath(path))
+
+
 
 
 def load_params(path: str) -> Any:
