@@ -30,6 +30,7 @@ def generate(ctx):
     stream = ctx.tpu.generate(body["tokens"],
                               max_new_tokens=body.get("max_new_tokens", 64),
                               temperature=body.get("temperature", 0.0),
+                              top_k=body.get("top_k", 0),
                               eos_id=body.get("eos_id"))
     ctx.stream((json.dumps({"token": t}) + "\n").encode() for t in stream)
     return None

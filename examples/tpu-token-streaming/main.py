@@ -24,6 +24,7 @@ def generate_grpc(ctx, req):
     stream = ctx.tpu.generate(req["tokens"],
                               max_new_tokens=req.get("max_new_tokens", 64),
                               temperature=req.get("temperature", 0.0),
+                              top_k=req.get("top_k", 0),
                               eos_id=req.get("eos_id"))
     for tok in stream:
         yield {"token": tok}
@@ -38,6 +39,7 @@ def chat_grpc(ctx, requests):
         stream = ctx.tpu.generate(req["tokens"],
                                   max_new_tokens=req.get("max_new_tokens", 64),
                                   temperature=req.get("temperature", 0.0),
+                                  top_k=req.get("top_k", 0),
                                   eos_id=req.get("eos_id"))
         try:
             for tok in stream:
@@ -55,7 +57,8 @@ def generate_http(ctx):
     body = ctx.bind()
     stream = ctx.tpu.generate(body["tokens"],
                               max_new_tokens=body.get("max_new_tokens", 64),
-                              temperature=body.get("temperature", 0.0))
+                              temperature=body.get("temperature", 0.0),
+                              top_k=body.get("top_k", 0))
     ctx.stream((json.dumps({"token": t}) + "\n").encode() for t in stream)
     return None
 

@@ -77,15 +77,16 @@ class GenStream:
 
 
 class _Request:
-    __slots__ = ("stream", "prompt", "max_new", "temperature", "eos_id",
-                 "enqueued_at")
+    __slots__ = ("stream", "prompt", "max_new", "temperature", "top_k",
+                 "eos_id", "enqueued_at")
 
     def __init__(self, stream: GenStream, prompt: np.ndarray, max_new: int,
-                 temperature: float, eos_id: int | None):
+                 temperature: float, top_k: int, eos_id: int | None):
         self.stream = stream
         self.prompt = prompt
         self.max_new = max_new
         self.temperature = temperature
+        self.top_k = top_k
         self.eos_id = eos_id
         self.enqueued_at = time.monotonic()
 
@@ -142,6 +143,7 @@ class GenerationEngine:
         self._last_tokens = np.zeros((slots,), np.int32)
         self._active = np.zeros((slots,), bool)
         self._temps = np.zeros((slots,), np.float32)
+        self._top_ks = np.zeros((slots,), np.int32)
         self._key = jax.random.PRNGKey(seed)
 
         self._pending: queue.Queue[_Request] = queue.Queue()
@@ -190,18 +192,33 @@ class GenerationEngine:
                                         daemon=True)
         self._thread.start()
 
+    # top-k truncation width: per-request k is traced (no recompiles);
+    # ranks past k are masked within this fixed top set
+    TOP_K_MAX = 64
+
     # -- jitted device functions --------------------------------------------
-    def _sample(self, logits, temps, key):
-        """Greedy where temp==0, categorical(logits/temp) otherwise — fused
-        per-slot so mixed-sampling batches stay one program."""
-        B = logits.shape[0]
+    def _sample(self, logits, temps, key, top_ks):
+        """Greedy where temp==0; categorical(logits/temp) otherwise,
+        truncated to the request's top-k logits when top_k > 0 — all
+        fused per-slot so mixed-sampling batches stay one program."""
+        B, V = logits.shape
         keys = jax.random.split(key, B)
         safe_t = jnp.maximum(temps, 1e-6)[:, None]
-        sampled = jax.vmap(jax.random.categorical)(keys, logits / safe_t)
+        scaled = logits / safe_t
+        sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+        kmax = min(self.TOP_K_MAX, V)
+        vals, idx = jax.lax.top_k(scaled, kmax)          # [B, kmax]
+        kk = jnp.minimum(jnp.where(top_ks > 0, top_ks, kmax), kmax)
+        vals = jnp.where(jnp.arange(kmax)[None, :] < kk[:, None],
+                         vals, -jnp.inf)
+        in_k = jax.vmap(jax.random.categorical)(keys, vals)
+        topk_tok = jnp.take_along_axis(idx, in_k[:, None], axis=1)[:, 0]
+        sampled = jnp.where(top_ks > 0, topk_tok, sampled)
         greedy = jnp.argmax(logits, axis=-1)
         return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
-    def _prefill_fn(self, cache, params, tokens, length, slot, temp, key):
+    def _prefill_fn(self, cache, params, tokens, length, slot, temp,
+                    top_k, key):
         """tokens [1, Sb] (padded), length/slot scalars. Writes the slot's
         KV, sets its cursor, returns (first_token scalar, cache)."""
         # flash prefill only off-mesh: a Pallas call inside a GSPMD-sharded
@@ -214,11 +231,11 @@ class GenerationEngine:
         lengths = cache.lengths.at[slot].set(length)
         cache = llama.write_kv(cache, k, v, (0, slot, 0, 0, 0), lengths)
         last = jnp.take(logits[0], length - 1, axis=0)  # [V] at the true end
-        tok = self._sample(last[None, :], temp[None], key)[0]
+        tok = self._sample(last[None, :], temp[None], key, top_k[None])[0]
         return tok, cache
 
     def _chunk_fn(self, cache, params, tokens, start, slot, total_len,
-                  pos_in_chunk, temp, key, sample: bool):
+                  pos_in_chunk, temp, top_k, key, sample: bool):
         """Chunked prefill for prompts longer than the largest bucket:
         slice the slot's cache view, run one chunk against it, write back.
         The final chunk (``sample=True``) also sets the slot's cursor to
@@ -256,10 +273,11 @@ class GenerationEngine:
             return llama.KVCache(k_new, v_new, lengths, ks, vs)
         lengths = cache.lengths.at[slot].set(total_len)
         last = jnp.take(logits[0], pos_in_chunk, axis=0)
-        tok = self._sample(last[None, :], temp[None], key)[0]
+        tok = self._sample(last[None, :], temp[None], key, top_k[None])[0]
         return tok, llama.KVCache(k_new, v_new, lengths, ks, vs)
 
-    def _step_fn(self, cache, params, last_tokens, active, temps, key):
+    def _step_fn(self, cache, params, last_tokens, active, temps, top_ks,
+                 key):
         """K fused decode steps over all slots (K = decode_block); one
         dispatch returns [K, B] tokens. Each step feeds its sampled token
         to the next on device — the host is off the per-token critical
@@ -275,7 +293,7 @@ class GenerationEngine:
                 rope_tables=self.rope_tables, flash=self._flash_decode)
             lengths = jnp.where(active, stepped.lengths, cache.lengths)
             stepped = stepped._replace(lengths=lengths)
-            toks = self._sample(logits, temps, step_key)
+            toks = self._sample(logits, temps, step_key, top_ks)
             toks = jnp.where(active, toks, tokens)
             return (toks, stepped), toks
 
@@ -284,9 +302,16 @@ class GenerationEngine:
 
     # -- public API ----------------------------------------------------------
     def generate(self, prompt, max_new_tokens: int = 128,
-                 temperature: float = 0.0, eos_id: int | None = None) -> GenStream:
+                 temperature: float = 0.0, top_k: int = 0,
+                 eos_id: int | None = None) -> GenStream:
         """Enqueue a prompt (sequence of token ids); returns a GenStream
-        yielding generated ids as the device produces them."""
+        yielding generated ids as the device produces them.
+
+        ``temperature=0`` (default) is greedy. ``top_k > 0`` truncates
+        sampling to the k most likely tokens; k is CAPPED at
+        TOP_K_MAX (64) — the compiled step extracts a fixed top set
+        once and masks within it, so larger requested k silently
+        saturates to 64 rather than widening the distribution."""
         if self._closed:
             raise GenerationError("generation engine is closed")
         if self.down is not None:
@@ -311,7 +336,7 @@ class GenerationEngine:
             if self._closed:
                 raise GenerationError("generation engine is closed")
             self._pending.put(_Request(stream, prompt, max_new_tokens,
-                                       temperature, eos_id))
+                                       temperature, top_k, eos_id))
         self._work.set()
         return stream
 
@@ -347,7 +372,8 @@ class GenerationEngine:
                     toks = jnp.zeros((1, b), jnp.int32)
                     _, self.cache = jax.block_until_ready(self._prefill_jit(
                         self.cache, self.params, toks, jnp.int32(1),
-                        jnp.int32(free), jnp.float32(0.0), self._key))
+                        jnp.int32(free), jnp.float32(0.0), jnp.int32(0),
+                        self._key))
                     if chunked_reachable:
                         # chunked-admission lattice: the final chunk
                         # compiles per bucket, mid chunks only at C
@@ -355,20 +381,20 @@ class GenerationEngine:
                             self._chunk_final_jit(
                                 self.cache, self.params, toks, jnp.int32(0),
                                 jnp.int32(free), jnp.int32(1), jnp.int32(0),
-                                jnp.float32(0.0), self._key))
+                                jnp.float32(0.0), jnp.int32(0), self._key))
                 if chunked_reachable:
                     toks = jnp.zeros((1, C), jnp.int32)
                     self.cache = jax.block_until_ready(self._chunk_mid_jit(
                         self.cache, self.params, toks, jnp.int32(0),
                         jnp.int32(free), jnp.int32(0), jnp.int32(0),
-                        jnp.float32(0.0), self._key))
+                        jnp.float32(0.0), jnp.int32(0), self._key))
             elif self.logger is not None:
                 self.logger.debug({"event": "generator warmup skipped prefill",
                                    "reason": "no free slot"})
             _, self.cache = jax.block_until_ready(self._step_jit(
                 self.cache, self.params, jnp.asarray(self._last_tokens),
                 jnp.zeros((self.n_slots,), bool), jnp.asarray(self._temps),
-                self._key))
+                jnp.asarray(self._top_ks), self._key))
             # restore cursors dirtied by the dummy dispatches
             self.cache = self.cache._replace(lengths=jnp.asarray(cursors))
 
@@ -428,7 +454,8 @@ class GenerationEngine:
             padded[0, :L] = req.prompt
             tok, self.cache = self._prefill_jit(
                 self.cache, self.params, jnp.asarray(padded), jnp.int32(L),
-                jnp.int32(idx), jnp.float32(req.temperature), self._next_key())
+                jnp.int32(idx), jnp.float32(req.temperature),
+                jnp.int32(req.top_k), self._next_key())
             return int(tok)
         mid_count = (L - 1) // C
         for i in range(mid_count):
@@ -438,7 +465,7 @@ class GenerationEngine:
             self.cache = self._chunk_mid_jit(
                 self.cache, self.params, jnp.asarray(chunk[None, :]),
                 jnp.int32(i * C), jnp.int32(idx), jnp.int32(0),
-                jnp.int32(0), jnp.float32(0.0), self._key)
+                jnp.int32(0), jnp.float32(0.0), jnp.int32(0), self._key)
             # Long admissions must not stall active decode streams
             # (VERDICT r2 weak #5): run one decode block between chunks
             # so every live slot keeps producing while this prompt loads.
@@ -454,7 +481,7 @@ class GenerationEngine:
             self.cache, self.params, jnp.asarray(final[None, :]),
             jnp.int32(L - Sb), jnp.int32(idx), jnp.int32(L),
             jnp.int32(Sb - 1), jnp.float32(req.temperature),
-            self._next_key())
+            jnp.int32(req.top_k), self._next_key())
         return int(tok)
 
     def _start(self, idx: int, slot: _Slot, req: _Request) -> None:
@@ -475,6 +502,7 @@ class GenerationEngine:
         slot.remaining = req.max_new
         self.total_requests += 1
         self._temps[idx] = req.temperature
+        self._top_ks[idx] = req.top_k
         self._deliver(idx, slot, first)
         if slot.request is not None:  # not finished by the first token
             self._last_tokens[idx] = first
@@ -503,6 +531,7 @@ class GenerationEngine:
         slot.request = None
         self._active[idx] = False
         self._temps[idx] = 0.0
+        self._top_ks[idx] = 0
 
     def _loop(self) -> None:
         while not self._closed:
@@ -573,7 +602,7 @@ class GenerationEngine:
         toks, self.cache = self._step_jit(
             self.cache, self.params, jnp.asarray(self._last_tokens),
             jnp.asarray(self._active), jnp.asarray(self._temps),
-            self._next_key())
+            jnp.asarray(self._top_ks), self._next_key())
         toks_np = np.asarray(jax.device_get(toks))  # [K, B]
         if self.metrics is not None:
             self.metrics.set_gauge("app_tpu_batch_fill",

@@ -342,6 +342,31 @@ def test_generation_engine_down_when_recovery_fails(tiny_llama, monkeypatch):
         eng.close()
 
 
+def test_generation_top_k_one_is_greedy(gen_engine):
+    # top_k=1 collapses sampling to argmax even at high temperature
+    prompt = [5, 17, 42, 7]
+    greedy = gen_engine.generate(prompt, max_new_tokens=8).tokens()
+    t1 = gen_engine.generate(prompt, max_new_tokens=8, temperature=5.0,
+                             top_k=1).tokens()
+    assert t1 == greedy
+
+
+def test_generation_top_k_stays_in_top_set(gen_engine, tiny_llama):
+    """Every sampled token must come from the reference top-k set at its
+    position (following the sampled path)."""
+    k = 4
+    prompt = [2, 9, 4]
+    toks = gen_engine.generate(prompt, max_new_tokens=6, temperature=2.0,
+                               top_k=k).tokens()
+    ctx = list(prompt)
+    for t in toks:
+        logits = llama.forward(tiny_llama, TINY,
+                               jnp.asarray([ctx], jnp.int32))[0, -1]
+        top = set(np.argsort(np.asarray(logits))[-k:].tolist())
+        assert t in top, (t, sorted(top))
+        ctx.append(t)
+
+
 def test_generation_temperature_sampling(gen_engine):
     out = gen_engine.generate([7, 7, 7], max_new_tokens=20,
                               temperature=5.0).tokens()
