@@ -28,6 +28,9 @@ class ModelConfig:
     image_size: int = 224
     patch_size: int = 14
     type_vocab_size: int = 2
+    # mixture-of-experts (0 experts = dense FFN)
+    n_experts: int = 0
+    experts_per_token: int = 2
 
     @property
     def head_dim(self) -> int:
@@ -56,6 +59,17 @@ LLAMA_CONFIGS = {
     "tiny": ModelConfig(name="tiny", vocab_size=256, dim=64, n_layers=2,
                         n_heads=4, n_kv_heads=2, ffn_dim=128, max_seq=128,
                         rope_theta=10000.0, dtype="float32"),
+    # Mixtral-8x7B (public dims): top-2 of 8 SwiGLU experts per layer
+    "mixtral-8x7b": ModelConfig(name="mixtral-8x7b", vocab_size=32000,
+                                dim=4096, n_layers=32, n_heads=32,
+                                n_kv_heads=8, ffn_dim=14336, max_seq=8192,
+                                rope_theta=1e6, n_experts=8,
+                                experts_per_token=2),
+    "tiny-moe": ModelConfig(name="tiny-moe", vocab_size=256, dim=64,
+                            n_layers=2, n_heads=4, n_kv_heads=2,
+                            ffn_dim=128, max_seq=128, rope_theta=10000.0,
+                            dtype="float32", n_experts=4,
+                            experts_per_token=2),
 }
 
 BERT_CONFIGS = {

@@ -91,6 +91,20 @@ def init(cfg: ModelConfig, key) -> dict:
     L, D, H, KV, hd, F, V = (cfg.n_layers, cfg.dim, cfg.n_heads,
                              cfg.n_kv_heads, cfg.head_dim, cfg.ffn_dim,
                              cfg.vocab_size)
+    if cfg.n_experts > 0:
+        E = cfg.n_experts
+        ffn = {
+            "router": dense_init(keys[9], (L, D, E), dt),
+            "w_gate": dense_init(keys[5], (L, E, D, F), dt),
+            "w_up": dense_init(keys[6], (L, E, D, F), dt),
+            "w_down": dense_init(keys[7], (L, E, F, D), dt),
+        }
+    else:
+        ffn = {
+            "w_gate": dense_init(keys[5], (L, D, F), dt),
+            "w_up": dense_init(keys[6], (L, D, F), dt),
+            "w_down": dense_init(keys[7], (L, F, D), dt),
+        }
     params = {
         "embedding": dense_init(keys[0], (V, D), dt, scale=0.02),
         "layers": {
@@ -100,15 +114,65 @@ def init(cfg: ModelConfig, key) -> dict:
             "wv": dense_init(keys[3], (L, D, KV * hd), dt),
             "wo": dense_init(keys[4], (L, H * hd, D), dt),
             "ffn_norm": jnp.ones((L, D), dt),
-            "w_gate": dense_init(keys[5], (L, D, F), dt),
-            "w_up": dense_init(keys[6], (L, D, F), dt),
-            "w_down": dense_init(keys[7], (L, F, D), dt),
+            **ffn,
         },
         "final_norm": jnp.ones((D,), dt),
     }
     if not cfg.tie_embeddings:
         params["lm_head"] = dense_init(keys[8], (D, V), dt)
     return params
+
+
+def _expert_mm(h, w, pattern: str):
+    """Per-expert einsum that consumes int8 QuantizedLinear expert stacks
+    ([E, in, out] int8 + [E, out] scale) the same way ops.quant.qmatmul
+    does for dense weights: upcast in-register, scale after the
+    contraction (constant over the contracted axis, so XLA keeps it
+    fused — the experts are never materialized in bf16)."""
+    from ..ops.quant import QuantizedLinear
+
+    if isinstance(w, QuantizedLinear):
+        y = jnp.einsum(pattern, h, w.w.astype(h.dtype),
+                       preferred_element_type=jnp.float32)
+        return (y * w.scale[None, None]).astype(h.dtype)
+    return jnp.einsum(pattern, h, w)
+
+
+def _moe_ffn(h, layer_w, cfg: ModelConfig):
+    """Mixture-of-experts SwiGLU FFN: softmax router, top-k expert
+    selection with renormalized weights, dense-dispatch combine.
+
+    Dense dispatch (every expert computes every token, combined by a
+    [B,S,E] weight matrix that is zero off the top-k) keeps shapes
+    static and the whole layer one fused einsum chain — XLA-friendly and
+    exactly correct. It spends E/k times the FLOPs of routed dispatch,
+    which is the right trade below ~8 experts per chip; capacity-based
+    gather dispatch is the extension point when expert counts grow past
+    what dense dispatch amortizes (experts would shard over their own
+    mesh axis, specs in parallel/sharding.py already carry the [L,E,..]
+    rank).
+
+    Weights: router [D,E]; w_gate/w_up [E,D,F]; w_down [E,F,D] — dense
+    or int8 QuantizedLinear stacks (TPU_QUANT=int8 quantizes experts
+    per-output-channel like every other projection).
+    Returns (ffn_out [B,S,D], router_probs [B,S,E] f32 — the aux
+    load-balancing loss input, collected by the training path).
+    """
+    probs = jax.nn.softmax(
+        jnp.einsum("bsd,de->bse", h, layer_w["router"],
+                   preferred_element_type=jnp.float32), axis=-1)
+    topv, topi = jax.lax.top_k(probs, cfg.experts_per_token)   # [B,S,k]
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    # combine weights: zero everywhere except the chosen experts
+    combine = jnp.sum(
+        jax.nn.one_hot(topi, cfg.n_experts, dtype=topv.dtype)
+        * topv[..., None], axis=2)                             # [B,S,E]
+
+    gated = jax.nn.silu(_expert_mm(h, layer_w["w_gate"], "bsd,edf->bsef")) \
+        * _expert_mm(h, layer_w["w_up"], "bsd,edf->bsef")
+    out = _expert_mm(gated, layer_w["w_down"], "bsef,efd->bsed")
+    return (jnp.einsum("bsed,bse->bsd", out,
+                       combine.astype(out.dtype)), probs)
 
 
 def _layer(x, layer_w, cfg: ModelConfig, cos, sin, positions,
@@ -131,9 +195,14 @@ def _layer(x, layer_w, cfg: ModelConfig, cos, sin, positions,
     x = x + qmatmul(attn, layer_w["wo"])
 
     h = rms_norm(x, layer_w["ffn_norm"], cfg.norm_eps)
-    gated = jax.nn.silu(qmatmul(h, layer_w["w_gate"])) * qmatmul(h, layer_w["w_up"])
-    x = x + qmatmul(gated, layer_w["w_down"])
-    return x, (k_all, v_all)
+    router_probs = None
+    if cfg.n_experts > 0:
+        ffn, router_probs = _moe_ffn(h, layer_w, cfg)
+        x = x + ffn
+    else:
+        gated = jax.nn.silu(qmatmul(h, layer_w["w_gate"])) * qmatmul(h, layer_w["w_up"])
+        x = x + qmatmul(gated, layer_w["w_down"])
+    return x, (k_all, v_all), router_probs
 
 
 def _logits(params, cfg: ModelConfig, x):
@@ -147,7 +216,7 @@ def _logits(params, cfg: ModelConfig, x):
 def _causal_scan(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                  lengths: jnp.ndarray | None, rope_max: int, rope_tables,
                  constrain, collect_kv: bool, flash: bool = False,
-                 attend_override=None):
+                 attend_override=None, collect_router: bool = False):
     """Shared causal body for forward/prefill: embed, mask, scan layers.
 
     Returns (x [B,S,D], kv  — stacked [L,B,S,KV,hd] pair when
@@ -189,26 +258,36 @@ def _causal_scan(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     x = constrain(params["embedding"][tokens].astype(cfg.jdtype))
 
     def body(x, layer_w):
-        x, kv = _layer(x, layer_w, cfg, cos, sin, positions,
-                       kv_write=lambda k, v: (k, v), attend=attend)
+        x, kv, probs = _layer(x, layer_w, cfg, cos, sin, positions,
+                              kv_write=lambda k, v: (k, v), attend=attend)
         # Training drops the per-layer k/v so the scan never materializes
         # the [L,B,S,KV,hd] stacks it would otherwise carry.
-        return constrain(x), (kv if collect_kv else None)
+        return constrain(x), (kv if collect_kv else None,
+                              probs if collect_router else None)
 
-    x, kv = jax.lax.scan(body, x, params["layers"])
-    return x, kv, lengths
+    x, (kv, router_probs) = jax.lax.scan(body, x, params["layers"])
+    return x, kv, lengths, router_probs
 
 
 def forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
             lengths: jnp.ndarray | None = None, rope_tables=None,
-            constrain=None, attend_override=None) -> jnp.ndarray:
+            constrain=None, attend_override=None,
+            return_router_probs: bool = False):
     """Cache-free causal forward over [B, S] tokens -> [B, S, V] f32 logits.
     The training/scoring path: no KV-cache allocation or writes.
-    ``attend_override``: see _causal_scan (ring attention hook)."""
-    x, _, _ = _causal_scan(params, cfg, tokens, lengths, tokens.shape[1],
-                           rope_tables, constrain, collect_kv=False,
-                           attend_override=attend_override)
-    return _logits(params, cfg, x)
+    ``attend_override``: see _causal_scan (ring attention hook).
+    ``return_router_probs``: also return the per-layer MoE router
+    probabilities [L, B, S, E] (the load-balancing aux-loss input);
+    returns (logits, probs) — probs is None for dense models."""
+    x, _, _, probs = _causal_scan(params, cfg, tokens, lengths,
+                                  tokens.shape[1], rope_tables, constrain,
+                                  collect_kv=False,
+                                  attend_override=attend_override,
+                                  collect_router=return_router_probs)
+    logits = _logits(params, cfg, x)
+    if return_router_probs:
+        return logits, probs
+    return logits
 
 
 def prefill(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
@@ -223,7 +302,7 @@ def prefill(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     under GSPMD, so the default stays safe for sharded jits.
     """
     S = tokens.shape[1]
-    x, (k_stack, v_stack), lengths = _causal_scan(
+    x, (k_stack, v_stack), lengths, _ = _causal_scan(
         params, cfg, tokens, lengths, cache.k.shape[2], rope_tables,
         constrain=None, collect_kv=True, flash=flash)
     # k_stack: [L, B, S, KV, hd] -> write into the cache's first S slots
@@ -268,7 +347,7 @@ def prefill_kv(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
 
     Returns (logits [B, S, V] f32, k_stack, v_stack, lengths [B]).
     """
-    x, (k_stack, v_stack), lengths = _causal_scan(
+    x, (k_stack, v_stack), lengths, _ = _causal_scan(
         params, cfg, tokens, lengths, rope_max or tokens.shape[1],
         rope_tables, constrain=None, collect_kv=True, flash=flash)
     return _logits(params, cfg, x), k_stack, v_stack, lengths
@@ -307,8 +386,8 @@ def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
             return chunk_attention(q, k_layer, v_layer, k_new, v_new, start,
                                    ks_layer, vs_layer)
 
-        x, kv = _layer(x, layer_w, cfg, cos, sin, positions,
-                       kv_write=lambda k, v: (k, v), attend=attend)
+        x, kv, _ = _layer(x, layer_w, cfg, cos, sin, positions,
+                          kv_write=lambda k, v: (k, v), attend=attend)
         return x, kv
 
     x, (k_chunk, v_chunk) = jax.lax.scan(
@@ -366,8 +445,8 @@ def decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
             return _decode_attn(q, k_layer, v_layer, k_new, v_new,
                                 lengths, ks_layer, vs_layer)
 
-        x, kv_tok = _layer(x, layer_w, cfg, cos, sin, positions,
-                           kv_write=lambda k, v: (k, v), attend=attend)
+        x, kv_tok, _ = _layer(x, layer_w, cfg, cos, sin, positions,
+                              kv_write=lambda k, v: (k, v), attend=attend)
         return x, kv_tok
 
     x, (k_toks, v_toks) = jax.lax.scan(

@@ -91,14 +91,17 @@ def maybe_quantize_tree(params, quantize: bool, *, min_size: int = 1 << 16):
 
     def is_proj_weight(k: str, v) -> bool:
         # Projection weights only: stacked [L, in, out] or plain [in, out]
-        # mats whose key marks them as weights. Biases ([L, F] — also 2-D!),
-        # norms and embeddings must stay dense: a stacked bias quantized as
-        # a 2-D weight would break the lax.scan leading-axis contract.
+        # mats whose key marks them as weights, plus 4-D [L, E, in, out]
+        # MoE expert stacks (the contraction axis is ndim-2 in every
+        # case, so one quantize call covers all ranks). Biases ([L, F] —
+        # also 2-D!), norms and embeddings must stay dense: a stacked
+        # bias quantized as a 2-D weight would break the lax.scan
+        # leading-axis contract.
         if not isinstance(v, jnp.ndarray) or v.size < min_size:
             return False
         named_weight = k.startswith("w") or k in ("lm_head", "head",
                                                   "patch_proj", "pooler_w")
-        return named_weight and v.ndim in (2, 3)
+        return named_weight and v.ndim in (2, 3, 4)
 
     def visit(d):
         if isinstance(d, dict):

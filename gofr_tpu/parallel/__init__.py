@@ -16,8 +16,9 @@ from .sharding import (activation_constraint, activation_spec, batch_spec,
                        fit_spec, kv_cache_specs, param_specs, replicated,
                        shard_params, shardings_for, spec_for)
 from .train import (TrainState, abstract_train_state, default_optimizer,
-                    init_train_state, make_train_step, next_token_loss,
-                    restore_train_state, save_train_state, state_shardings)
+                    init_train_state, load_balance_loss, make_train_step,
+                    next_token_loss, restore_train_state, save_train_state,
+                    state_shardings)
 
 __all__ = [
     "is_coordinator", "is_initialized", "maybe_initialize",
@@ -28,6 +29,7 @@ __all__ = [
     "kv_cache_specs", "param_specs", "replicated", "shard_params",
     "shardings_for", "spec_for",
     "TrainState", "abstract_train_state", "default_optimizer",
-    "init_train_state", "make_train_step", "next_token_loss",
-    "restore_train_state", "save_train_state", "state_shardings",
+    "init_train_state", "load_balance_loss", "make_train_step",
+    "next_token_loss", "restore_train_state", "save_train_state",
+    "state_shardings",
 ]

@@ -39,8 +39,12 @@ _ROW_BIAS = {"bo", "b_out"}
 def spec_for(name: str, ndim: int) -> P:
     """PartitionSpec for a parameter leaf, keyed on its dict name."""
     if name in _COLUMN:
+        if ndim == 4:  # MoE experts: [L, E, D, F] — hidden over tp, the
+            return P(None, None, AXIS_FSDP, AXIS_TP)  # same axes as dense
         return P(None, AXIS_FSDP, AXIS_TP) if ndim == 3 else P(AXIS_FSDP, AXIS_TP)
     if name in _ROW:
+        if ndim == 4:  # MoE experts: [L, E, F, D]
+            return P(None, None, AXIS_TP, AXIS_FSDP)
         return P(None, AXIS_TP, AXIS_FSDP) if ndim == 3 else P(AXIS_TP, AXIS_FSDP)
     if name in _COLUMN_BIAS:
         return P(None, AXIS_TP) if ndim == 2 else P(AXIS_TP)

@@ -75,6 +75,25 @@ def _build_state(cfg: ModelConfig,
     return build
 
 
+def load_balance_loss(router_probs: jnp.ndarray,
+                      lengths: jnp.ndarray) -> jnp.ndarray:
+    """Switch-Transformer-style MoE auxiliary loss:
+    E * mean_layers( sum_e f_e * P_e ) over VALID tokens, where f_e is
+    the fraction of tokens whose top-1 expert is e and P_e the mean
+    router probability for e. Equals 1.0 at perfect balance and climbs
+    toward E as the router collapses — the gradient pushes assignment
+    back toward uniform. router_probs: [L, B, S, E] f32."""
+    L, B, S, E = router_probs.shape
+    mask = (jnp.arange(S)[None, :] < lengths[:, None]).astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    top1 = jnp.argmax(router_probs, axis=-1)                  # [L, B, S]
+    f = jnp.sum(jax.nn.one_hot(top1, E) * mask[None, ..., None],
+                axis=(1, 2)) / denom                           # [L, E]
+    p = jnp.sum(router_probs * mask[None, ..., None],
+                axis=(1, 2)) / denom                           # [L, E]
+    return E * jnp.mean(jnp.sum(f * p, axis=-1))
+
+
 def init_train_state(cfg: ModelConfig, key, mesh: Mesh,
                      optimizer: optax.GradientTransformation) -> TrainState:
     """Init params + optimizer state DIRECTLY sharded on the mesh: the init
@@ -122,7 +141,8 @@ def state_shardings(state_like: Any, mesh: Mesh) -> Any:
 
 def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
                     mesh: Mesh, *, remat: bool = True,
-                    seq_parallel: str = "auto") -> Callable:
+                    seq_parallel: str = "auto",
+                    moe_aux_weight: float = 0.01) -> Callable:
     """Build the jitted sharded train step:
     step(state, tokens [B,S], lengths [B]) -> (state, metrics dict).
 
@@ -131,8 +151,13 @@ def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
     sp axis with ppermute); "dense" keeps the fusable jnp attention;
     "auto" (default) picks ring exactly when the mesh has sp > 1, where
     GSPMD's dense partition degrades into full-rematerialization
-    reshards (the spmd_partitioner warnings the dryrun notes)."""
+    reshards (the spmd_partitioner warnings the dryrun notes).
+
+    MoE configs (cfg.n_experts > 0) add ``moe_aux_weight`` times the
+    load-balancing loss (reported as metrics["aux_loss"]) so the router
+    cannot collapse onto a few experts."""
     constrain = activation_constraint(mesh)
+    moe = cfg.n_experts > 0
 
     use_ring = (seq_parallel == "ring"
                 or (seq_parallel == "auto"
@@ -144,16 +169,23 @@ def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
         attend_override = make_ring_attention(
             mesh, axis_name=AXIS_SP, batch_axes=(AXIS_DP, AXIS_FSDP))
 
-    fwd = (jax.checkpoint(llama.forward, static_argnums=(1, 5, 6))
+    fwd = (jax.checkpoint(llama.forward, static_argnums=(1, 5, 6, 7))
            if remat else llama.forward)
 
     def loss_fn(params, tokens, lengths):
+        if moe:
+            logits, probs = fwd(params, cfg, tokens, lengths, None,
+                                constrain, attend_override, True)
+            aux = load_balance_loss(probs, lengths)
+            lm = next_token_loss(logits, tokens, lengths)
+            return lm + moe_aux_weight * aux, aux
         logits = fwd(params, cfg, tokens, lengths, None, constrain,
-                     attend_override)
-        return next_token_loss(logits, tokens, lengths)
+                     attend_override, False)
+        return next_token_loss(logits, tokens, lengths), jnp.zeros(())
 
     def step(state: TrainState, tokens, lengths):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, lengths)
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, tokens, lengths)
         updates, opt_state = optimizer.update(grads, state.opt_state,
                                               state.params)
         params = optax.apply_updates(state.params, updates)
@@ -162,6 +194,7 @@ def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
                          opt_state=opt_state)
         return new, {"loss": loss.astype(jnp.float32),
                      "grad_norm": gnorm.astype(jnp.float32),
+                     "aux_loss": aux.astype(jnp.float32),
                      "step": new.step}
 
     def data_sharding(shape_rank2, shape_rank1):
@@ -177,7 +210,8 @@ def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
             st_sh = state_shardings(state, mesh)
             tok_sh, len_sh = data_sharding(tokens.shape, lengths.shape)
             rep = NamedSharding(mesh, P())
-            metrics_sh = {"loss": rep, "grad_norm": rep, "step": rep}
+            metrics_sh = {"loss": rep, "grad_norm": rep,
+                          "aux_loss": rep, "step": rep}
             fn = jax.jit(step,
                          in_shardings=(st_sh, tok_sh, len_sh),
                          out_shardings=(st_sh, metrics_sh),

@@ -118,9 +118,11 @@ def maybe_quantize(params: Any, enabled: bool) -> Any:
         if isinstance(node, dict):
             return {k: walk(v, k) for k, v in node.items()}
         if (name in _QUANT_LEAVES and not isinstance(node, QuantizedLinear)
-                and getattr(node, "ndim", 0) in (2, 3)):
+                and getattr(node, "ndim", 0) in (2, 3, 4)):
             w = jnp.asarray(node)
-            # stacked layers: quantize per (layer, out-channel)
+            # stacked layers / [L, E, in, out] MoE expert stacks: the
+            # contraction axis is ndim-2 in every rank — quantize per
+            # (layer[, expert], out-channel)
             axis = w.ndim - 2
             return quantize_int8(w, axis=axis)
         return node

@@ -1,0 +1,146 @@
+"""Mixture-of-experts Llama variant: routing correctness, serving, and
+sharded training on the virtual mesh.
+
+The oracle for routing math needs no external reference: with every
+expert's weights set IDENTICAL to a dense model's FFN, the top-k
+combine (weights renormalized to sum 1) must reproduce the dense model
+EXACTLY, whatever the router chooses."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.models import LLAMA_CONFIGS, llama
+
+MOE = LLAMA_CONFIGS["tiny-moe"]
+DENSE = LLAMA_CONFIGS["tiny"]
+
+
+@pytest.fixture(scope="module")
+def moe_params():
+    return llama.init(MOE, jax.random.PRNGKey(3))
+
+
+def test_identical_experts_reproduce_dense_model():
+    dense = llama.init(DENSE, jax.random.PRNGKey(1))
+    moe = llama.init(MOE, jax.random.PRNGKey(1))
+    # overwrite every expert with the dense FFN weights
+    lw = dict(moe["layers"])
+    for name in ("w_gate", "w_up", "w_down"):
+        lw[name] = jnp.broadcast_to(
+            dense["layers"][name][:, None], lw[name].shape)
+    for name in ("attn_norm", "wq", "wk", "wv", "wo", "ffn_norm"):
+        lw[name] = dense["layers"][name]
+    moe = {**moe, "layers": lw, "embedding": dense["embedding"],
+           "final_norm": dense["final_norm"],
+           "lm_head": dense["lm_head"]}
+
+    tokens = jnp.asarray([[5, 17, 42, 7, 9, 1]], jnp.int32)
+    got = llama.forward(moe, MOE, tokens)
+    want = llama.forward(dense, DENSE, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_moe_generation_through_engine(moe_params):
+    from gofr_tpu.tpu import GenerationEngine
+
+    eng = GenerationEngine(MOE, moe_params, slots=2, max_seq=64,
+                           prompt_buckets=(8, 16))
+    try:
+        got = eng.generate([5, 17, 42, 7], max_new_tokens=8).tokens()
+        # oracle: naive cache-free greedy with the same forward
+        toks = [5, 17, 42, 7]
+        for _ in range(8):
+            logits = llama.forward(moe_params, MOE,
+                                   jnp.asarray([toks], jnp.int32))
+            toks.append(int(jnp.argmax(logits[0, -1])))
+        assert got == toks[4:]
+    finally:
+        eng.close()
+
+
+def test_moe_routing_is_selective(moe_params):
+    """Different tokens must route to different experts (a collapsed
+    router would make MoE pointless); with random init the top-1 expert
+    varies across positions."""
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0,
+                                MOE.vocab_size)
+    x = moe_params["embedding"][tokens].astype(MOE.jdtype)
+    h = x  # router sees the embedded stream at layer 0 (pre-norm skipped
+    # — selectivity, not exactness, is the property under test)
+    probs = jax.nn.softmax(
+        jnp.einsum("bsd,de->bse", h, moe_params["layers"]["router"][0]),
+        axis=-1)
+    top1 = np.asarray(jnp.argmax(probs, -1)).ravel()
+    assert len(set(top1.tolist())) > 1
+
+
+def test_moe_sharded_train_step():
+    from gofr_tpu import parallel
+
+    mesh = parallel.make_mesh(dp=2, fsdp=2, tp=2)
+    opt = parallel.default_optimizer(lr=1e-3, warmup=1, total_steps=10)
+    state = parallel.init_train_state(MOE, jax.random.PRNGKey(0), mesh, opt)
+    step = parallel.make_train_step(MOE, opt, mesh, remat=False)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                MOE.vocab_size)
+    lengths = jnp.full((4,), 32, jnp.int32)
+    losses = []
+    for _ in range(5):
+        state, m = step(state, tokens, lengths)
+        losses.append(float(m["loss"]))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses  # it learns (past lr warmup)
+    # expert weights actually sharded: hidden dim over tp
+    assert state.params["layers"]["w_gate"].sharding.spec[3] == "tp"
+
+
+def test_moe_int8_quantized_serving(moe_params):
+    """TPU_QUANT=int8 must actually quantize the 4D expert stacks (the
+    bulk of an MoE model's weights) and serve through them."""
+    from gofr_tpu.ops.quant import QuantizedLinear
+    from gofr_tpu.tpu import GenerationEngine, maybe_quantize
+
+    q = maybe_quantize(moe_params, True)
+    for name in ("w_gate", "w_up", "w_down"):
+        assert isinstance(q["layers"][name], QuantizedLinear), name
+    # int8 quantization error must stay small at the logits level
+    tokens = jnp.asarray([[5, 17, 42, 7]], jnp.int32)
+    dense_logits = llama.forward(moe_params, MOE, tokens)
+    quant_logits = llama.forward(q, MOE, tokens)
+    top_dense = np.asarray(jnp.argsort(dense_logits[0, -1]))[-3:]
+    top_quant = np.asarray(jnp.argsort(quant_logits[0, -1]))[-3:]
+    assert top_dense[-1] == top_quant[-1]  # argmax survives int8
+
+    eng = GenerationEngine(MOE, q, slots=2, max_seq=64, prompt_buckets=(8,))
+    try:
+        assert len(eng.generate([5, 17, 42], max_new_tokens=4).tokens()) == 4
+    finally:
+        eng.close()
+
+
+def test_load_balance_loss_properties():
+    from gofr_tpu.parallel import load_balance_loss
+
+    L, B, S, E = 2, 2, 8, 4
+    lengths = jnp.asarray([8, 5], jnp.int32)
+    uniform = jnp.full((L, B, S, E), 1.0 / E, jnp.float32)
+    assert abs(float(load_balance_loss(uniform, lengths)) - 1.0) < 1e-5
+    collapsed = jax.nn.one_hot(jnp.zeros((L, B, S), jnp.int32), E)
+    assert abs(float(load_balance_loss(collapsed, lengths)) - E) < 1e-5
+
+
+def test_moe_train_reports_aux_loss():
+    from gofr_tpu import parallel
+
+    mesh = parallel.make_mesh(dp=4, fsdp=2)
+    opt = parallel.default_optimizer(lr=1e-3, warmup=1, total_steps=10)
+    state = parallel.init_train_state(MOE, jax.random.PRNGKey(0), mesh, opt)
+    step = parallel.make_train_step(MOE, opt, mesh, remat=True)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                MOE.vocab_size)
+    state, m = step(state, tokens, jnp.full((4,), 32, jnp.int32))
+    aux = float(m["aux_loss"])
+    assert np.isfinite(aux) and 0.9 <= aux <= MOE.n_experts + 0.1
