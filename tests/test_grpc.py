@@ -387,8 +387,12 @@ def test_app_grpc_token_streaming():
     app.run(block=False)
     try:
         ch = dial(f"127.0.0.1:{app.grpc_port}")
+        # generous deadline: the first request compiles the engine's
+        # bucket programs, and loaded CI boxes have stretched the default
+        # 60 s past breaking (observed under a concurrent full-suite run)
         toks = [m["token"] for m in ch.server_stream(
-            "/llm.Generation/Generate", {"tokens": [5, 17, 42], "max_new_tokens": 6})]
+            "/llm.Generation/Generate",
+            {"tokens": [5, 17, 42], "max_new_tokens": 6}, timeout=240.0)]
         assert len(toks) == 6
         assert all(isinstance(t, int) for t in toks)
         ch.close()
@@ -425,7 +429,7 @@ def test_app_grpc_bidi_generation_cancel_releases_slot():
     gen = app.container.tpu.generator
     try:
         ch = dial(f"127.0.0.1:{app.grpc_port}")
-        call = ch.bidi_stream("/llm.Generation/Chat")
+        call = ch.bidi_stream("/llm.Generation/Chat", timeout=240.0)
         it = iter(call)
         # turn 1: full generation, then the turn marker
         call.send({"tokens": [5, 17, 42], "max_new": 4})
@@ -436,13 +440,17 @@ def test_app_grpc_bidi_generation_cancel_releases_slot():
         call.send({"tokens": [1, 2, 3], "max_new": 1000})
         assert "token" in next(it)
         call.cancel()
-        for _ in range(200):
+        # generous deadline for the same loaded-CI reason as the call
+        # timeouts above: RST propagation + handler teardown + slot
+        # release can stretch well past a couple of seconds under load
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
             if gen.stats()["active"] == 0 and gen._pending.qsize() == 0:
                 break
-            time.sleep(0.01)
+            time.sleep(0.02)
         assert gen.stats()["active"] == 0
         # a fresh turn on a NEW call must get the (only) slot
-        call2 = ch.bidi_stream("/llm.Generation/Chat")
+        call2 = ch.bidi_stream("/llm.Generation/Chat", timeout=240.0)
         call2.send({"tokens": [9, 9], "max_new": 3})
         call2.close_send()
         toks = [m["token"] for m in call2 if "token" in m]
