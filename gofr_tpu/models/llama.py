@@ -123,22 +123,85 @@ def init(cfg: ModelConfig, key) -> dict:
     return params
 
 
-def _expert_mm(h, w, pattern: str):
+def _expert_mm(h, w, pattern: str, scale_expand=(None, None)):
     """Per-expert einsum that consumes int8 QuantizedLinear expert stacks
     ([E, in, out] int8 + [E, out] scale) the same way ops.quant.qmatmul
     does for dense weights: upcast in-register, scale after the
     contraction (constant over the contracted axis, so XLA keeps it
-    fused — the experts are never materialized in bf16)."""
+    fused — the experts are never materialized in bf16).
+    ``scale_expand``: axes to insert into the [E, out] scale so it
+    broadcasts against the output — (None, None) prepends two (the
+    [B,S,E,out] dense-dispatch layout); for [E,C,out] grouped buffers
+    pass (slice(None), None)."""
     from ..ops.quant import QuantizedLinear
 
     if isinstance(w, QuantizedLinear):
         y = jnp.einsum(pattern, h, w.w.astype(h.dtype),
                        preferred_element_type=jnp.float32)
-        return (y * w.scale[None, None]).astype(h.dtype)
+        return (y * w.scale[scale_expand]).astype(h.dtype)
     return jnp.einsum(pattern, h, w)
 
 
-def _moe_ffn(h, layer_w, cfg: ModelConfig):
+def _moe_ffn_grouped(h, layer_w, cfg: ModelConfig, valid=None):
+    """Capacity-based grouped MoE dispatch — the at-scale sibling of the
+    dense-dispatch path: tokens scatter into per-expert buffers
+    [E, C, D] (C = capacity_factor * T * k / E), each expert runs ONE
+    batched FFN over its buffer, outputs gather back and combine by the
+    renormalized top-k router weights. Compute is k/E of dense dispatch;
+    the price is the standard Switch/Mixtral drop rule — assignments
+    past an expert's capacity contribute zero (the residual stream
+    carries those tokens unchanged). All shapes static: position-in-
+    buffer comes from a cumsum over one-hot assignments, over-capacity
+    writes land out of range and scatter-drop."""
+    import math
+
+    B, S, D = h.shape
+    T = B * S
+    E, K = cfg.n_experts, cfg.experts_per_token
+    # ceil, not truncate: at capacity_factor=1.0 a perfectly balanced
+    # router must fit with zero drops (Switch's convention)
+    cap = max(1, math.ceil(cfg.moe_capacity_factor * T * K / E))
+    hf = h.reshape(T, D)
+
+    probs = jax.nn.softmax(
+        jnp.einsum("td,de->te", hf, layer_w["router"],
+                   preferred_element_type=jnp.float32), axis=-1)  # [T,E]
+    topv, topi = jax.lax.top_k(probs, K)
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+
+    flat_e = topi.reshape(T * K)                         # assignment order:
+    tok_of = jnp.repeat(jnp.arange(T), K)                # token-major, so
+    onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)  # earlier tokens win
+    if valid is not None:
+        # padding/inactive tokens must not claim expert capacity (they
+        # would evict REAL tokens' assignments): zero their one-hot so
+        # the position cumsum skips them, and drop their writes
+        vflat = valid.reshape(T)[tok_of]
+        onehot = onehot * vflat[:, None].astype(onehot.dtype)
+    pos = jnp.take_along_axis(jnp.cumsum(onehot, axis=0) - onehot,
+                              flat_e[:, None], axis=1)[:, 0]  # [T*K]
+    keep = pos < cap
+    if valid is not None:
+        keep = keep & vflat
+
+    buf = jnp.zeros((E, cap, D), h.dtype)
+    buf = buf.at[flat_e, jnp.where(keep, pos, cap)].set(
+        hf[tok_of], mode="drop")                          # [E, C, D]
+
+    grouped = (slice(None), None)
+    gated = jax.nn.silu(_expert_mm(buf, layer_w["w_gate"], "ecd,edf->ecf",
+                                   grouped)) \
+        * _expert_mm(buf, layer_w["w_up"], "ecd,edf->ecf", grouped)
+    out_buf = _expert_mm(gated, layer_w["w_down"], "ecf,efd->ecd", grouped)
+
+    vals = out_buf[flat_e, jnp.where(keep, pos, 0)]       # [T*K, D]
+    vals = vals * keep[:, None].astype(vals.dtype)
+    out = jnp.sum(vals.reshape(T, K, D)
+                  * topv.reshape(T, K, 1).astype(vals.dtype), axis=1)
+    return out.reshape(B, S, D), probs.reshape(B, S, E)
+
+
+def _moe_ffn(h, layer_w, cfg: ModelConfig, valid=None):
     """Mixture-of-experts SwiGLU FFN: softmax router, top-k expert
     selection with renormalized weights, dense-dispatch combine.
 
@@ -146,11 +209,10 @@ def _moe_ffn(h, layer_w, cfg: ModelConfig):
     [B,S,E] weight matrix that is zero off the top-k) keeps shapes
     static and the whole layer one fused einsum chain — XLA-friendly and
     exactly correct. It spends E/k times the FLOPs of routed dispatch,
-    which is the right trade below ~8 experts per chip; capacity-based
-    gather dispatch is the extension point when expert counts grow past
-    what dense dispatch amortizes (experts would shard over their own
-    mesh axis, specs in parallel/sharding.py already carry the [L,E,..]
-    rank).
+    which is the right trade below ~8 experts per chip; set
+    ``cfg.moe_capacity_factor > 0`` to switch to capacity-based grouped
+    dispatch (_moe_ffn_grouped) when expert counts grow past what dense
+    dispatch amortizes.
 
     Weights: router [D,E]; w_gate/w_up [E,D,F]; w_down [E,F,D] — dense
     or int8 QuantizedLinear stacks (TPU_QUANT=int8 quantizes experts
@@ -158,6 +220,8 @@ def _moe_ffn(h, layer_w, cfg: ModelConfig):
     Returns (ffn_out [B,S,D], router_probs [B,S,E] f32 — the aux
     load-balancing loss input, collected by the training path).
     """
+    if cfg.moe_capacity_factor > 0:
+        return _moe_ffn_grouped(h, layer_w, cfg, valid)
     probs = jax.nn.softmax(
         jnp.einsum("bsd,de->bse", h, layer_w["router"],
                    preferred_element_type=jnp.float32), axis=-1)
@@ -176,7 +240,7 @@ def _moe_ffn(h, layer_w, cfg: ModelConfig):
 
 
 def _layer(x, layer_w, cfg: ModelConfig, cos, sin, positions,
-           kv_write, attend):
+           kv_write, attend, valid=None):
     """One transformer block. ``kv_write(k_new, v_new) -> (k_all, v_all)``
     handles cache interaction; ``attend(q, k, v)`` runs attention.
     Returns (x_out, (k_stored, v_stored))."""
@@ -197,7 +261,7 @@ def _layer(x, layer_w, cfg: ModelConfig, cos, sin, positions,
     h = rms_norm(x, layer_w["ffn_norm"], cfg.norm_eps)
     router_probs = None
     if cfg.n_experts > 0:
-        ffn, router_probs = _moe_ffn(h, layer_w, cfg)
+        ffn, router_probs = _moe_ffn(h, layer_w, cfg, valid)
         x = x + ffn
     else:
         gated = jax.nn.silu(qmatmul(h, layer_w["w_gate"])) * qmatmul(h, layer_w["w_up"])
@@ -259,7 +323,8 @@ def _causal_scan(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
 
     def body(x, layer_w):
         x, kv, probs = _layer(x, layer_w, cfg, cos, sin, positions,
-                              kv_write=lambda k, v: (k, v), attend=attend)
+                              kv_write=lambda k, v: (k, v), attend=attend,
+                              valid=valid)
         # Training drops the per-layer k/v so the scan never materializes
         # the [L,B,S,KV,hd] stacks it would otherwise carry.
         return constrain(x), (kv if collect_kv else None,

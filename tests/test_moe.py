@@ -144,3 +144,75 @@ def test_moe_train_reports_aux_loss():
     state, m = step(state, tokens, jnp.full((4,), 32, jnp.int32))
     aux = float(m["aux_loss"])
     assert np.isfinite(aux) and 0.9 <= aux <= MOE.n_experts + 0.1
+
+
+def test_grouped_dispatch_matches_dense_with_ample_capacity(moe_params):
+    """capacity_factor high enough that nothing drops => grouped dispatch
+    must reproduce dense dispatch exactly (same experts, same weights,
+    same combine — only the execution layout differs)."""
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 16), 0,
+                                MOE.vocab_size)
+    dense_cfg = MOE
+    grouped_cfg = MOE.with_(moe_capacity_factor=float(MOE.n_experts))
+    want = llama.forward(moe_params, dense_cfg, tokens)
+    got = llama.forward(moe_params, grouped_cfg, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # and with int8 experts: both dispatch layouts consume the same
+    # QuantizedLinear stacks and must still agree exactly
+    from gofr_tpu.tpu import maybe_quantize
+
+    q = maybe_quantize(moe_params, True)
+    np.testing.assert_allclose(
+        np.asarray(llama.forward(q, grouped_cfg, tokens)),
+        np.asarray(llama.forward(q, dense_cfg, tokens)),
+        rtol=2e-5, atol=2e-5)
+
+
+def test_grouped_dispatch_drops_over_capacity():
+    """A capacity factor near zero forces drops: outputs shrink toward
+    the residual stream (the FFN contribution zeroes for dropped
+    assignments) but stay finite and the model still runs end to end."""
+    cfg = MOE.with_(moe_capacity_factor=0.05)
+    params = llama.init(cfg, jax.random.PRNGKey(3))
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (2, 16), 0,
+                                cfg.vocab_size)
+    logits = llama.forward(params, cfg, tokens)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_grouped_dispatch_trains_sharded():
+    from gofr_tpu import parallel
+
+    cfg = MOE.with_(moe_capacity_factor=2.0)
+    mesh = parallel.make_mesh(dp=2, fsdp=2, tp=2)
+    opt = parallel.default_optimizer(lr=1e-3, warmup=1, total_steps=10)
+    state = parallel.init_train_state(cfg, jax.random.PRNGKey(0), mesh, opt)
+    step = parallel.make_train_step(cfg, opt, mesh, remat=True)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                cfg.vocab_size)
+    state, m = step(state, tokens, jnp.full((4,), 32, jnp.int32))
+    assert np.isfinite(float(m["loss"])) and np.isfinite(float(m["aux_loss"]))
+
+
+def test_grouped_dispatch_padding_cannot_evict_real_tokens(moe_params):
+    """A padded neighbor's position in the batch must be invisible to a
+    real sequence: capacity claims are token-major, so an UNMASKED pad
+    sequence placed first would grab buffer slots ahead of the real
+    tokens (different drops => different logits than when it rides
+    last). With lengths masking wired through, pads claim nothing and
+    the real sequence's logits are identical under batch reordering."""
+    cfg = MOE.with_(moe_capacity_factor=1.0)
+    rng = np.random.default_rng(9)
+    real = rng.integers(1, cfg.vocab_size, (1, 16)).astype(np.int32)
+    pad_seq = np.zeros((1, 16), np.int32)
+
+    pad_first = llama.forward(
+        moe_params, cfg, jnp.asarray(np.concatenate([pad_seq, real])),
+        jnp.asarray([1, 16], jnp.int32))
+    pad_last = llama.forward(
+        moe_params, cfg, jnp.asarray(np.concatenate([real, pad_seq])),
+        jnp.asarray([16, 1], jnp.int32))
+    np.testing.assert_allclose(np.asarray(pad_first[1]),
+                               np.asarray(pad_last[0]),
+                               rtol=2e-5, atol=2e-5)
