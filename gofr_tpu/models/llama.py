@@ -142,6 +142,20 @@ def _expert_mm(h, w, pattern: str, scale_expand=(None, None)):
     return jnp.einsum(pattern, h, w)
 
 
+def _route(hf, router, k: int):
+    """The ONE routing definition both dispatch layouts share: f32
+    softmax over expert logits, top-k selection, renormalized weights.
+    hf: [T, D] flattened tokens. Returns (probs [T,E], topv, topi
+    [T,k]) — any future routing change (z-loss, jitter) lands here once
+    so the dense/grouped equivalence tests keep meaning something."""
+    probs = jax.nn.softmax(
+        jnp.einsum("td,de->te", hf, router,
+                   preferred_element_type=jnp.float32), axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    return probs, topv, topi
+
+
 def _moe_ffn_grouped(h, layer_w, cfg: ModelConfig, valid=None):
     """Capacity-based grouped MoE dispatch — the at-scale sibling of the
     dense-dispatch path: tokens scatter into per-expert buffers
@@ -163,11 +177,7 @@ def _moe_ffn_grouped(h, layer_w, cfg: ModelConfig, valid=None):
     cap = max(1, math.ceil(cfg.moe_capacity_factor * T * K / E))
     hf = h.reshape(T, D)
 
-    probs = jax.nn.softmax(
-        jnp.einsum("td,de->te", hf, layer_w["router"],
-                   preferred_element_type=jnp.float32), axis=-1)  # [T,E]
-    topv, topi = jax.lax.top_k(probs, K)
-    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    probs, topv, topi = _route(hf, layer_w["router"], K)      # [T, ...]
 
     flat_e = topi.reshape(T * K)                         # assignment order:
     tok_of = jnp.repeat(jnp.arange(T), K)                # token-major, so
@@ -222,11 +232,12 @@ def _moe_ffn(h, layer_w, cfg: ModelConfig, valid=None):
     """
     if cfg.moe_capacity_factor > 0:
         return _moe_ffn_grouped(h, layer_w, cfg, valid)
-    probs = jax.nn.softmax(
-        jnp.einsum("bsd,de->bse", h, layer_w["router"],
-                   preferred_element_type=jnp.float32), axis=-1)
-    topv, topi = jax.lax.top_k(probs, cfg.experts_per_token)   # [B,S,k]
-    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    B, S, D = h.shape
+    probs, topv, topi = _route(h.reshape(B * S, D), layer_w["router"],
+                               cfg.experts_per_token)
+    probs = probs.reshape(B, S, -1)
+    topv = topv.reshape(B, S, -1)
+    topi = topi.reshape(B, S, -1)
     # combine weights: zero everywhere except the chosen experts
     combine = jnp.sum(
         jax.nn.one_hot(topi, cfg.n_experts, dtype=topv.dtype)
@@ -491,6 +502,15 @@ def decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     are possible under jit). The serving engine retires slots before they
     hit capacity.
     """
+    if cfg.n_experts > 0 and cfg.moe_capacity_factor > 0:
+        # Grouped MoE dispatch is FORBIDDEN at decode: with T = B the
+        # per-expert capacity is tiny and token-major claims let one
+        # batch slot evict another's expert assignment — slot 0's token
+        # would change slot 1's logits, violating the serving engine's
+        # slot-isolation invariant (verified: up to 0.5 logit cross-talk
+        # at capacity_factor=1.0). Dense dispatch at T=B costs E/k of a
+        # few token-FFNs — noise next to the weight stream.
+        cfg = cfg.with_(moe_capacity_factor=0.0)
     B = tokens.shape[0]
     cos, sin = rope_tables or get_rope_tables(cfg, cache.k.shape[2])
     positions = cache.lengths[:, None]  # [B,1] — this token's position

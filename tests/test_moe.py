@@ -216,3 +216,22 @@ def test_grouped_dispatch_padding_cannot_evict_real_tokens(moe_params):
     np.testing.assert_allclose(np.asarray(pad_first[1]),
                                np.asarray(pad_last[0]),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_grouped_moe_decode_preserves_slot_isolation(moe_params):
+    """decode_step must force dense dispatch for MoE: grouped capacity
+    claims at T=B would let slot 0's token evict slot 1's expert
+    assignment — one request's output changing with an unrelated batch
+    occupant breaks the engine's slot-isolation invariant."""
+    cfg = MOE.with_(moe_capacity_factor=1.0)
+    cache = llama.init_cache(cfg, 2, 32)
+    cache = cache._replace(lengths=jnp.asarray([4, 4], jnp.int32))
+    base = None
+    for other in (0, 7, 101, 200):  # sweep slot 0's token
+        toks = jnp.asarray([other, 42], jnp.int32)
+        logits, _ = llama.decode_step(moe_params, cfg, toks, cache)
+        if base is None:
+            base = np.asarray(logits[1])
+        else:
+            np.testing.assert_allclose(np.asarray(logits[1]), base,
+                                       rtol=1e-6, atol=1e-6)

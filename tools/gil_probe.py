@@ -1,0 +1,81 @@
+"""Diagnose consumer-thread starvation during decode blocks.
+
+The r3 finding this probes: gRPC-stream TTFT ran ~120 ms (one decode
+block) above engine-level TTFT (PERF.md). Hypothesis: while the serving
+loop blocks in a device call through the axon tunnel, the GIL (or
+scheduler) starves the gRPC server/client socket threads. This script
+measures localhost TCP round-trip latency between two Python threads
+while a realistic 8B decode loop runs in a third — if busy-RTT jumps to
+~block duration, the starvation is confirmed and the fix is a
+scheduling yield in the decode loop; if it stays ~idle-RTT, look at the
+transport instead.
+
+Run ON THE CHIP BOX: env -u XLA_FLAGS -u JAX_PLATFORMS python tools/gil_probe.py
+"""
+
+import time, sys, threading, functools, socket, statistics
+import jax, jax.numpy as jnp, numpy as np
+sys.path.insert(0, "/root/repo")
+from gofr_tpu.models import llama
+from gofr_tpu.models.common import LLAMA_CONFIGS
+from bench import int8_random_params
+
+cfg = LLAMA_CONFIGS["llama3-8b"]
+batch, cache_len, K = 64, 1024, 4
+params = int8_random_params(cfg, jax.random.PRNGKey(0))
+cache = llama.init_cache(cfg, batch, cache_len, dtype=jnp.int8)
+rope = llama.get_rope_tables(cfg, cache_len)
+cache = cache._replace(lengths=jnp.full((batch,), 32, jnp.int32))
+tokens = jnp.zeros((batch,), jnp.int32)
+
+@functools.partial(jax.jit, donate_argnums=(3,))
+def multistep(params, rope, tokens, cache):
+    def body(carry, _):
+        t, c = carry
+        logits, c = llama.decode_step(params, cfg, t, c, rope)
+        return (jnp.argmax(logits, -1).astype(jnp.int32), c), t
+    (t, c), toks = jax.lax.scan(body, (tokens, cache), None, length=K)
+    return t, c, toks
+
+tokens, cache, toks = multistep(params, rope, tokens, cache); np.asarray(toks)
+print("compiled", flush=True)
+
+stop = threading.Event()
+def decode_loop():
+    global tokens, cache
+    while not stop.is_set():
+        t, c, tk = multistep(params, rope, tokens, cache)
+        tokens, cache = t, c
+        np.asarray(tk)   # the fetch the engine loop does
+
+# localhost TCP echo pair
+srv = socket.socket(); srv.bind(("127.0.0.1", 0)); srv.listen(1)
+port = srv.getsockname()[1]
+def echo():
+    conn, _ = srv.accept()
+    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    while True:
+        d = conn.recv(64)
+        if not d: return
+        conn.sendall(d)
+threading.Thread(target=echo, daemon=True).start()
+cli = socket.create_connection(("127.0.0.1", port))
+cli.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+def rtt_samples(n=40):
+    out = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        cli.sendall(b"x"); cli.recv(64)
+        out.append((time.perf_counter() - t0) * 1e3)
+        time.sleep(0.01)
+    return out
+
+idle = rtt_samples()
+print(f"idle RTT p50={statistics.median(idle):.2f}ms max={max(idle):.2f}ms", flush=True)
+
+th = threading.Thread(target=decode_loop, daemon=True); th.start()
+time.sleep(1.0)
+busy = rtt_samples()
+stop.set(); th.join(timeout=30)
+print(f"busy RTT p50={statistics.median(busy):.2f}ms max={max(busy):.2f}ms", flush=True)
