@@ -475,6 +475,21 @@ def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     return logits, cache
 
 
+def multi_request_serving_config(cfg: ModelConfig) -> ModelConfig:
+    """Config for any program that batches UNRELATED requests into one
+    forward — decode over the slot pool, the engine's coalesced ``score``
+    batches. Grouped MoE dispatch is FORBIDDEN there: capacity claims are
+    token-major across the whole batch, so request A's tokens can evict
+    request B's expert assignments and B's output would depend on what A
+    routed to (verified: up to 0.5 logit cross-talk at
+    capacity_factor=1.0). Dense dispatch keeps every request's result
+    independent of its batch-mates; per-request programs (prefill of one
+    prompt, training steps) keep grouped dispatch."""
+    if cfg.n_experts > 0 and cfg.moe_capacity_factor > 0:
+        return cfg.with_(moe_capacity_factor=0.0)
+    return cfg
+
+
 def decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                 cache: KVCache, rope_tables=None,
                 flash: bool = False) -> tuple[jnp.ndarray, KVCache]:
@@ -502,15 +517,9 @@ def decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     are possible under jit). The serving engine retires slots before they
     hit capacity.
     """
-    if cfg.n_experts > 0 and cfg.moe_capacity_factor > 0:
-        # Grouped MoE dispatch is FORBIDDEN at decode: with T = B the
-        # per-expert capacity is tiny and token-major claims let one
-        # batch slot evict another's expert assignment — slot 0's token
-        # would change slot 1's logits, violating the serving engine's
-        # slot-isolation invariant (verified: up to 0.5 logit cross-talk
-        # at capacity_factor=1.0). Dense dispatch at T=B costs E/k of a
-        # few token-FFNs — noise next to the weight stream.
-        cfg = cfg.with_(moe_capacity_factor=0.0)
+    # slot isolation: grouped MoE dispatch would couple batch slots
+    # (see multi_request_serving_config) — force dense at decode
+    cfg = multi_request_serving_config(cfg)
     B = tokens.shape[0]
     cos, sin = rope_tables or get_rope_tables(cfg, cache.k.shape[2])
     positions = cache.lengths[:, None]  # [B,1] — this token's position

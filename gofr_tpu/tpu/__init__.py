@@ -26,6 +26,10 @@ Config keys (reference config style, pkg/gofr/config/config.go:3):
                       the stream sees K tokens per roundtrip; raise on
                       high-latency links, lower toward 1 for tightest
                       per-token latency)
+  TPU_ADMIT_WINDOW_MS post-block GIL-yield window in ms (default 2 —
+                      lets request-submitter threads parked on the GIL
+                      during a device block enqueue before the next
+                      block's admission check; 0 disables)
   TPU_BATCH_BUCKETS   csv of predict batch buckets (default 1,2,4,8)
   TPU_SEQ_BUCKETS     csv of token-length buckets  (default 32..512)
   TPU_MAX_BATCH_DELAY coalescing window in seconds (default 0.004)
@@ -143,12 +147,19 @@ def new_engine_from_config(cfg, logger=None, metrics=None) -> TPUEngine:
         engine.generator = GenerationEngine(
             mc, params, slots=slots, max_seq=max_seq, prompt_buckets=prompt_b,
             logger=logger, metrics=metrics, mesh=mesh, kv_dtype=kv_dtype,
-            decode_block=cfg.get_int("TPU_DECODE_BLOCK", 4))
+            decode_block=cfg.get_int("TPU_DECODE_BLOCK", 4),
+            admit_window_ms=cfg.get_float("TPU_ADMIT_WINDOW_MS", 2.0))
 
         # scoring program: next-token logits at the prompt end (the
-        # non-streaming sibling of generate, e.g. for classification heads)
+        # non-streaming sibling of generate, e.g. for classification
+        # heads). The batcher coalesces UNRELATED requests into one
+        # [B, S] batch, so grouped MoE dispatch is forbidden here just
+        # like at decode — request isolation (llama.py:
+        # multi_request_serving_config).
+        score_mc = llama.multi_request_serving_config(mc)
+
         def score_fn(p, tokens, lengths):
-            logits = llama.forward(p, mc, tokens, lengths)
+            logits = llama.forward(p, score_mc, tokens, lengths)
             idx = jnp.maximum(lengths - 1, 0)
             return jnp.take_along_axis(
                 logits, idx[:, None, None], axis=1)[:, 0]

@@ -109,7 +109,8 @@ class GenerationEngine:
                  max_seq: int | None = None,
                  prompt_buckets: tuple[int, ...] = (32, 64, 128, 256, 512),
                  logger=None, metrics=None, seed: int = 0, mesh=None,
-                 kv_dtype=None, decode_block: int = 4):
+                 kv_dtype=None, decode_block: int = 4,
+                 admit_window_ms: float = 2.0):
         self.cfg = cfg
         self.params = params
         self.n_slots = slots
@@ -118,6 +119,17 @@ class GenerationEngine:
         # dispatch/tunnel latency K-fold. Cost: a finished stream wastes at
         # most K-1 slot-steps, and admission waits at most one block.
         self.decode_block = max(1, int(decode_block))
+        # Post-block GIL-yield window (seconds). On backends whose
+        # blocking device calls hold the GIL (the tunneled axon platform
+        # does), a submitter thread that received a request mid-block —
+        # the gRPC connection thread, an HTTP handler — is still parked
+        # on the GIL when the block ends, loses the race to the next
+        # _admit by microseconds, and eats one extra decode block of
+        # TTFT (~measured +134 ms at K=4). Sleeping a moment after each
+        # block hands the GIL to parked submitters so their requests
+        # make the very next admission check. Costs window/K per token
+        # (<1% at defaults); 0 disables.
+        self._admit_window = max(0.0, float(admit_window_ms)) / 1e3
         # flash-decode kernel (ops.flash_decode): single-device only
         # (pallas is opaque to GSPMD) and opt-in while hardware timings
         # are being validated — GOFR_FLASH_DECODE=1 enables.
@@ -539,6 +551,10 @@ class GenerationEngine:
                 if self._active.any() or not self._pending.empty():
                     with self._device_lock:
                         self._iteration()
+                    if self._admit_window > 0 and self._active.any():
+                        # yield the GIL to request-submitter threads
+                        # parked during the device block (see __init__)
+                        time.sleep(self._admit_window)
                 else:
                     self._work.wait(timeout=0.05)
                     self._work.clear()

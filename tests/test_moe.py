@@ -218,6 +218,31 @@ def test_grouped_dispatch_padding_cannot_evict_real_tokens(moe_params):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_score_batches_preserve_request_isolation(moe_params):
+    """The engine's coalesced ``score`` program must not let one
+    request's prompt change another's logits. Grouped dispatch WOULD
+    (cross-batch capacity eviction); multi_request_serving_config
+    forces dense for such programs — sweep request 0's prompt and pin
+    request 1's scores (the same invariant decode_step enforces,
+    applied to the batched-forward path)."""
+    cfg = MOE.with_(moe_capacity_factor=1.0)
+    serving = llama.multi_request_serving_config(cfg)
+    assert serving.moe_capacity_factor == 0.0
+    # per-request programs keep grouped dispatch untouched
+    assert llama.multi_request_serving_config(MOE) is MOE
+    pinned = jnp.asarray([7, 42, 3, 9], jnp.int32)
+    lens = jnp.asarray([4, 4], jnp.int32)
+    base = None
+    for other in (0, 7, 101, 200):
+        toks = jnp.stack([jnp.full((4,), other, jnp.int32), pinned])
+        logits = llama.forward(moe_params, serving, toks, lens)
+        if base is None:
+            base = np.asarray(logits[1])
+        else:
+            np.testing.assert_allclose(np.asarray(logits[1]), base,
+                                       rtol=1e-6, atol=1e-6)
+
+
 def test_grouped_moe_decode_preserves_slot_isolation(moe_params):
     """decode_step must force dense dispatch for MoE: grouped capacity
     claims at T=B would let slot 0's token evict slot 1's expert

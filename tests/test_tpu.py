@@ -244,6 +244,38 @@ def test_decode_block_size_is_numerically_invisible(tiny_llama):
     assert outs[1] == outs[3] == outs[8]
 
 
+def test_admit_window_yields_between_blocks(tiny_llama):
+    """The post-block GIL-yield window (admit_window_ms) must be
+    numerically invisible: a request submitted from another thread while
+    decode blocks are in flight streams the exact greedy tokens, and
+    disabling the window (0) behaves identically. The window exists for
+    backends whose blocking device calls hold the GIL (PERF.md: the
+    gRPC-TTFT gap was one full decode block of admission lag)."""
+    for window in (2.0, 0.0):
+        eng = GenerationEngine(TINY, tiny_llama, slots=4, max_seq=64,
+                               prompt_buckets=(8,), decode_block=4,
+                               admit_window_ms=window)
+        try:
+            bg = eng.generate([3, 1, 4], max_new_tokens=48)
+            it = iter(bg)
+            next(it)  # decode loop is live and blocking in device steps
+            done = []
+
+            def submit():
+                done.append(
+                    eng.generate([5, 17, 42, 7], max_new_tokens=6).tokens())
+
+            t = threading.Thread(target=submit)
+            t.start()
+            t.join(timeout=30)
+            assert not t.is_alive(), "mid-decode submission never admitted"
+            assert done[0] == _reference_greedy(tiny_llama, [5, 17, 42, 7], 6)
+            bg.cancel()
+            list(it)
+        finally:
+            eng.close()
+
+
 def test_chunked_admission_keeps_decode_flowing():
     """A long chunked admission must not stall active decode streams:
     decode blocks interleave between prompt chunks (VERDICT r2 weak #5 —
@@ -383,8 +415,9 @@ def test_generation_streaming_is_incremental(gen_engine):
 
 
 def test_engine_generate_via_config_and_warmup():
-    eng = new_engine_from_config(_mock_cfg())
+    eng = new_engine_from_config(_mock_cfg(TPU_ADMIT_WINDOW_MS="0.5"))
     try:
+        assert eng.generator._admit_window == pytest.approx(0.5e-3)
         eng.warmup()
         toks = eng.generate([1, 2, 3], max_new_tokens=4).tokens()
         assert len(toks) == 4

@@ -13,9 +13,9 @@ transport instead.
 Run ON THE CHIP BOX: env -u XLA_FLAGS -u JAX_PLATFORMS python tools/gil_probe.py
 """
 
-import time, sys, threading, functools, socket, statistics
+import os, time, sys, threading, functools, socket, statistics
 import jax, jax.numpy as jnp, numpy as np
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from gofr_tpu.models import llama
 from gofr_tpu.models.common import LLAMA_CONFIGS
 from bench import int8_random_params
