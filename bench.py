@@ -455,7 +455,8 @@ def main() -> None:
 
     cfg = LLAMA_CONFIGS["llama3-8b"]
     try:
-        res = bench_decode_best(cfg, (64, 48, 32, 24, 16, 8), cache_len=1024)
+        res = bench_decode_best(cfg, (96, 80, 64, 48, 32, 24, 16, 8),
+                                cache_len=1024)
     except Exception as e:
         emit({"metric": metric, "value": 0.0, "unit": "tok/s",
               "vs_baseline": 0.0,
