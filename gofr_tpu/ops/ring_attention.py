@@ -107,7 +107,8 @@ def ring_causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 
 def make_ring_attention(mesh, *, axis_name: str = "sp",
-                        batch_axes=("dp", "fsdp"), head_axis: str = "tp"):
+                        batch_axes=("dp", "fsdp", "ep"),
+                        head_axis: str = "tp"):
     """shard_map-wrapped ring attention over ``mesh``.
 
     Returns attend(q [B,S,H,D], k, v [B,S,KV,D], lengths [B] | None)

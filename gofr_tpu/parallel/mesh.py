@@ -12,11 +12,17 @@ Axis vocabulary (the scaling-book recipe):
   fsdp  data parallelism with parameter sharding (ZeRO-3 style): batch is
         split over (dp, fsdp) jointly; params/optimizer shard over fsdp and
         are all-gathered per layer by XLA
+  ep    expert parallelism — MoE expert dim split over ep; the batch also
+        splits over ep (dense layers see it as one more data axis, their
+        params replicate over it), so GSPMD's partition of the grouped
+        dispatch scatter/gather IS the classic MoE all-to-all: tokens
+        leave batch-sharded, land expert-sharded, and return
   sp    sequence/context parallelism — activation sequence axis
   tp    tensor parallelism — attention heads / FFN hidden, the innermost
         axis so its collectives ride the fastest ICI links
-Axis order in the mesh is (dp, fsdp, sp, tp): JAX lays consecutive devices
-on the innermost axes, which is where per-layer tp collectives live.
+Axis order in the mesh is (dp, fsdp, ep, sp, tp): JAX lays consecutive
+devices on the innermost axes, which is where per-layer tp collectives
+live; ep sits just outside sp/tp so its all-to-alls stay on-slice.
 """
 
 from __future__ import annotations
@@ -29,33 +35,39 @@ from jax.sharding import Mesh
 
 AXIS_DP = "dp"
 AXIS_FSDP = "fsdp"
+AXIS_EP = "ep"
 AXIS_SP = "sp"
 AXIS_TP = "tp"
-MESH_AXES = (AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP)
+MESH_AXES = (AXIS_DP, AXIS_FSDP, AXIS_EP, AXIS_SP, AXIS_TP)
 
-# Axes over which the *batch* dimension of data is split.
-DATA_AXES = (AXIS_DP, AXIS_FSDP)
+# Axes over which the *batch* dimension of data is split. ep is a data
+# axis for everything EXCEPT the expert weights (sharding.spec_for puts
+# the MoE expert dim on it); dense params replicate over it, so a
+# dense-model mesh with ep=1 is bit-identical to the pre-ep layout.
+DATA_AXES = (AXIS_DP, AXIS_FSDP, AXIS_EP)
 
 
 @dataclass(frozen=True)
 class MeshPlan:
-    """A validated (dp, fsdp, sp, tp) factorization of a device count."""
+    """A validated (dp, fsdp, ep, sp, tp) factorization of a device count."""
 
     dp: int = 1
     fsdp: int = 1
     sp: int = 1
     tp: int = 1
+    ep: int = 1
 
     @property
     def n_devices(self) -> int:
-        return self.dp * self.fsdp * self.sp * self.tp
+        return self.dp * self.fsdp * self.ep * self.sp * self.tp
 
     def describe(self) -> str:
-        return f"dp={self.dp} fsdp={self.fsdp} sp={self.sp} tp={self.tp}"
+        return (f"dp={self.dp} fsdp={self.fsdp} ep={self.ep} "
+                f"sp={self.sp} tp={self.tp}")
 
 
 def make_mesh(plan: MeshPlan | None = None, *, dp: int = 1, fsdp: int = 1,
-              sp: int = 1, tp: int = 1, devices=None) -> Mesh:
+              sp: int = 1, tp: int = 1, ep: int = 1, devices=None) -> Mesh:
     """Build a named mesh from an explicit factorization.
 
     `devices` defaults to `jax.devices()`; the factorization must cover
@@ -64,14 +76,15 @@ def make_mesh(plan: MeshPlan | None = None, *, dp: int = 1, fsdp: int = 1,
     call shapes single-host slices and multi-host pods — DCN-crossing axes
     should be outermost (dp first), which is the order used here.
     """
-    plan = plan or MeshPlan(dp=dp, fsdp=fsdp, sp=sp, tp=tp)
+    plan = plan or MeshPlan(dp=dp, fsdp=fsdp, sp=sp, tp=tp, ep=ep)
     devices = list(devices if devices is not None else jax.devices())
     if plan.n_devices != len(devices):
         raise ValueError(
             f"mesh plan {plan.describe()} covers {plan.n_devices} devices, "
             f"got {len(devices)}")
     import numpy as np
-    arr = np.array(devices).reshape(plan.dp, plan.fsdp, plan.sp, plan.tp)
+    arr = np.array(devices).reshape(plan.dp, plan.fsdp, plan.ep,
+                                    plan.sp, plan.tp)
     return Mesh(arr, MESH_AXES)
 
 

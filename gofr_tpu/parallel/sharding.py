@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP, DATA_AXES
+from .mesh import AXIS_DP, AXIS_EP, AXIS_FSDP, AXIS_SP, AXIS_TP, DATA_AXES
 
 # leaf name -> spec for the *full* (possibly [L, ...]-stacked) weight
 _COLUMN = {"wq", "wk", "wv", "w_gate", "w_up", "w_in"}
@@ -39,12 +39,13 @@ _ROW_BIAS = {"bo", "b_out"}
 def spec_for(name: str, ndim: int) -> P:
     """PartitionSpec for a parameter leaf, keyed on its dict name."""
     if name in _COLUMN:
-        if ndim == 4:  # MoE experts: [L, E, D, F] — hidden over tp, the
-            return P(None, None, AXIS_FSDP, AXIS_TP)  # same axes as dense
+        if ndim == 4:  # MoE experts [L, E, D, F]: experts over ep,
+            # hidden over the dense axes (fsdp/tp) within each expert
+            return P(None, AXIS_EP, AXIS_FSDP, AXIS_TP)
         return P(None, AXIS_FSDP, AXIS_TP) if ndim == 3 else P(AXIS_FSDP, AXIS_TP)
     if name in _ROW:
         if ndim == 4:  # MoE experts: [L, E, F, D]
-            return P(None, None, AXIS_TP, AXIS_FSDP)
+            return P(None, AXIS_EP, AXIS_TP, AXIS_FSDP)
         return P(None, AXIS_TP, AXIS_FSDP) if ndim == 3 else P(AXIS_TP, AXIS_FSDP)
     if name in _COLUMN_BIAS:
         return P(None, AXIS_TP) if ndim == 2 else P(AXIS_TP)
@@ -99,9 +100,12 @@ def param_specs(params: Any) -> Any:
         name = _leaf_name(path)
         spec = spec_for(name, leaf.ndim if hasattr(leaf, "ndim") else 0)
         if _is_quant_scale(path):
-            # per-output-channel scale: keep only the output-axis sharding
+            # per-output-channel scale [..., out]: keep only the output
+            # axis's sharding, on the LAST dim (a rank-1 P(tail) on an
+            # [L, E, F] expert scale would land tp on L instead of F)
             tail = spec[-1] if len(spec) else None
-            spec = P(None, tail) if leaf.ndim == 2 else P(tail)
+            nd = leaf.ndim if hasattr(leaf, "ndim") else 1
+            spec = P(*([None] * max(0, nd - 1)), tail)
         return spec
 
     return jax.tree_util.tree_map_with_path(one, params)

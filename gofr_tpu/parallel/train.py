@@ -25,7 +25,7 @@ import optax
 
 from ..models import llama
 from ..models.common import ModelConfig
-from .mesh import AXIS_DP, AXIS_FSDP, AXIS_SP, Mesh
+from .mesh import AXIS_SP, DATA_AXES, Mesh
 from .sharding import (activation_constraint, batch_spec, fit_spec,
                        param_specs, shardings_for)
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -167,7 +167,7 @@ def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
         from ..ops.ring_attention import make_ring_attention
 
         attend_override = make_ring_attention(
-            mesh, axis_name=AXIS_SP, batch_axes=(AXIS_DP, AXIS_FSDP))
+            mesh, axis_name=AXIS_SP, batch_axes=DATA_AXES)
 
     fwd = (jax.checkpoint(llama.forward, static_argnums=(1, 5, 6, 7))
            if remat else llama.forward)

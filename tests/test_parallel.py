@@ -21,7 +21,7 @@ CFG = LLAMA_CONFIGS["tiny"]
 
 def test_mesh_plan_and_axes():
     mesh = parallel.make_mesh(dp=2, fsdp=2, sp=1, tp=2)
-    assert mesh.shape == {"dp": 2, "fsdp": 2, "sp": 1, "tp": 2}
+    assert mesh.shape == {"dp": 2, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
     with pytest.raises(ValueError):
         parallel.make_mesh(dp=3, tp=2)  # 6 != 8 devices
 
@@ -30,7 +30,7 @@ def test_auto_plan_fits_model():
     # 64 GB of weights on 16 GB chips -> tp must be > 4; 8 devices -> tp=8
     plan = parallel.auto_plan(8, model_bytes=64 << 30)
     assert plan.tp * plan.dp == 8 and plan.tp >= 7
-    assert parallel.auto_plan(8).describe() == "dp=8 fsdp=1 sp=1 tp=1"
+    assert parallel.auto_plan(8).describe() == "dp=8 fsdp=1 ep=1 sp=1 tp=1"
 
 
 def test_fit_spec_drops_non_dividing_axes():
@@ -111,7 +111,7 @@ def test_kv_cache_specs():
     cache = llama.init_cache(CFG, batch=4, max_seq=32)
     sh = parallel.kv_cache_specs(mesh, cache)
     # KV=2 not divisible by tp=4 -> kv-head axis replicated; batch kept
-    assert sh.k.spec[1] == ("dp", "fsdp")
+    assert sh.k.spec[1] == tuple(parallel.DATA_AXES)
 
 
 def test_train_state_checkpoint_resume(tmp_path):

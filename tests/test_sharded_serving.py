@@ -60,7 +60,7 @@ def test_sharded_cache_layout(tiny_params):
     try:
         spec = eng.cache.k.sharding.spec
         # [L, B, Smax, KV, hd]: batch over data axes, kv heads over tp
-        assert spec[1] == ("dp", "fsdp")
+        assert spec[1] == ("dp", "fsdp", "ep")
         assert spec[3] == "tp"
         # layout must survive a generation (donation keeps shardings pinned)
         eng.generate([1, 2, 3], max_new_tokens=4).tokens()
@@ -76,7 +76,7 @@ def test_sharded_engine_from_config_end_to_end():
     eng = new_engine_from_config(cfg)
     try:
         h = eng.health_check()
-        assert h.details["mesh"] == {"dp": 2, "fsdp": 2, "sp": 1, "tp": 2}
+        assert h.details["mesh"] == {"dp": 2, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
         toks = eng.generate([3, 1, 4], max_new_tokens=5).tokens()
         assert len(toks) == 5
         logits = eng.predict("score", np.asarray([3, 1, 4], np.int32))
