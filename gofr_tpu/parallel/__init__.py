@@ -9,9 +9,10 @@ and jitted steps whose collectives XLA derives from the specs.
 
 from .distributed import (is_coordinator, is_initialized, maybe_initialize,
                           process_count, process_index)
-from .mesh import (AXIS_DP, AXIS_EP, AXIS_FSDP, AXIS_SP, AXIS_TP, DATA_AXES,
-                   MESH_AXES, MeshPlan, auto_plan, make_mesh,
+from .mesh import (AXIS_DP, AXIS_EP, AXIS_FSDP, AXIS_PP, AXIS_SP, AXIS_TP,
+                   DATA_AXES, MESH_AXES, MeshPlan, auto_plan, make_mesh,
                    single_device_mesh)
+from .pipeline import make_pp_loss_fn
 from .sharding import (activation_constraint, activation_spec, batch_spec,
                        fit_spec, kv_cache_specs, param_specs, replicated,
                        shard_params, shardings_for, spec_for)
@@ -23,9 +24,10 @@ from .train import (TrainState, abstract_train_state, default_optimizer,
 __all__ = [
     "is_coordinator", "is_initialized", "maybe_initialize",
     "process_count", "process_index",
-    "AXIS_DP", "AXIS_EP", "AXIS_FSDP", "AXIS_SP", "AXIS_TP", "DATA_AXES",
-    "MESH_AXES",
+    "AXIS_DP", "AXIS_EP", "AXIS_FSDP", "AXIS_PP", "AXIS_SP", "AXIS_TP",
+    "DATA_AXES", "MESH_AXES",
     "MeshPlan", "auto_plan", "make_mesh", "single_device_mesh",
+    "make_pp_loss_fn",
     "activation_constraint", "activation_spec", "batch_spec", "fit_spec",
     "kv_cache_specs", "param_specs", "replicated", "shard_params",
     "shardings_for", "spec_for",

@@ -12,6 +12,11 @@ Axis vocabulary (the scaling-book recipe):
   fsdp  data parallelism with parameter sharding (ZeRO-3 style): batch is
         split over (dp, fsdp) jointly; params/optimizer shard over fsdp and
         are all-gathered per layer by XLA
+  pp    pipeline parallelism — the layer dim of stacked weights splits
+        over pp stages; activations conveyor between stages with
+        ppermute (parallel/pipeline.py). Training-only: serving meshes
+        use tp/dp. Its point-to-point hops are the cheapest collective
+        in the system, so pp sits right after dp (it may cross DCN).
   ep    expert parallelism — MoE expert dim split over ep; the batch also
         splits over ep (dense layers see it as one more data axis, their
         params replicate over it), so GSPMD's partition of the grouped
@@ -20,9 +25,10 @@ Axis vocabulary (the scaling-book recipe):
   sp    sequence/context parallelism — activation sequence axis
   tp    tensor parallelism — attention heads / FFN hidden, the innermost
         axis so its collectives ride the fastest ICI links
-Axis order in the mesh is (dp, fsdp, ep, sp, tp): JAX lays consecutive
-devices on the innermost axes, which is where per-layer tp collectives
-live; ep sits just outside sp/tp so its all-to-alls stay on-slice.
+Axis order in the mesh is (dp, pp, fsdp, ep, sp, tp): JAX lays
+consecutive devices on the innermost axes, which is where per-layer tp
+collectives live; ep sits just outside sp/tp so its all-to-alls stay
+on-slice.
 """
 
 from __future__ import annotations
@@ -34,11 +40,12 @@ import jax
 from jax.sharding import Mesh
 
 AXIS_DP = "dp"
+AXIS_PP = "pp"
 AXIS_FSDP = "fsdp"
 AXIS_EP = "ep"
 AXIS_SP = "sp"
 AXIS_TP = "tp"
-MESH_AXES = (AXIS_DP, AXIS_FSDP, AXIS_EP, AXIS_SP, AXIS_TP)
+MESH_AXES = (AXIS_DP, AXIS_PP, AXIS_FSDP, AXIS_EP, AXIS_SP, AXIS_TP)
 
 # Axes over which the *batch* dimension of data is split. ep is a data
 # axis for everything EXCEPT the expert weights (sharding.spec_for puts
@@ -49,25 +56,28 @@ DATA_AXES = (AXIS_DP, AXIS_FSDP, AXIS_EP)
 
 @dataclass(frozen=True)
 class MeshPlan:
-    """A validated (dp, fsdp, ep, sp, tp) factorization of a device count."""
+    """A validated (dp, pp, fsdp, ep, sp, tp) factorization of a device
+    count."""
 
     dp: int = 1
     fsdp: int = 1
     sp: int = 1
     tp: int = 1
     ep: int = 1
+    pp: int = 1
 
     @property
     def n_devices(self) -> int:
-        return self.dp * self.fsdp * self.ep * self.sp * self.tp
+        return self.dp * self.pp * self.fsdp * self.ep * self.sp * self.tp
 
     def describe(self) -> str:
-        return (f"dp={self.dp} fsdp={self.fsdp} ep={self.ep} "
+        return (f"dp={self.dp} pp={self.pp} fsdp={self.fsdp} ep={self.ep} "
                 f"sp={self.sp} tp={self.tp}")
 
 
 def make_mesh(plan: MeshPlan | None = None, *, dp: int = 1, fsdp: int = 1,
-              sp: int = 1, tp: int = 1, ep: int = 1, devices=None) -> Mesh:
+              sp: int = 1, tp: int = 1, ep: int = 1, pp: int = 1,
+              devices=None) -> Mesh:
     """Build a named mesh from an explicit factorization.
 
     `devices` defaults to `jax.devices()`; the factorization must cover
@@ -76,14 +86,14 @@ def make_mesh(plan: MeshPlan | None = None, *, dp: int = 1, fsdp: int = 1,
     call shapes single-host slices and multi-host pods — DCN-crossing axes
     should be outermost (dp first), which is the order used here.
     """
-    plan = plan or MeshPlan(dp=dp, fsdp=fsdp, sp=sp, tp=tp, ep=ep)
+    plan = plan or MeshPlan(dp=dp, fsdp=fsdp, sp=sp, tp=tp, ep=ep, pp=pp)
     devices = list(devices if devices is not None else jax.devices())
     if plan.n_devices != len(devices):
         raise ValueError(
             f"mesh plan {plan.describe()} covers {plan.n_devices} devices, "
             f"got {len(devices)}")
     import numpy as np
-    arr = np.array(devices).reshape(plan.dp, plan.fsdp, plan.ep,
+    arr = np.array(devices).reshape(plan.dp, plan.pp, plan.fsdp, plan.ep,
                                     plan.sp, plan.tp)
     return Mesh(arr, MESH_AXES)
 

@@ -1,0 +1,166 @@
+"""Pipeline parallelism: GPipe-style microbatch conveyor over the pp axis.
+
+The reference has no parallelism at all (SURVEY §2); this is the
+TPU-native pp story, built the way the rest of the parallel layer is —
+named mesh axes and collectives the compiler can see:
+
+  - The [L, ...]-stacked layer weights shard L over ``pp``
+    (sharding.spec_for ``stacked=True``): stage s owns layers
+    [s*L/pp, (s+1)*L/pp) as a LOCAL stack — no gathering, ever.
+  - The step runs inside ``jax.shard_map`` MANUAL over pp only
+    (``axis_names={"pp"}``): dp/fsdp/ep/sp/tp stay "auto", so GSPMD
+    keeps partitioning the batch and the per-layer matmuls exactly as
+    in the non-pp step. pp composes with the other axes instead of
+    replacing them (Megatron-style dp x pp x tp).
+  - Microbatches conveyor through stages with ``lax.ppermute``: at tick
+    t, stage s works on microbatch t-s; activations AND their lengths
+    ride the conveyor (the causal mask travels with its microbatch).
+    The last stage computes logits+loss for each microbatch as it
+    drains; a psum over pp publishes the scalar. Autodiff reverses the
+    ppermutes — backward is the same conveyor in reverse, and grads
+    accumulate over microbatches by construction.
+  - Bubbles: the first/last pp-1 ticks compute garbage on idle stages
+    (injected zeros). Their outputs are never selected into the loss,
+    so correctness is unconditional; the waste is the standard GPipe
+    bubble fraction (pp-1)/(n_micro+pp-1) — raise n_microbatches to
+    amortize.
+
+Scope (v1): dense decoders (MoE grouped/dense FFN both work but the
+router-balance aux loss is not collected across stages yet) and
+jnp attention. pp with sp>1 ring attention is rejected — ring's own
+collective runs over sp inside the stage and has not been validated
+under a manual-pp trace. Serving meshes keep pp=1 (decode wants every
+layer resident; pipelining decode trades latency for nothing at
+batch-1 token cadence).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..models import llama
+from ..models.common import ModelConfig
+from .mesh import AXIS_PP, Mesh
+
+
+def _loss_parts(logits: jnp.ndarray, tokens: jnp.ndarray,
+                lengths: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(sum of masked next-token NLL, number of masked positions) — the
+    additive form of train.next_token_loss, so microbatch losses combine
+    into EXACTLY the full-batch mean."""
+    B, S, _ = logits.shape
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32),
+                               axis=-1)[..., 0]
+    mask = (jnp.arange(1, S)[None, :] < lengths[:, None]).astype(jnp.float32)
+    return jnp.sum(nll * mask), jnp.sum(mask)
+
+
+def _stage_apply(layers_local: Any, x: jnp.ndarray, cfg: ModelConfig,
+                 cos, sin, positions, valid) -> jnp.ndarray:
+    """Run this stage's local layer stack over one microbatch."""
+
+    def attend(q, k, v):
+        return llama.causal_attention(q, k, v, mask=valid)
+
+    def body(x, layer_w):
+        x, _, _ = llama._layer(x, layer_w, cfg, cos, sin, positions,
+                               kv_write=lambda k, v: (k, v), attend=attend,
+                               valid=valid)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, layers_local)
+    return x
+
+
+def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *, n_microbatches: int,
+                    remat: bool = True):
+    """loss_fn(params, tokens [B,S], lengths [B]) -> (loss, aux=0) running
+    the forward as a pp-stage conveyor. Differentiable; use under
+    jax.value_and_grad exactly like the dense loss_fn."""
+    pp = mesh.shape[AXIS_PP]
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+    if mesh.shape.get("sp", 1) > 1:
+        raise ValueError("pp + sp (ring attention) is not supported yet; "
+                         "use pp with dp/fsdp/ep/tp")
+    if cfg.n_experts > 0 and cfg.moe_capacity_factor > 0:
+        # XLA's SPMD partitioner CHECK-crashes (spmd_partitioner_util.cc
+        # replica-group mismatch) partitioning the grouped-dispatch
+        # scatter over an auto ep axis inside a manual-pp shard_map;
+        # dense dispatch partitions fine. Reject rather than segfault.
+        raise ValueError("pp + grouped MoE dispatch (moe_capacity_factor"
+                         " > 0) is not supported; use dense dispatch "
+                         "(moe_capacity_factor=0) under pp")
+    n_micro = int(n_microbatches)
+    perm = [(i, i + 1) for i in range(pp - 1)]  # no wraparound: stage 0
+    # receives ppermute's zero-fill, immediately overwritten by injection
+
+    def pp_body(params, tokens, lengths):
+        stage = jax.lax.axis_index(AXIS_PP)
+        B, S = tokens.shape
+        if B % n_micro:
+            raise ValueError(f"batch {B} not divisible by "
+                             f"n_microbatches={n_micro}")
+        mb = B // n_micro
+        cos, sin = llama.get_rope_tables(cfg, S)
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (mb, S))
+
+        # every stage embeds (embedding is replicated over pp; computing
+        # it everywhere beats a conveyor warm-up special case)
+        x_all = params["embedding"][tokens].astype(cfg.jdtype)
+        xs = x_all.reshape(n_micro, mb, S, -1)
+        toks_mb = tokens.reshape(n_micro, mb, S)
+        lens_mb = lengths.reshape(n_micro, mb)
+
+        def tick_compute(layers_local, x_in, lens_in):
+            valid = positions < lens_in[:, None]
+            return _stage_apply(layers_local, x_in, cfg, cos, sin,
+                                positions, valid)
+
+        if remat:
+            tick_compute = jax.checkpoint(tick_compute)
+
+        state_x = jnp.zeros_like(xs[0])
+        state_len = jnp.zeros((mb,), lengths.dtype)
+        nll_sum = jnp.zeros((), jnp.float32)
+        mask_sum = jnp.zeros((), jnp.float32)
+        last = pp - 1
+        for t in range(n_micro + pp - 1):
+            j_in = min(t, n_micro - 1)     # microbatch entering stage 0
+            x_in = jnp.where(stage == 0, xs[j_in], state_x)
+            lens_in = jnp.where(stage == 0, lens_mb[j_in], state_len)
+            y = tick_compute(params["layers"], x_in, lens_in)
+            j_out = t - last               # microbatch draining at the
+            if 0 <= j_out < n_micro:       # last stage this tick (static)
+                logits = llama._logits(params, cfg, y)  # final_norm inside
+                n, m = _loss_parts(logits, toks_mb[j_out], lens_in)
+                on_last = (stage == last).astype(jnp.float32)
+                nll_sum = nll_sum + n * on_last
+                mask_sum = mask_sum + m * on_last
+            state_x = jax.lax.ppermute(y, AXIS_PP, perm)
+            state_len = jax.lax.ppermute(lens_in, AXIS_PP, perm)
+        # only the last stage accumulated: psum publishes to all stages
+        nll_sum = jax.lax.psum(nll_sum, AXIS_PP)
+        mask_sum = jax.lax.psum(mask_sum, AXIS_PP)
+        return nll_sum / jnp.maximum(mask_sum, 1.0)
+
+    def loss_fn(params, tokens, lengths):
+        # manual over pp only: layer stacks enter stage-local ([L/pp]);
+        # everything else replicates over pp. All other mesh axes stay
+        # auto — GSPMD partitions inside the stages as usual. in_specs
+        # is a prefix pytree: one spec per top-level param entry.
+        param_specs = {k: (P(AXIS_PP) if k == "layers" else P())
+                       for k in params}
+        fn = jax.shard_map(pp_body, mesh=mesh,
+                           in_specs=(param_specs, P(), P()),
+                           out_specs=P(), axis_names={AXIS_PP},
+                           check_vma=False)
+        return fn(params, tokens, lengths), jnp.zeros(())
+
+    return loss_fn

@@ -27,7 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import AXIS_DP, AXIS_EP, AXIS_FSDP, AXIS_SP, AXIS_TP, DATA_AXES
+from .mesh import (AXIS_DP, AXIS_EP, AXIS_FSDP, AXIS_PP, AXIS_SP, AXIS_TP,
+                   DATA_AXES)
 
 # leaf name -> spec for the *full* (possibly [L, ...]-stacked) weight
 _COLUMN = {"wq", "wk", "wv", "w_gate", "w_up", "w_in"}
@@ -36,27 +37,35 @@ _COLUMN_BIAS = {"bq", "bk", "bv", "b_in"}
 _ROW_BIAS = {"bo", "b_out"}
 
 
-def spec_for(name: str, ndim: int) -> P:
-    """PartitionSpec for a parameter leaf, keyed on its dict name."""
+def spec_for(name: str, ndim: int, stacked: bool = False) -> P:
+    """PartitionSpec for a parameter leaf, keyed on its dict name.
+
+    ``stacked``: the leaf lives under a per-layer stack (params["layers"])
+    with a leading [L] dim — that dim shards over pp (pipeline stages own
+    contiguous layer ranges; parallel/pipeline.py conveys activations
+    between them). On pp=1 meshes the axis fits to nothing."""
+    lead = AXIS_PP if stacked else None
     if name in _COLUMN:
         if ndim == 4:  # MoE experts [L, E, D, F]: experts over ep,
             # hidden over the dense axes (fsdp/tp) within each expert
-            return P(None, AXIS_EP, AXIS_FSDP, AXIS_TP)
-        return P(None, AXIS_FSDP, AXIS_TP) if ndim == 3 else P(AXIS_FSDP, AXIS_TP)
+            return P(lead, AXIS_EP, AXIS_FSDP, AXIS_TP)
+        return P(lead, AXIS_FSDP, AXIS_TP) if ndim == 3 else P(AXIS_FSDP, AXIS_TP)
     if name in _ROW:
         if ndim == 4:  # MoE experts: [L, E, F, D]
-            return P(None, AXIS_EP, AXIS_TP, AXIS_FSDP)
-        return P(None, AXIS_TP, AXIS_FSDP) if ndim == 3 else P(AXIS_TP, AXIS_FSDP)
+            return P(lead, AXIS_EP, AXIS_TP, AXIS_FSDP)
+        return P(lead, AXIS_TP, AXIS_FSDP) if ndim == 3 else P(AXIS_TP, AXIS_FSDP)
     if name in _COLUMN_BIAS:
-        return P(None, AXIS_TP) if ndim == 2 else P(AXIS_TP)
+        return P(lead, AXIS_TP) if ndim == 2 else P(AXIS_TP)
     if name in _ROW_BIAS:
-        return P(None, AXIS_FSDP) if ndim == 2 else P(AXIS_FSDP)
+        return P(lead, AXIS_FSDP) if ndim == 2 else P(AXIS_FSDP)
     if name == "embedding":
         return P(AXIS_TP, AXIS_FSDP)
     if name == "lm_head":
         return P(AXIS_FSDP, AXIS_TP)
     if name in ("pos_embedding", "patch_proj", "pooler_w", "head"):
         return P(None, AXIS_FSDP) if ndim == 2 else P(AXIS_FSDP)
+    if stacked and ndim >= 1:  # per-layer norms/router: layer dim over pp
+        return P(lead)
     return P()  # norms, small embeddings, cls_token: replicated
 
 
@@ -98,14 +107,20 @@ def param_specs(params: Any) -> Any:
 
     def one(path, leaf):
         name = _leaf_name(path)
-        spec = spec_for(name, leaf.ndim if hasattr(leaf, "ndim") else 0)
+        stacked = any(isinstance(e, jax.tree_util.DictKey)
+                      and str(e.key) == "layers" for e in path)
+        spec = spec_for(name, leaf.ndim if hasattr(leaf, "ndim") else 0,
+                        stacked=stacked)
         if _is_quant_scale(path):
             # per-output-channel scale [..., out]: keep only the output
             # axis's sharding, on the LAST dim (a rank-1 P(tail) on an
-            # [L, E, F] expert scale would land tp on L instead of F)
+            # [L, E, F] expert scale would land tp on L instead of F),
+            # plus the layer dim over pp for stacked leaves
             tail = spec[-1] if len(spec) else None
             nd = leaf.ndim if hasattr(leaf, "ndim") else 1
-            spec = P(*([None] * max(0, nd - 1)), tail)
+            lead = AXIS_PP if stacked and nd >= 2 else None
+            spec = P(lead, *([None] * max(0, nd - 2)), tail) if nd >= 2 \
+                else P(tail)
         return spec
 
     return jax.tree_util.tree_map_with_path(one, params)

@@ -25,7 +25,7 @@ import optax
 
 from ..models import llama
 from ..models.common import ModelConfig
-from .mesh import AXIS_SP, DATA_AXES, Mesh
+from .mesh import AXIS_PP, AXIS_SP, DATA_AXES, Mesh
 from .sharding import (activation_constraint, batch_spec, fit_spec,
                        param_specs, shardings_for)
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -142,7 +142,8 @@ def state_shardings(state_like: Any, mesh: Mesh) -> Any:
 def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
                     mesh: Mesh, *, remat: bool = True,
                     seq_parallel: str = "auto",
-                    moe_aux_weight: float = 0.01) -> Callable:
+                    moe_aux_weight: float = 0.01,
+                    n_microbatches: int | None = None) -> Callable:
     """Build the jitted sharded train step:
     step(state, tokens [B,S], lengths [B]) -> (state, metrics dict).
 
@@ -155,33 +156,50 @@ def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
 
     MoE configs (cfg.n_experts > 0) add ``moe_aux_weight`` times the
     load-balancing loss (reported as metrics["aux_loss"]) so the router
-    cannot collapse onto a few experts."""
+    cannot collapse onto a few experts.
+
+    Meshes with pp > 1 run the forward as a GPipe microbatch conveyor
+    (parallel/pipeline.py) over ``n_microbatches`` (default 2*pp; the
+    batch must divide by it). pp composes with dp/fsdp/ep/tp; pp+sp and
+    pp+MoE-aux-loss are rejected for now."""
     constrain = activation_constraint(mesh)
     moe = cfg.n_experts > 0
+    pp = mesh.shape.get(AXIS_PP, 1)
 
-    use_ring = (seq_parallel == "ring"
-                or (seq_parallel == "auto"
-                    and mesh.shape.get(AXIS_SP, 1) > 1))
-    attend_override = None
-    if use_ring:
-        from ..ops.ring_attention import make_ring_attention
+    if pp > 1:
+        if moe and moe_aux_weight > 0:
+            raise ValueError(
+                "pp + MoE load-balance aux loss is not collected across "
+                "stages yet; pass moe_aux_weight=0.0 to train MoE under pp")
+        from .pipeline import make_pp_loss_fn
 
-        attend_override = make_ring_attention(
-            mesh, axis_name=AXIS_SP, batch_axes=DATA_AXES)
+        loss_fn = make_pp_loss_fn(cfg, mesh,
+                                  n_microbatches=n_microbatches or 2 * pp,
+                                  remat=remat)
+    else:
+        use_ring = (seq_parallel == "ring"
+                    or (seq_parallel == "auto"
+                        and mesh.shape.get(AXIS_SP, 1) > 1))
+        attend_override = None
+        if use_ring:
+            from ..ops.ring_attention import make_ring_attention
 
-    fwd = (jax.checkpoint(llama.forward, static_argnums=(1, 5, 6, 7))
-           if remat else llama.forward)
+            attend_override = make_ring_attention(
+                mesh, axis_name=AXIS_SP, batch_axes=DATA_AXES)
 
-    def loss_fn(params, tokens, lengths):
-        if moe:
-            logits, probs = fwd(params, cfg, tokens, lengths, None,
-                                constrain, attend_override, True)
-            aux = load_balance_loss(probs, lengths)
-            lm = next_token_loss(logits, tokens, lengths)
-            return lm + moe_aux_weight * aux, aux
-        logits = fwd(params, cfg, tokens, lengths, None, constrain,
-                     attend_override, False)
-        return next_token_loss(logits, tokens, lengths), jnp.zeros(())
+        fwd = (jax.checkpoint(llama.forward, static_argnums=(1, 5, 6, 7))
+               if remat else llama.forward)
+
+        def loss_fn(params, tokens, lengths):
+            if moe:
+                logits, probs = fwd(params, cfg, tokens, lengths, None,
+                                    constrain, attend_override, True)
+                aux = load_balance_loss(probs, lengths)
+                lm = next_token_loss(logits, tokens, lengths)
+                return lm + moe_aux_weight * aux, aux
+            logits = fwd(params, cfg, tokens, lengths, None, constrain,
+                         attend_override, False)
+            return next_token_loss(logits, tokens, lengths), jnp.zeros(())
 
     def step(state: TrainState, tokens, lengths):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(

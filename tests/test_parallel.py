@@ -21,7 +21,7 @@ CFG = LLAMA_CONFIGS["tiny"]
 
 def test_mesh_plan_and_axes():
     mesh = parallel.make_mesh(dp=2, fsdp=2, sp=1, tp=2)
-    assert mesh.shape == {"dp": 2, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
+    assert mesh.shape == {"dp": 2, "pp": 1, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
     with pytest.raises(ValueError):
         parallel.make_mesh(dp=3, tp=2)  # 6 != 8 devices
 
@@ -30,7 +30,7 @@ def test_auto_plan_fits_model():
     # 64 GB of weights on 16 GB chips -> tp must be > 4; 8 devices -> tp=8
     plan = parallel.auto_plan(8, model_bytes=64 << 30)
     assert plan.tp * plan.dp == 8 and plan.tp >= 7
-    assert parallel.auto_plan(8).describe() == "dp=8 fsdp=1 ep=1 sp=1 tp=1"
+    assert parallel.auto_plan(8).describe() == "dp=8 pp=1 fsdp=1 ep=1 sp=1 tp=1"
 
 
 def test_fit_spec_drops_non_dividing_axes():
@@ -43,10 +43,10 @@ def test_fit_spec_drops_non_dividing_axes():
 def test_param_specs_llama_rules():
     params = llama.init(CFG, jax.random.PRNGKey(0))
     specs = parallel.param_specs(params)
-    assert specs["layers"]["wq"] == P(None, "fsdp", "tp")
-    assert specs["layers"]["wo"] == P(None, "tp", "fsdp")
+    assert specs["layers"]["wq"] == P("pp", "fsdp", "tp")
+    assert specs["layers"]["wo"] == P("pp", "tp", "fsdp")
     assert specs["embedding"] == P("tp", "fsdp")
-    assert specs["layers"]["attn_norm"] == P()
+    assert specs["layers"]["attn_norm"] == P("pp")
 
 
 def test_shard_params_places_on_mesh():
@@ -54,7 +54,7 @@ def test_shard_params_places_on_mesh():
     params = llama.init(CFG, jax.random.PRNGKey(0))
     sharded = parallel.shard_params(params, mesh)
     wq = sharded["layers"]["wq"]  # [L, 64, 64]: tp=4 divides 64
-    assert wq.sharding.spec == P(None, "fsdp", "tp")
+    assert wq.sharding.spec == P("pp", "fsdp", "tp")
     # every leaf lands on the mesh without error and keeps its value
     np.testing.assert_allclose(np.asarray(wq), np.asarray(params["layers"]["wq"]))
 
@@ -92,7 +92,7 @@ def test_train_step_runs_and_loss_decreases():
     assert int(state.step) == 5
     assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
     # params sharded per the rules, not replicated
-    assert state.params["layers"]["wq"].sharding.spec == P(None, "fsdp", "tp")
+    assert state.params["layers"]["wq"].sharding.spec == P("pp", "fsdp", "tp")
 
 
 def test_state_shardings_cover_opt_state():

@@ -76,7 +76,7 @@ def test_sharded_engine_from_config_end_to_end():
     eng = new_engine_from_config(cfg)
     try:
         h = eng.health_check()
-        assert h.details["mesh"] == {"dp": 2, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
+        assert h.details["mesh"] == {"dp": 2, "pp": 1, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
         toks = eng.generate([3, 1, 4], max_new_tokens=5).tokens()
         assert len(toks) == 5
         logits = eng.predict("score", np.asarray([3, 1, 4], np.int32))
