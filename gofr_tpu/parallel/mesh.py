@@ -57,14 +57,15 @@ DATA_AXES = (AXIS_DP, AXIS_FSDP, AXIS_EP)
 @dataclass(frozen=True)
 class MeshPlan:
     """A validated (dp, pp, fsdp, ep, sp, tp) factorization of a device
-    count."""
+    count. Field order matches the mesh's axis order — positional
+    construction reads the same as ``describe()``."""
 
     dp: int = 1
+    pp: int = 1
     fsdp: int = 1
+    ep: int = 1
     sp: int = 1
     tp: int = 1
-    ep: int = 1
-    pp: int = 1
 
     @property
     def n_devices(self) -> int:

@@ -45,20 +45,7 @@ from jax.sharding import PartitionSpec as P
 from ..models import llama
 from ..models.common import ModelConfig
 from .mesh import AXIS_PP, Mesh
-
-
-def _loss_parts(logits: jnp.ndarray, tokens: jnp.ndarray,
-                lengths: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """(sum of masked next-token NLL, number of masked positions) — the
-    additive form of train.next_token_loss, so microbatch losses combine
-    into EXACTLY the full-batch mean."""
-    B, S, _ = logits.shape
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32),
-                               axis=-1)[..., 0]
-    mask = (jnp.arange(1, S)[None, :] < lengths[:, None]).astype(jnp.float32)
-    return jnp.sum(nll * mask), jnp.sum(mask)
+from .train import loss_parts
 
 
 def _stage_apply(layers_local: Any, x: jnp.ndarray, cfg: ModelConfig,
@@ -139,7 +126,7 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *, n_microbatches: int,
             j_out = t - last               # microbatch draining at the
             if 0 <= j_out < n_micro:       # last stage this tick (static)
                 logits = llama._logits(params, cfg, y)  # final_norm inside
-                n, m = _loss_parts(logits, toks_mb[j_out], lens_in)
+                n, m = loss_parts(logits, toks_mb[j_out], lens_in)
                 on_last = (stage == last).astype(jnp.float32)
                 nll_sum = nll_sum + n * on_last
                 mask_sum = mask_sum + m * on_last

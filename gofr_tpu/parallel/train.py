@@ -49,17 +49,28 @@ def default_optimizer(lr: float = 3e-4, *, warmup: int = 100,
     )
 
 
-def next_token_loss(logits: jnp.ndarray, tokens: jnp.ndarray,
-                    lengths: jnp.ndarray) -> jnp.ndarray:
-    """Mean causal-LM cross-entropy: logits [B,S,V] f32 predict tokens
-    shifted left; positions ≥ length are masked out."""
+def loss_parts(logits: jnp.ndarray, tokens: jnp.ndarray,
+               lengths: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(sum of masked next-token NLL, number of masked positions) — the
+    additive form of the causal-LM loss. The ONE definition of the
+    shift/mask/log-softmax math: next_token_loss is its ratio, and the
+    pipeline conveyor sums these parts over microbatches so pp losses
+    combine into exactly the full-batch mean."""
     B, S, _ = logits.shape
     targets = tokens[:, 1:]                       # [B, S-1]
     logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32),
                                axis=-1)[..., 0]   # [B, S-1]
     mask = (jnp.arange(1, S)[None, :] < lengths[:, None]).astype(jnp.float32)
-    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(nll * mask), jnp.sum(mask)
+
+
+def next_token_loss(logits: jnp.ndarray, tokens: jnp.ndarray,
+                    lengths: jnp.ndarray) -> jnp.ndarray:
+    """Mean causal-LM cross-entropy: logits [B,S,V] f32 predict tokens
+    shifted left; positions ≥ length are masked out."""
+    nll_sum, mask_sum = loss_parts(logits, tokens, lengths)
+    return nll_sum / jnp.maximum(mask_sum, 1.0)
 
 
 def _build_state(cfg: ModelConfig,
@@ -177,6 +188,12 @@ def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
                                   n_microbatches=n_microbatches or 2 * pp,
                                   remat=remat)
     else:
+        if n_microbatches is not None:
+            # silently running a full-batch step instead of the requested
+            # microbatching would change memory semantics unannounced
+            raise ValueError("n_microbatches only applies to pp>1 meshes "
+                             "(gradient accumulation without pp is not "
+                             "implemented)")
         use_ring = (seq_parallel == "ring"
                     or (seq_parallel == "auto"
                         and mesh.shape.get(AXIS_SP, 1) > 1))
