@@ -25,13 +25,13 @@ named mesh axes and collectives the compiler can see:
     bubble fraction (pp-1)/(n_micro+pp-1) — raise n_microbatches to
     amortize.
 
-Scope (v1): dense decoders (MoE grouped/dense FFN both work but the
-router-balance aux loss is not collected across stages yet) and
-jnp attention. pp with sp>1 ring attention is rejected — ring's own
-collective runs over sp inside the stage and has not been validated
-under a manual-pp trace. Serving meshes keep pp=1 (decode wants every
-layer resident; pipelining decode trades latency for nothing at
-batch-1 token cadence).
+Scope: dense decoders and dense-dispatch MoE (aux loss collected
+exactly across stages — see make_pp_loss_fn). pp with sp>1 ring
+attention is rejected — ring's own collective runs over sp inside the
+stage and has not been validated under a manual-pp trace; pp with
+grouped MoE dispatch is rejected (XLA partitioner limitation). Serving
+meshes keep pp=1 (decode wants every layer resident; pipelining decode
+trades latency for nothing at batch-1 token cadence).
 """
 
 from __future__ import annotations
@@ -56,20 +56,27 @@ def _stage_apply(layers_local: Any, x: jnp.ndarray, cfg: ModelConfig,
         return llama.causal_attention(q, k, v, mask=valid)
 
     def body(x, layer_w):
-        x, _, _ = llama._layer(x, layer_w, cfg, cos, sin, positions,
-                               kv_write=lambda k, v: (k, v), attend=attend,
-                               valid=valid)
-        return x, None
+        x, _, probs = llama._layer(x, layer_w, cfg, cos, sin, positions,
+                                   kv_write=lambda k, v: (k, v),
+                                   attend=attend, valid=valid)
+        return x, probs  # [mb, S, E] per layer for MoE, else None
 
-    x, _ = jax.lax.scan(body, x, layers_local)
-    return x
+    x, probs = jax.lax.scan(body, x, layers_local)
+    return x, probs
 
 
 def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *, n_microbatches: int,
-                    remat: bool = True):
-    """loss_fn(params, tokens [B,S], lengths [B]) -> (loss, aux=0) running
+                    remat: bool = True, moe_aux_weight: float = 0.01):
+    """loss_fn(params, tokens [B,S], lengths [B]) -> (loss, aux) running
     the forward as a pp-stage conveyor. Differentiable; use under
-    jax.value_and_grad exactly like the dense loss_fn."""
+    jax.value_and_grad exactly like the dense loss_fn.
+
+    MoE aux collection under pp: each stage accumulates per-local-layer
+    [E] vectors of top-1 counts and router-probability sums over the
+    microbatches it actually processed (bubble ticks weighted 0), the
+    balance term sums over local layers, and one psum over pp rebuilds
+    train.load_balance_loss EXACTLY — the nonlinear f·P product is formed
+    per layer AFTER accumulation, never across partial batches."""
     pp = mesh.shape[AXIS_PP]
     if cfg.n_layers % pp:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
@@ -113,16 +120,35 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *, n_microbatches: int,
         if remat:
             tick_compute = jax.checkpoint(tick_compute)
 
+        moe = cfg.n_experts > 0
         state_x = jnp.zeros_like(xs[0])
         state_len = jnp.zeros((mb,), lengths.dtype)
         nll_sum = jnp.zeros((), jnp.float32)
         mask_sum = jnp.zeros((), jnp.float32)
+        if moe:
+            l_local = cfg.n_layers // pp
+            cnt_sum = jnp.zeros((l_local, cfg.n_experts), jnp.float32)
+            prob_sum = jnp.zeros((l_local, cfg.n_experts), jnp.float32)
         last = pp - 1
         for t in range(n_micro + pp - 1):
             j_in = min(t, n_micro - 1)     # microbatch entering stage 0
             x_in = jnp.where(stage == 0, xs[j_in], state_x)
             lens_in = jnp.where(stage == 0, lens_mb[j_in], state_len)
-            y = tick_compute(params["layers"], x_in, lens_in)
+            y, probs = tick_compute(params["layers"], x_in, lens_in)
+            if moe:
+                # this tick is real work iff a microbatch is at this stage
+                # (bubble outputs are finite — masked attention uses a
+                # finite NEG_INF — so a 0-weight cleanly removes them)
+                in_range = ((t - stage >= 0) & (t - stage < n_micro)
+                            ).astype(jnp.float32)
+                vmask = (positions < lens_in[:, None]
+                         ).astype(jnp.float32)[None, ..., None]
+                top1 = jax.nn.one_hot(jnp.argmax(probs, axis=-1),
+                                      cfg.n_experts)  # [l, mb, S, E]
+                cnt_sum = cnt_sum + in_range * jnp.sum(
+                    top1 * vmask, axis=(1, 2))
+                prob_sum = prob_sum + in_range * jnp.sum(
+                    probs * vmask, axis=(1, 2))
             j_out = t - last               # microbatch draining at the
             if 0 <= j_out < n_micro:       # last stage this tick (static)
                 logits = llama._logits(params, cfg, y)  # final_norm inside
@@ -135,7 +161,16 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *, n_microbatches: int,
         # only the last stage accumulated: psum publishes to all stages
         nll_sum = jax.lax.psum(nll_sum, AXIS_PP)
         mask_sum = jax.lax.psum(mask_sum, AXIS_PP)
-        return nll_sum / jnp.maximum(mask_sum, 1.0)
+        lm = nll_sum / jnp.maximum(mask_sum, 1.0)
+        if not moe:
+            return lm, jnp.zeros(())
+        # per-layer f·P AFTER full accumulation (train.load_balance_loss
+        # shape: E * mean_layers(sum_e f_e P_e) over valid tokens)
+        total = jnp.maximum(
+            jnp.sum(jnp.minimum(lengths, S).astype(jnp.float32)), 1.0)
+        local = jnp.sum((cnt_sum / total) * (prob_sum / total))
+        aux = cfg.n_experts * jax.lax.psum(local, AXIS_PP) / cfg.n_layers
+        return lm + moe_aux_weight * aux, aux
 
     def loss_fn(params, tokens, lengths):
         # manual over pp only: layer stacks enter stage-local ([L/pp]);
@@ -146,8 +181,8 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *, n_microbatches: int,
                        for k in params}
         fn = jax.shard_map(pp_body, mesh=mesh,
                            in_specs=(param_specs, P(), P()),
-                           out_specs=P(), axis_names={AXIS_PP},
+                           out_specs=(P(), P()), axis_names={AXIS_PP},
                            check_vma=False)
-        return fn(params, tokens, lengths), jnp.zeros(())
+        return fn(params, tokens, lengths)
 
     return loss_fn

@@ -171,22 +171,19 @@ def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
 
     Meshes with pp > 1 run the forward as a GPipe microbatch conveyor
     (parallel/pipeline.py) over ``n_microbatches`` (default 2*pp; the
-    batch must divide by it). pp composes with dp/fsdp/ep/tp; pp+sp and
-    pp+MoE-aux-loss are rejected for now."""
+    batch must divide by it), MoE aux loss included. pp composes with
+    dp/fsdp/ep/tp; pp+sp and pp+grouped-MoE-dispatch are rejected."""
     constrain = activation_constraint(mesh)
     moe = cfg.n_experts > 0
     pp = mesh.shape.get(AXIS_PP, 1)
 
     if pp > 1:
-        if moe and moe_aux_weight > 0:
-            raise ValueError(
-                "pp + MoE load-balance aux loss is not collected across "
-                "stages yet; pass moe_aux_weight=0.0 to train MoE under pp")
         from .pipeline import make_pp_loss_fn
 
         loss_fn = make_pp_loss_fn(cfg, mesh,
                                   n_microbatches=n_microbatches or 2 * pp,
-                                  remat=remat)
+                                  remat=remat,
+                                  moe_aux_weight=moe_aux_weight)
     else:
         if n_microbatches is not None:
             # silently running a full-batch step instead of the requested

@@ -63,20 +63,35 @@ def test_pp_step_learns_and_remat_matches():
     assert losses[-1] < losses[0], losses
 
 
-def test_pp_composes_with_ep_dense_moe():
+def test_pp_composes_with_ep_dense_moe_and_matches_aux():
     """3-axis composition pp x ep x dp on a dense-dispatch MoE: expert
-    dim over ep, layer dim over pp, batch over (dp, ep) — the step runs
-    and learns with both model axes verifiably sharded."""
+    dim over ep, layer dim over pp, batch over (dp, ep). Loss AND the
+    cross-stage-collected load-balance aux must match the dense step
+    (the nonlinear f·P balance term is formed per layer after full
+    accumulation, so microbatching must not change it)."""
     cfg = LLAMA_CONFIGS["tiny-moe"].with_(n_layers=4, max_seq=32)
-    mesh = parallel.make_mesh(pp=2, ep=2, dp=2)
     opt = parallel.default_optimizer(lr=1e-2, warmup=1, total_steps=20)
+    tokens, lengths = _data()
+
+    dense_mesh = parallel.make_mesh(dp=8)
+    state_d = parallel.init_train_state(cfg, jax.random.PRNGKey(0),
+                                        dense_mesh, opt)
+    step_d = parallel.make_train_step(cfg, opt, dense_mesh, remat=False)
+    _, md = step_d(state_d, tokens, lengths)
+
+    mesh = parallel.make_mesh(pp=2, ep=2, dp=2)
     state = parallel.init_train_state(cfg, jax.random.PRNGKey(0), mesh, opt)
     step = parallel.make_train_step(cfg, opt, mesh, remat=False,
-                                    moe_aux_weight=0.0, n_microbatches=2)
-    tokens, lengths = _data()
+                                    n_microbatches=2)
     losses = []
-    for _ in range(4):
+    for i in range(4):
         state, m = step(state, tokens, lengths)
+        if i == 0:
+            np.testing.assert_allclose(float(m["loss"]), float(md["loss"]),
+                                       rtol=1e-5, atol=1e-5)
+            np.testing.assert_allclose(float(m["aux_loss"]),
+                                       float(md["aux_loss"]),
+                                       rtol=1e-5, atol=1e-5)
         losses.append(float(m["loss"]))
     assert all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0], losses
@@ -95,14 +110,15 @@ def test_pp_rejects_bad_configs():
     sp_mesh = parallel.make_mesh(pp=2, sp=2, dp=2)
     with pytest.raises(ValueError, match="sp"):
         parallel.make_pp_loss_fn(CFG, sp_mesh, n_microbatches=2)
-    # pp + MoE aux loss unsupported (explicit opt-out required)
-    moe_cfg = LLAMA_CONFIGS["tiny-moe"].with_(n_layers=4)
-    with pytest.raises(ValueError, match="aux"):
-        parallel.make_train_step(moe_cfg, opt, mesh)
     # pp + grouped MoE dispatch would CHECK-crash XLA's partitioner
+    moe_cfg = LLAMA_CONFIGS["tiny-moe"].with_(n_layers=4)
     with pytest.raises(ValueError, match="grouped"):
         parallel.make_pp_loss_fn(moe_cfg.with_(moe_capacity_factor=2.0),
                                  mesh, n_microbatches=2)
+    # n_microbatches on a pp=1 mesh is not gradient accumulation
+    with pytest.raises(ValueError, match="pp"):
+        parallel.make_train_step(CFG, opt, parallel.make_mesh(dp=8),
+                                 n_microbatches=4)
     # batch not divisible by n_microbatches fails at trace time
     step = parallel.make_train_step(CFG, opt, mesh, remat=False,
                                     n_microbatches=3)
