@@ -259,6 +259,8 @@ def register_framework_metrics(m: Manager) -> None:
     m.new_gauge("app_tpu_batch_fill", "fraction of batch slots occupied at dispatch")
     m.new_counter("app_tpu_requests_total", "total TPU predict requests")
     m.new_counter("app_tpu_tokens_generated_total", "total generated tokens")
+    m.new_counter("app_tpu_prefix_cache_hits_total",
+                  "generation admissions that restored a cached prompt-prefix KV row")
     m.new_gauge("app_tpu_devices", "number of visible TPU devices")
 
 

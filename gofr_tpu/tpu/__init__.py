@@ -30,6 +30,12 @@ Config keys (reference config style, pkg/gofr/config/config.go:3):
                       lets request-submitter threads parked on the GIL
                       during a device block enqueue before the next
                       block's admission check; 0 disables)
+  TPU_PREFIX_CACHE    prefix-KV pool rows (default 0 = off): stored
+                      prompt prefixes restore as one HBM row copy
+                      instead of prefill compute (tpu/prefix_cache.py);
+                      single-device engines only
+  TPU_PREFIX_MIN      min prompt length stored in the pool (default:
+                      the largest prompt bucket)
   TPU_BATCH_BUCKETS   csv of predict batch buckets (default 1,2,4,8)
   TPU_SEQ_BUCKETS     csv of token-length buckets  (default 32..512)
   TPU_MAX_BATCH_DELAY coalescing window in seconds (default 0.004)
@@ -148,7 +154,9 @@ def new_engine_from_config(cfg, logger=None, metrics=None) -> TPUEngine:
             mc, params, slots=slots, max_seq=max_seq, prompt_buckets=prompt_b,
             logger=logger, metrics=metrics, mesh=mesh, kv_dtype=kv_dtype,
             decode_block=cfg.get_int("TPU_DECODE_BLOCK", 4),
-            admit_window_ms=cfg.get_float("TPU_ADMIT_WINDOW_MS", 2.0))
+            admit_window_ms=cfg.get_float("TPU_ADMIT_WINDOW_MS", 2.0),
+            prefix_cache_slots=cfg.get_int("TPU_PREFIX_CACHE", 0),
+            prefix_store_min=cfg.get_int("TPU_PREFIX_MIN", 0) or None)
 
         # scoring program: next-token logits at the prompt end (the
         # non-streaming sibling of generate, e.g. for classification

@@ -45,6 +45,24 @@ from .batcher import pad_bucket
 _REQ_IDS = itertools.count(1)
 
 
+def _copy_row(dst, src, dst_idx, src_idx):
+    """Copy one batch row of KV (+ scale planes): src[:, src_idx] ->
+    dst[:, dst_idx]. Shared by prefix-pool store (dst=pool) and load
+    (dst=serving cache); lengths are untouched — the slot cursor is set
+    by the chunk dispatches, the pool's lengths live host-side."""
+    import jax.lax as lax
+
+    def cp(d, s):
+        r = lax.dynamic_slice_in_dim(s, src_idx, 1, axis=1)
+        return lax.dynamic_update_slice_in_dim(d, r, dst_idx, axis=1)
+
+    quant = dst.k_scale is not None
+    return dst._replace(
+        k=cp(dst.k, src.k), v=cp(dst.v, src.v),
+        k_scale=cp(dst.k_scale, src.k_scale) if quant else None,
+        v_scale=cp(dst.v_scale, src.v_scale) if quant else None)
+
+
 class GenerationError(RuntimeError):
     pass
 
@@ -110,7 +128,9 @@ class GenerationEngine:
                  prompt_buckets: tuple[int, ...] = (32, 64, 128, 256, 512),
                  logger=None, metrics=None, seed: int = 0, mesh=None,
                  kv_dtype=None, decode_block: int = 4,
-                 admit_window_ms: float = 2.0):
+                 admit_window_ms: float = 2.0,
+                 prefix_cache_slots: int = 0,
+                 prefix_store_min: int | None = None):
         self.cfg = cfg
         self.params = params
         self.n_slots = slots
@@ -157,6 +177,28 @@ class GenerationEngine:
         self._temps = np.zeros((slots,), np.float32)
         self._top_ks = np.zeros((slots,), np.int32)
         self._key = jax.random.PRNGKey(seed)
+
+        # Prefix KV cache (tpu/prefix_cache.py): a P-row pool of stored
+        # prompt-prefix KV. A hit replaces MXU prefill work for the
+        # matched positions with one HBM row copy; the remainder (always
+        # >= 1 token, so the first sample recomputes) prefills from the
+        # match point. Single-device engines only for now: the row copies
+        # use traced batch indices, which reshard poorly under GSPMD.
+        self._prefix_idx = None
+        self._pool = None
+        if prefix_cache_slots > 0:
+            if mesh is not None:
+                raise ValueError("prefix_cache_slots requires a "
+                                 "single-device engine (mesh=None)")
+            from .prefix_cache import PrefixIndex
+
+            self._prefix_idx = PrefixIndex(prefix_cache_slots)
+            self._pool = llama.init_cache(cfg, prefix_cache_slots,
+                                          self.max_seq, dtype=kv_dtype)
+            self._store_min = int(prefix_store_min
+                                  or self.prompt_buckets[-1])
+            self._pool_load_jit = jax.jit(_copy_row, donate_argnums=(0,))
+            self._pool_store_jit = jax.jit(_copy_row, donate_argnums=(0,))
 
         self._pending: queue.Queue[_Request] = queue.Queue()
         self._work = threading.Event()
@@ -355,7 +397,7 @@ class GenerationEngine:
     def stats(self) -> dict:
         if self.down is not None:
             return {"down": self.down, "slots": self.n_slots}
-        return {
+        out = {
             "slots": self.n_slots,
             "active": int(self._active.sum()),
             "queued": self._pending.qsize(),
@@ -364,6 +406,9 @@ class GenerationEngine:
             "total_requests": self.total_requests,
             "total_tokens": self.total_tokens,
         }
+        if self._prefix_idx is not None:
+            out["prefix_cache"] = self._prefix_idx.stats()
+        return out
 
     def warmup(self) -> None:
         """Prime every compiled shape (prefill per bucket + the step).
@@ -460,7 +505,8 @@ class GenerationEngine:
         waste in the cache: capacity used == prompt length."""
         L = len(req.prompt)
         C = self.prompt_buckets[-1]
-        if L <= C:
+        pos = self._prefix_restore(idx, req, L, C)
+        if pos == 0 and L <= C:
             Sb = pad_bucket(L, self.prompt_buckets)
             padded = np.zeros((1, Sb), np.int32)
             padded[0, :L] = req.prompt
@@ -469,15 +515,15 @@ class GenerationEngine:
                 jnp.int32(idx), jnp.float32(req.temperature),
                 jnp.int32(req.top_k), self._next_key())
             return int(tok)
-        mid_count = (L - 1) // C
-        for i in range(mid_count):
+        while L - pos > C:
             if req.stream.cancelled.is_set():
                 break
-            chunk = req.prompt[i * C:(i + 1) * C]
+            chunk = req.prompt[pos:pos + C]
             self.cache = self._chunk_mid_jit(
                 self.cache, self.params, jnp.asarray(chunk[None, :]),
-                jnp.int32(i * C), jnp.int32(idx), jnp.int32(0),
+                jnp.int32(pos), jnp.int32(idx), jnp.int32(0),
                 jnp.int32(0), jnp.float32(0.0), jnp.int32(0), self._key)
+            pos += C
             # Long admissions must not stall active decode streams
             # (VERDICT r2 weak #5): run one decode block between chunks
             # so every live slot keeps producing while this prompt loads.
@@ -486,7 +532,7 @@ class GenerationEngine:
             # token is discarded anyway (_deliver retires cancelled slots
             # before use) — skip the final-chunk dispatch entirely
             return 0
-        rem = L - mid_count * C
+        rem = L - pos
         Sb = pad_bucket(rem, self.prompt_buckets)
         final = req.prompt[L - Sb:]
         tok, self.cache = self._chunk_final_jit(
@@ -495,6 +541,52 @@ class GenerationEngine:
             jnp.int32(Sb - 1), jnp.float32(req.temperature),
             jnp.int32(req.top_k), self._next_key())
         return int(tok)
+
+    def _prefix_restore(self, idx: int, req: _Request, L: int,
+                        C: int) -> int:
+        """Consult the prefix pool; on a useful hit copy the stored row
+        into slot ``idx`` and return the position prefill resumes from
+        (0 = no hit). The returned position keeps every later dispatch on
+        the compiled lattice: chunk STARTS are traced values, only chunk
+        LENGTHS are compile keys, so resuming mid-prompt compiles
+        nothing new. At least one prompt position is always recomputed —
+        the final chunk ends at the prompt end and samples there."""
+        if self._prefix_idx is None:
+            return 0
+        prompt = np.asarray(req.prompt, np.int32)
+        row, m = self._prefix_idx.match(prompt)
+        if row < 0:
+            return 0
+        m_eff = min(int(m), L - 1)
+        if m_eff < self.prompt_buckets[0]:
+            return 0  # matched less than the smallest bucket: the copy
+            # would not remove a single dispatch's worth of work
+        # the final chunk needs [L - Sb, L) to be a valid window
+        rem = L - m_eff
+        while rem > C:
+            rem -= C
+        if L - pad_bucket(rem, self.prompt_buckets) < 0:
+            return 0
+        self.cache = self._pool_load_jit(self.cache, self._pool,
+                                         jnp.int32(idx), jnp.int32(row))
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_tpu_prefix_cache_hits_total")
+        return m_eff
+
+    def _prefix_store(self, idx: int, req: _Request) -> None:
+        """After a completed admission, remember this prompt's KV row
+        (LRU pool; skipped for short prompts and already-covered ones).
+        Must run BEFORE the slot's first decode tick — decode writes
+        position L into the same row."""
+        if self._prefix_idx is None or req.stream.cancelled.is_set():
+            return
+        prompt = np.asarray(req.prompt, np.int32)
+        if len(prompt) < self._store_min or self._prefix_idx.covered(prompt):
+            return
+        row = self._prefix_idx.store_row(prompt)
+        self._pool = self._pool_store_jit(self._pool, self.cache,
+                                          jnp.int32(row), jnp.int32(idx))
 
     def _start(self, idx: int, slot: _Slot, req: _Request) -> None:
         t0 = time.monotonic()
@@ -506,6 +598,7 @@ class GenerationEngine:
             req.stream._q.put(GenerationError(f"prefill failed: {e!r}"))
             req.stream._q.put(None)
             raise
+        self._prefix_store(idx, req)
         if self.metrics is not None:
             self.metrics.record_histogram("app_tpu_batch_wait_duration",
                                           t0 - req.enqueued_at, program="generate")

@@ -1,0 +1,158 @@
+"""Prefix KV cache: restored prefixes must be numerically invisible —
+every stream yields the exact greedy tokens the cache-free reference
+produces, hit or miss, across partial matches, eviction, and int8
+quantized caches."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.models import LLAMA_CONFIGS, llama
+from gofr_tpu.tpu import GenerationEngine
+from gofr_tpu.tpu.prefix_cache import PrefixIndex
+
+TINY = LLAMA_CONFIGS["tiny"]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init(TINY, jax.random.PRNGKey(1))
+
+
+def _ref_greedy(params, prompt, n):
+    toks = list(prompt)
+    for _ in range(n):
+        logits = llama.forward(params, TINY, jnp.asarray([toks], jnp.int32))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def _engine(params, **kw):
+    kw.setdefault("prefix_cache_slots", 2)
+    kw.setdefault("prefix_store_min", 16)
+    return GenerationEngine(TINY, params, slots=2, max_seq=128,
+                            prompt_buckets=(8, 16, 32), **kw)
+
+
+# -- index unit tests ---------------------------------------------------------
+
+def test_index_lcp_match_and_lru_eviction():
+    idx = PrefixIndex(2)
+    a = np.arange(1, 41, dtype=np.int32)          # 40 tokens
+    b = np.arange(100, 140, dtype=np.int32)
+    assert idx.match(a) == (-1, 0)                # cold miss
+    ra = idx.store_row(a)
+    rb = idx.store_row(b)
+    assert ra != rb
+    # partial match of a stored prefix is a valid (shorter) hit
+    probe = np.concatenate([a[:25], np.asarray([9, 9], np.int32)])
+    row, m = idx.match(probe)
+    assert row == ra and m == 25
+    # covered: storing a shorter prefix of an entry is pointless
+    assert idx.covered(a[:30]) and not idx.covered(probe)
+    # LRU: a was just touched by match -> b is the victim
+    c = np.arange(200, 240, dtype=np.int32)
+    rc = idx.store_row(c)
+    assert rc == rb
+    assert idx.stats()["entries"] == 2 and idx.stats()["hits"] == 1
+
+
+# -- engine behavior ----------------------------------------------------------
+
+def test_hit_restores_prefix_and_streams_exact_tokens(params):
+    rng = np.random.default_rng(3)
+    prefix = rng.integers(1, TINY.vocab_size, 24).tolist()
+    eng = _engine(params)
+    try:
+        # 1st request stores the prompt's KV row
+        first = eng.generate(prefix, max_new_tokens=4).tokens()
+        assert first == _ref_greedy(params, prefix, 4)
+        assert eng.stats()["prefix_cache"]["entries"] == 1
+        # 2nd request shares the prefix, different tail -> partial hit
+        cont = prefix[:20] + rng.integers(1, TINY.vocab_size, 12).tolist()
+        got = eng.generate(cont, max_new_tokens=6).tokens()
+        assert got == _ref_greedy(params, cont, 6)
+        st = eng.stats()["prefix_cache"]
+        assert st["hits"] >= 1
+        # 3rd: exact repeat (full-length match; one token recomputes)
+        again = eng.generate(prefix, max_new_tokens=4).tokens()
+        assert again == first
+    finally:
+        eng.close()
+
+
+def test_hit_with_chunked_remainder(params):
+    """Prefix hit + a long remainder that still needs mid chunks: the
+    resumed chunk lattice (traced starts) must write [m, L) correctly."""
+    rng = np.random.default_rng(4)
+    prefix = rng.integers(1, TINY.vocab_size, 32).tolist()
+    eng = _engine(params)
+    try:
+        eng.generate(prefix, max_new_tokens=2).tokens()
+        long = prefix + rng.integers(1, TINY.vocab_size, 70).tolist()
+        got = eng.generate(long, max_new_tokens=5).tokens()
+        assert got == _ref_greedy(params, long, 5)
+        assert eng.stats()["prefix_cache"]["hits"] >= 1
+    finally:
+        eng.close()
+
+
+def test_quantized_cache_pool_roundtrips(params):
+    """int8 pool rows (values + scale planes) restore bit-identically:
+    a hit must reproduce the miss path's tokens exactly."""
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(1, TINY.vocab_size, 28).tolist()
+    miss_eng = _engine(params, prefix_cache_slots=0)
+    try:
+        want = miss_eng.generate(prompt, max_new_tokens=6,
+                                 ).tokens()
+    finally:
+        miss_eng.close()
+    eng = _engine(params, kv_dtype=jnp.int8)
+    try:
+        assert eng.generate(prompt, max_new_tokens=6).tokens() == want
+        assert eng.generate(prompt, max_new_tokens=6).tokens() == want
+        assert eng.stats()["prefix_cache"]["hits"] >= 1
+    finally:
+        eng.close()
+
+
+def test_eviction_keeps_streams_correct(params):
+    rng = np.random.default_rng(6)
+    prompts = [rng.integers(1, TINY.vocab_size, 20).tolist()
+               for _ in range(3)]
+    eng = _engine(params, prefix_cache_slots=1)
+    try:
+        for _ in range(2):  # second pass re-stores after eviction
+            for p in prompts:
+                assert eng.generate(p, max_new_tokens=3).tokens() == \
+                    _ref_greedy(params, p, 3)
+        assert eng.stats()["prefix_cache"]["entries"] == 1
+    finally:
+        eng.close()
+
+
+def test_short_prompts_bypass_pool(params):
+    eng = _engine(params, prefix_store_min=16)
+    try:
+        eng.generate([1, 2, 3], max_new_tokens=2).tokens()
+        assert eng.stats()["prefix_cache"]["entries"] == 0
+    finally:
+        eng.close()
+
+
+def test_disabled_by_default_and_mesh_rejected(params):
+    eng = GenerationEngine(TINY, params, slots=2, max_seq=64,
+                           prompt_buckets=(8, 16))
+    try:
+        assert "prefix_cache" not in eng.stats()
+    finally:
+        eng.close()
+    from gofr_tpu import parallel
+
+    mesh = parallel.make_mesh(dp=8)
+    with pytest.raises(ValueError, match="single-device"):
+        GenerationEngine(TINY, parallel.shard_params(params, mesh),
+                         slots=2, max_seq=64, prompt_buckets=(8,),
+                         mesh=mesh, prefix_cache_slots=2)
