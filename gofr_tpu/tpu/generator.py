@@ -424,7 +424,12 @@ class GenerationEngine:
             free = next((i for i, s in enumerate(self._slots) if s.free), None)
             if free is not None:
                 C = self.prompt_buckets[-1]
-                chunked_reachable = self.max_seq - 1 > C
+                # chunk programs run for prompts past the largest bucket
+                # — and, with a prefix pool, for ANY hit (prefill resumes
+                # mid-prompt through the chunk lattice), so they must be
+                # warm whenever the pool exists
+                chunked_reachable = (self.max_seq - 1 > C
+                                     or self._prefix_idx is not None)
                 for b in self.prompt_buckets:
                     toks = jnp.zeros((1, b), jnp.int32)
                     _, self.cache = jax.block_until_ready(self._prefill_jit(
@@ -555,20 +560,21 @@ class GenerationEngine:
             return 0
         prompt = np.asarray(req.prompt, np.int32)
         row, m = self._prefix_idx.match(prompt)
-        if row < 0:
-            return 0
         m_eff = min(int(m), L - 1)
-        if m_eff < self.prompt_buckets[0]:
-            return 0  # matched less than the smallest bucket: the copy
-            # would not remove a single dispatch's worth of work
-        # the final chunk needs [L - Sb, L) to be a valid window
         rem = L - m_eff
         while rem > C:
             rem -= C
-        if L - pad_bucket(rem, self.prompt_buckets) < 0:
+        if (row < 0
+                # matched less than the smallest bucket: the copy would
+                # not remove a single dispatch's worth of work
+                or m_eff < self.prompt_buckets[0]
+                # the final chunk needs [L - Sb, L) to be a valid window
+                or L - pad_bucket(rem, self.prompt_buckets) < 0):
+            self._prefix_idx.reject()
             return 0
         self.cache = self._pool_load_jit(self.cache, self._pool,
                                          jnp.int32(idx), jnp.int32(row))
+        self._prefix_idx.accept(row)
         if self.metrics is not None:
             self.metrics.increment_counter(
                 "app_tpu_prefix_cache_hits_total")

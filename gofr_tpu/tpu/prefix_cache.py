@@ -45,7 +45,11 @@ class PrefixIndex:
         """(pool_row, matched_len) for the longest common prefix between
         ``prompt`` and any stored entry — a PARTIAL match of a stored
         prefix is still valid KV (a prefix of a prefix). (-1, 0) when
-        nothing matches; counts a hit/miss and touches LRU on hit."""
+        nothing matches. PURE: the caller decides whether the match is
+        USABLE (long enough, valid chunk window) and reports back via
+        accept()/reject() — counting a hit or refreshing LRU for a match
+        the engine then discards would diverge the stats from the
+        Prometheus counter and keep useless entries alive at eviction."""
         best, best_len = -1, 0
         for i, key in enumerate(self._keys):
             if key is None:
@@ -57,13 +61,17 @@ class PrefixIndex:
             m = int(neq[0]) if len(neq) else n
             if m > best_len:
                 best, best_len = i, m
-        if best >= 0 and best_len > 0:
-            self.hits += 1
-            self._tick += 1
-            self._used[best] = self._tick
-            return best, best_len
+        return (best, best_len) if best >= 0 and best_len > 0 else (-1, 0)
+
+    def accept(self, row: int) -> None:
+        """The engine restored ``row``: count the hit, touch LRU."""
+        self.hits += 1
+        self._tick += 1
+        self._used[row] = self._tick
+
+    def reject(self) -> None:
+        """No usable match for this admission."""
         self.misses += 1
-        return -1, 0
 
     def covered(self, prompt: np.ndarray) -> bool:
         """True when some stored entry already contains ``prompt`` as a

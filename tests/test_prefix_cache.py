@@ -41,7 +41,8 @@ def test_index_lcp_match_and_lru_eviction():
     idx = PrefixIndex(2)
     a = np.arange(1, 41, dtype=np.int32)          # 40 tokens
     b = np.arange(100, 140, dtype=np.int32)
-    assert idx.match(a) == (-1, 0)                # cold miss
+    assert idx.match(a) == (-1, 0)                # cold: no candidate
+    idx.reject()
     ra = idx.store_row(a)
     rb = idx.store_row(b)
     assert ra != rb
@@ -49,13 +50,17 @@ def test_index_lcp_match_and_lru_eviction():
     probe = np.concatenate([a[:25], np.asarray([9, 9], np.int32)])
     row, m = idx.match(probe)
     assert row == ra and m == 25
+    # match() is pure — only accept() counts the hit and touches LRU
+    assert idx.stats()["hits"] == 0
+    idx.accept(row)
     # covered: storing a shorter prefix of an entry is pointless
     assert idx.covered(a[:30]) and not idx.covered(probe)
-    # LRU: a was just touched by match -> b is the victim
+    # LRU: a was just accepted -> b is the victim
     c = np.arange(200, 240, dtype=np.int32)
     rc = idx.store_row(c)
     assert rc == rb
-    assert idx.stats()["entries"] == 2 and idx.stats()["hits"] == 1
+    st = idx.stats()
+    assert st["entries"] == 2 and st["hits"] == 1 and st["misses"] == 1
 
 
 # -- engine behavior ----------------------------------------------------------
@@ -100,13 +105,15 @@ def test_hit_with_chunked_remainder(params):
 
 def test_quantized_cache_pool_roundtrips(params):
     """int8 pool rows (values + scale planes) restore bit-identically:
-    a hit must reproduce the miss path's tokens exactly."""
+    a hit must reproduce the MISS path's tokens exactly. The reference
+    is an int8 engine WITHOUT a pool — quantization itself may
+    legitimately differ from the fp cache; the invariant under test is
+    hit == miss within the same cache dtype."""
     rng = np.random.default_rng(5)
     prompt = rng.integers(1, TINY.vocab_size, 28).tolist()
-    miss_eng = _engine(params, prefix_cache_slots=0)
+    miss_eng = _engine(params, prefix_cache_slots=0, kv_dtype=jnp.int8)
     try:
-        want = miss_eng.generate(prompt, max_new_tokens=6,
-                                 ).tokens()
+        want = miss_eng.generate(prompt, max_new_tokens=6).tokens()
     finally:
         miss_eng.close()
     eng = _engine(params, kv_dtype=jnp.int8)

@@ -81,8 +81,11 @@ def test_hpack_truncation_is_loud():
     decodes to a PREFIX of the original headers — never to different or
     extra headers (a truncated stream must not fabricate data)."""
     rng = random.Random(0x7A7A)
-    enc = Encoder()
     for _ in range(40):
+        # fresh encoder per case: a shared one emits dynamic-table
+        # references to EARLIER cases' entries, which a fresh Decoder
+        # rejects outright — silently skipping the fabrication check
+        enc = Encoder()
         headers = [(n.lower().encode(), v.encode())
                    for n, v in _rand_headers(rng)]
         block = bytes(enc.encode(headers))
