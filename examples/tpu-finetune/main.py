@@ -10,7 +10,9 @@ examples/sample-cmd, pkg/gofr/cmd.go:27-63).
 Data: an .npz with ``tokens`` [N, S] int32 (and optional ``lengths``
 [N]); omitted = synthetic random tokens (bringup mode, like
 TPU_WEIGHTS-less serving). Meshes with sp>1 train through ring
-attention automatically (seq_parallel="auto").
+attention automatically (seq_parallel="auto"); ``-sharding=pp=2,dp=4``
+runs the GPipe pipeline conveyor, ``ep=...`` shards MoE experts —
+every axis of gofr_tpu/parallel composes through this one flag.
 """
 
 from __future__ import annotations
