@@ -31,11 +31,12 @@ def _mk(key, quant: bool):
 
 @pytest.mark.parametrize("quant", [False, True])
 @pytest.mark.parametrize("lengths", [[256, 100, 1], [37, 128, 255], [0, 5, 256]])
-def test_flash_decode_matches_reference(quant, lengths):
+@pytest.mark.parametrize("block_s", [64, 128, 256])
+def test_flash_decode_matches_reference(quant, lengths, block_s):
     q, k, v, k_new, v_new, sk, sv = _mk(jax.random.PRNGKey(0), quant)
     lens = jnp.asarray(lengths, jnp.int32)
     got = flash_decode_appended(q, k, v, k_new, v_new, lens, sk, sv,
-                                block_s=BS, interpret=True)
+                                block_s=block_s, interpret=True)
     want = decode_attention_appended(q, k, v, k_new, v_new, lens, sk, sv)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
