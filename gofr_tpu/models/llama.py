@@ -22,7 +22,8 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import (causal_attention, chunk_attention,
-                             decode_attention_appended)
+                             decode_attention_appended,
+                             window_attention_appended)
 from ..ops.norms import rms_norm
 from ..ops.quant import qmatmul, quantize_kv
 from ..ops.rope import apply_rope, rope_frequencies
@@ -473,6 +474,75 @@ def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                      cache.lengths)
     logits = _logits(params, cfg, x) if compute_logits else None
     return logits, cache
+
+
+def verify_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
+                cache: KVCache, rope_tables=None) -> tuple[jnp.ndarray, KVCache]:
+    """Multi-token verify pass — speculative decoding's target forward.
+
+    ``tokens`` [B, W]: column 0 is each slot's pending last sampled
+    token (the one decode_step would consume), columns 1.. are draft
+    continuations. ONE weight stream computes logits at every window
+    position ([B, W, V] f32 — logits[:, j] predicts the token after
+    consuming tokens[:, :j+1]) and writes all W KV rows at each slot's
+    cursor. ``cache.lengths`` is returned UNCHANGED: acceptance — how
+    far the cursor really advances — is the caller's call, and garbage
+    KV past the accepted point stays invisible behind the cursor and is
+    overwritten by the next window (the same cursor-visibility contract
+    decode_step documents). W=1 is exactly decode_step minus sampling.
+
+    Why this wins: decode streams the full weight set per token; a
+    verify window streams it once for up to W tokens. On agreeing
+    drafts (repetitive text, prompt-lookup hits) decode becomes
+    bandwidth-bound on W tokens per pass instead of one.
+
+    CAPACITY CONTRACT: callers must ensure ``lengths + W <= capacity``
+    for slots whose acceptance they will honor — rows past capacity are
+    scatter-dropped and must not be accepted.
+    """
+    cfg = multi_request_serving_config(cfg)
+    B, W = tokens.shape
+    cos, sin = rope_tables or get_rope_tables(cfg, cache.k.shape[2])
+    positions = cache.lengths[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+    lengths = cache.lengths
+
+    x = params["embedding"][tokens].astype(cfg.jdtype)  # [B, W, D]
+
+    def body(x, xs):
+        layer_w, k_layer, v_layer, ks_layer, vs_layer = xs
+
+        def attend(q, k_new, v_new):
+            return window_attention_appended(q, k_layer, v_layer, k_new,
+                                             v_new, lengths, ks_layer,
+                                             vs_layer)
+
+        x, kv, _ = _layer(x, layer_w, cfg, cos, sin, positions,
+                          kv_write=lambda k, v: (k, v), attend=attend)
+        return x, kv
+
+    x, (k_w, v_w) = jax.lax.scan(
+        body, x, (params["layers"], cache.k, cache.v,
+                  cache.k_scale, cache.v_scale))
+    # one scatter for all layers and window rows: [L, B, W, KV, hd] ->
+    # cache[:, b, lengths[b] + j] (adjacent advanced indices broadcast)
+    b_idx = jnp.arange(B)[:, None]                       # [B, 1]
+    if cache.quantized:
+        qk, sk = quantize_kv(k_w)
+        qv, sv = quantize_kv(v_w)
+        new = KVCache(
+            k=cache.k.at[:, b_idx, positions].set(qk, mode="drop"),
+            v=cache.v.at[:, b_idx, positions].set(qv, mode="drop"),
+            lengths=lengths,
+            k_scale=cache.k_scale.at[:, b_idx, positions].set(sk, mode="drop"),
+            v_scale=cache.v_scale.at[:, b_idx, positions].set(sv, mode="drop"))
+    else:
+        new = KVCache(
+            k=cache.k.at[:, b_idx, positions].set(
+                k_w.astype(cache.k.dtype), mode="drop"),
+            v=cache.v.at[:, b_idx, positions].set(
+                v_w.astype(cache.v.dtype), mode="drop"),
+            lengths=lengths)
+    return _logits(params, cfg, x), new
 
 
 def multi_request_serving_config(cfg: ModelConfig) -> ModelConfig:

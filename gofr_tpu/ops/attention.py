@@ -130,6 +130,54 @@ def decode_attention_appended(q: jnp.ndarray, k_cache: jnp.ndarray,
     return out.reshape(b, 1, h, d)
 
 
+def window_attention_appended(q: jnp.ndarray, k_cache: jnp.ndarray,
+                              v_cache: jnp.ndarray, k_new: jnp.ndarray,
+                              v_new: jnp.ndarray, lengths: jnp.ndarray,
+                              k_scale: jnp.ndarray | None = None,
+                              v_scale: jnp.ndarray | None = None) -> jnp.ndarray:
+    """decode_attention_appended generalized to a W-token window — the
+    speculative-decoding verify pass: window query j attends the cache
+    prefix (positions < lengths[b], per slot) plus window positions <= j,
+    before any of the window's KV is written back. W=1 reduces exactly to
+    the appended decode step; unlike chunk_attention the prefix boundary
+    is PER ROW (every slot sits at its own cursor).
+
+    q: [B, W, H, D]; k_cache/v_cache: [B, Smax, KV, D];
+    k_new/v_new: [B, W, KV, D]; lengths: [B] valid cache entries
+    (EXCLUDING the window). Returns [B, W, H, D]. Int8 cache scales are
+    applied score/prob-side exactly as in decode_attention_appended.
+    """
+    b, w, h, d = q.shape
+    smax = k_cache.shape[1]
+    n_kv = k_cache.shape[2]
+    scale = d ** -0.5
+
+    qg = _repeat_kv_shape(q * scale, n_kv)  # [B,W,KV,G,D]
+    scores_c = jnp.einsum("bwkgd,btkd->bkgwt", qg, k_cache.astype(qg.dtype),
+                          preferred_element_type=jnp.float32)
+    if k_scale is not None:
+        scores_c = scores_c * jnp.transpose(
+            k_scale, (0, 2, 1))[:, :, None, None, :]
+    valid = jnp.arange(smax)[None, :] < lengths[:, None]     # [B, Smax]
+    scores_c = jnp.where(valid[:, None, None, None, :], scores_c, NEG_INF)
+    scores_s = jnp.einsum("bwkgd,btkd->bkgwt", qg, k_new,
+                          preferred_element_type=jnp.float32)  # [B,KV,G,W,W]
+    causal = jnp.tril(jnp.ones((w, w), bool))
+    scores_s = jnp.where(causal[None, None, None], scores_s, NEG_INF)
+    probs = jax.nn.softmax(jnp.concatenate([scores_c, scores_s], axis=-1),
+                           axis=-1)
+    probs_c = probs[..., :smax]
+    if v_scale is not None:
+        probs_c = probs_c * jnp.transpose(
+            v_scale, (0, 2, 1))[:, :, None, None, :]
+    vdt = q.dtype if v_scale is not None else v_cache.dtype
+    out = (jnp.einsum("bkgwt,btkd->bwkgd", probs_c.astype(vdt),
+                      v_cache.astype(vdt))
+           + jnp.einsum("bkgwt,btkd->bwkgd",
+                        probs[..., smax:].astype(v_new.dtype), v_new))
+    return out.reshape(b, w, h, d)
+
+
 def chunk_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
                     k_new: jnp.ndarray, v_new: jnp.ndarray,
                     start: jnp.ndarray,

@@ -36,6 +36,11 @@ Config keys (reference config style, pkg/gofr/config/config.go:3):
                       single-device engines only
   TPU_PREFIX_MIN      min prompt length stored in the pool (default:
                       the largest prompt bucket)
+  TPU_SPEC_DECODE     prompt-lookup speculative decoding: K draft
+                      tokens per verify pass (default 0 = off). One
+                      weight stream emits 1..K+1 tokens per greedy slot
+                      when its history's trailing n-gram repeats;
+                      single-device engines only
   TPU_BATCH_BUCKETS   csv of predict batch buckets (default 1,2,4,8)
   TPU_SEQ_BUCKETS     csv of token-length buckets  (default 32..512)
   TPU_MAX_BATCH_DELAY coalescing window in seconds (default 0.004)
@@ -156,7 +161,8 @@ def new_engine_from_config(cfg, logger=None, metrics=None) -> TPUEngine:
             decode_block=cfg.get_int("TPU_DECODE_BLOCK", 4),
             admit_window_ms=cfg.get_float("TPU_ADMIT_WINDOW_MS", 2.0),
             prefix_cache_slots=cfg.get_int("TPU_PREFIX_CACHE", 0),
-            prefix_store_min=cfg.get_int("TPU_PREFIX_MIN", 0) or None)
+            prefix_store_min=cfg.get_int("TPU_PREFIX_MIN", 0) or None,
+            spec_decode_k=cfg.get_int("TPU_SPEC_DECODE", 0))
 
         # scoring program: next-token logits at the prompt end (the
         # non-streaming sibling of generate, e.g. for classification

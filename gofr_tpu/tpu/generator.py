@@ -130,7 +130,8 @@ class GenerationEngine:
                  kv_dtype=None, decode_block: int = 4,
                  admit_window_ms: float = 2.0,
                  prefix_cache_slots: int = 0,
-                 prefix_store_min: int | None = None):
+                 prefix_store_min: int | None = None,
+                 spec_decode_k: int = 0):
         self.cfg = cfg
         self.params = params
         self.n_slots = slots
@@ -199,6 +200,25 @@ class GenerationEngine:
                                   or self.prompt_buckets[-1])
             self._pool_load_jit = jax.jit(_copy_row, donate_argnums=(0,))
             self._pool_store_jit = jax.jit(_copy_row, donate_argnums=(0,))
+
+        # Prompt-lookup speculative decoding (greedy slots only): each
+        # tick proposes K draft tokens per slot by matching the trailing
+        # n-gram of the slot's history against its own earlier tokens
+        # (repetitive text, code, JSON); ONE verify dispatch streams the
+        # weights once and emits 1..K+1 tokens per slot. Misses cost a
+        # normal decode tick (the engine falls back when no slot drafts,
+        # any active slot samples, or a slot is within a window of
+        # capacity). Single-device engines only for now, like the
+        # prefix pool.
+        self._spec_k = max(0, int(spec_decode_k))
+        if self._spec_k:
+            if mesh is not None:
+                raise ValueError("spec_decode_k requires a single-device "
+                                 "engine (mesh=None)")
+            self._verify_jit = jax.jit(self._verify_fn, donate_argnums=(0,))
+            self._spec_windows = 0
+            self._spec_emitted = 0
+        self._hist: list[list[int]] = [[] for _ in range(slots)]
 
         self._pending: queue.Queue[_Request] = queue.Queue()
         self._work = threading.Event()
@@ -354,6 +374,41 @@ class GenerationEngine:
         (_, cache), toks = jax.lax.scan(body, (last_tokens, cache), keys)
         return toks, cache
 
+    def _verify_fn(self, cache, params, window, active, key):
+        """One speculative verify pass. ``window`` [B, W]: col 0 = each
+        slot's pending last token, cols 1.. = prompt-lookup drafts.
+        Greedy-only (callers route sampling slots to the decode path).
+        Returns (greedy [B, W], emit [B] — how many of greedy's leading
+        tokens are real, 0 for inactive slots) and the cache with
+        cursors advanced by emit. ``key`` is unused (greedy) but kept so
+        the signature matches _step_fn's calling convention."""
+        logits, stepped = llama.verify_step(params, self.cfg, window,
+                                            cache,
+                                            rope_tables=self.rope_tables)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, W]
+        agree = (greedy[:, :-1] == window[:, 1:]).astype(jnp.int32)
+        accept = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)     # [B]
+        emit = jnp.where(active, accept + 1, 0)
+        lengths = stepped.lengths + emit
+        return greedy, emit, stepped._replace(lengths=lengths)
+
+    def _draft(self, idx: int) -> list[int] | None:
+        """Prompt-lookup draft: the K tokens that followed the most
+        recent earlier occurrence of the history's trailing 2-gram.
+        None = no match (this slot proposes nothing)."""
+        hist = self._hist[idx]
+        K = self._spec_k
+        if len(hist) < 3:
+            return None
+        a, b = hist[-2], hist[-1]
+        for j in range(len(hist) - 3, -1, -1):
+            if hist[j] == a and hist[j + 1] == b:
+                cont = hist[j + 2:j + 2 + K]
+                if cont:
+                    return cont + [0] * (K - len(cont))
+                return None
+        return None
+
     # -- public API ----------------------------------------------------------
     def generate(self, prompt, max_new_tokens: int = 128,
                  temperature: float = 0.0, top_k: int = 0,
@@ -408,6 +463,15 @@ class GenerationEngine:
         }
         if self._prefix_idx is not None:
             out["prefix_cache"] = self._prefix_idx.stats()
+        if self._spec_k:
+            out["spec_decode"] = {
+                "k": self._spec_k,
+                "windows": self._spec_windows,
+                "emitted": self._spec_emitted,
+                "tokens_per_window": (
+                    round(self._spec_emitted / self._spec_windows, 3)
+                    if self._spec_windows else None),
+            }
         return out
 
     def warmup(self) -> None:
@@ -605,6 +669,8 @@ class GenerationEngine:
             req.stream._q.put(None)
             raise
         self._prefix_store(idx, req)
+        if self._spec_k:
+            self._hist[idx] = list(int(t) for t in req.prompt)
         if self.metrics is not None:
             self.metrics.record_histogram("app_tpu_batch_wait_duration",
                                           t0 - req.enqueued_at, program="generate")
@@ -614,6 +680,8 @@ class GenerationEngine:
         self.total_requests += 1
         self._temps[idx] = req.temperature
         self._top_ks[idx] = req.top_k
+        if self._spec_k:
+            self._hist[idx].append(int(first))
         self._deliver(idx, slot, first)
         if slot.request is not None:  # not finished by the first token
             self._last_tokens[idx] = first
@@ -705,7 +773,63 @@ class GenerationEngine:
 
     def _iteration(self) -> None:
         self._admit()
+        self._tick()
+
+    def _tick(self) -> None:
+        """One serving tick: a speculative verify pass when the engine
+        can use one (spec enabled, every active slot greedy and clear of
+        capacity, at least one slot has a draft), else a decode block."""
+        if self._spec_k and self._spec_eligible():
+            drafts = {idx: self._draft(idx)
+                      for idx in range(self.n_slots) if self._active[idx]}
+            if any(d is not None for d in drafts.values()):
+                self._verify_tick(drafts)
+                return
         self._decode_tick()
+
+    def _spec_eligible(self) -> bool:
+        W = self._spec_k + 1
+        saw_active = False
+        for idx, slot in enumerate(self._slots):
+            if not self._active[idx]:
+                continue
+            req = slot.request
+            if req is None or req.temperature > 0:
+                return False  # sampling slots need the decode sampler
+            if req.stream.prompt_len + slot.generated + W > self.max_seq:
+                return False  # would scatter past capacity (llama.
+                # verify_step's capacity contract) — the slot retires soon
+            saw_active = True
+        return saw_active
+
+    def _verify_tick(self, drafts: dict) -> None:
+        """One verify dispatch: window = [last_token, K drafts] per slot
+        (zero drafts for slots with no lookup match — they still emit
+        their 1 guaranteed token). Delivery mirrors _decode_tick: emitted
+        tokens stream in order, retirement mid-window discards the rest."""
+        W = self._spec_k + 1
+        window = np.zeros((self.n_slots, W), np.int32)
+        window[:, 0] = self._last_tokens
+        for idx, d in drafts.items():
+            if d is not None:
+                window[idx, 1:] = d
+        toks, emit, self.cache = self._verify_jit(
+            self.cache, self.params, jnp.asarray(window),
+            jnp.asarray(self._active), self._next_key())
+        toks_np = np.asarray(jax.device_get(toks))
+        emit_np = np.asarray(jax.device_get(emit))
+        self._spec_windows += int(self._active.sum())
+        self._spec_emitted += int(emit_np.sum())
+        for idx, slot in enumerate(self._slots):
+            if not self._active[idx]:
+                continue
+            for k in range(int(emit_np[idx])):
+                if not self._active[idx]:
+                    break  # retired mid-window (EOS/budget/cancel)
+                t = int(toks_np[idx, k])
+                self._last_tokens[idx] = t
+                self._hist[idx].append(t)
+                self._deliver(idx, slot, t)
 
     def _decode_tick(self) -> None:
         """One fused decode block: dispatch, fetch [K, B] tokens, deliver
@@ -728,4 +852,6 @@ class GenerationEngine:
                 if not self._active[idx]:
                     continue
                 self._last_tokens[idx] = toks_np[k, idx]
+                if self._spec_k:
+                    self._hist[idx].append(int(toks_np[k, idx]))
                 self._deliver(idx, slot, int(toks_np[k, idx]))
