@@ -788,7 +788,13 @@ class GenerationEngine:
         if self._spec_k and self._spec_eligible():
             drafts = {idx: self._draft(idx)
                       for idx in range(self.n_slots) if self._active[idx]}
-            if any(d is not None for d in drafts.values()):
+            drafted = sum(d is not None for d in drafts.values())
+            # Coverage gate: slots WITHOUT drafts emit 1 token per verify
+            # pass vs decode_block per decode dispatch — one repetitive
+            # stream must not drag a batch of non-repetitive ones into
+            # K-times-slower cadence. Verify only when at least half the
+            # active slots would actually speculate.
+            if drafted > 0 and 2 * drafted >= len(drafts):
                 self._verify_tick(drafts)
                 return
         self._decode_tick()

@@ -94,7 +94,8 @@ def test_verify_step_partial_accept_prefix(params):
 # -- engine level -------------------------------------------------------------
 
 def _ref_stream(params, prompt, n, **kw):
-    eng = GenerationEngine(TINY, params, slots=2, max_seq=64,
+    kw.setdefault("slots", 2)
+    eng = GenerationEngine(TINY, params, max_seq=64,
                            prompt_buckets=(8, 16), **kw)
     try:
         return eng.generate(prompt, max_new_tokens=n).tokens()
@@ -170,6 +171,26 @@ def test_spec_respects_capacity(params):
                            prompt_buckets=(8, 16), spec_decode_k=4)
     try:
         assert eng.generate(prompt, max_new_tokens=200).tokens() == want
+    finally:
+        eng.close()
+
+
+def test_spec_coverage_gate_mixed_workload(params):
+    """One repetitive stream among several non-repetitive ones: the
+    coverage gate keeps the batch on decode blocks until enough slots
+    can speculate, and every stream still matches the plain engine."""
+    prompts = [[7, 9, 7, 9, 7, 9, 7, 9],
+               np.random.default_rng(11).integers(1, 256, 10).tolist(),
+               np.random.default_rng(12).integers(1, 256, 9).tolist(),
+               np.random.default_rng(13).integers(1, 256, 11).tolist()]
+    plain = {tuple(p): _ref_stream(params, p, 12, slots=4)
+             for p in prompts}
+    eng = GenerationEngine(TINY, params, slots=4, max_seq=64,
+                           prompt_buckets=(8, 16), spec_decode_k=3)
+    try:
+        streams = [eng.generate(p, max_new_tokens=12) for p in prompts]
+        for p, s in zip(prompts, streams):
+            assert s.tokens() == plain[tuple(p)], f"prompt {p[:4]}..."
     finally:
         eng.close()
 
