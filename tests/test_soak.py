@@ -24,7 +24,7 @@ def test_soak_concurrent_generate_cancel_and_prefix_reuse():
     eng = GenerationEngine(TINY, params, slots=4, max_seq=64,
                            prompt_buckets=(8, 16), decode_block=2,
                            kv_dtype=jnp.int8, prefix_cache_slots=2,
-                           prefix_store_min=16)
+                           prefix_store_min=16, spec_decode_k=2)
     # greedy oracle per prompt, computed once against the int8 engine
     # itself on an idle engine (the soak asserts REPRODUCIBILITY under
     # concurrency, not quantization-vs-fp numerics)
