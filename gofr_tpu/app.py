@@ -270,7 +270,23 @@ class App:
             except KeyboardInterrupt:
                 self.stop()
 
-    def stop(self) -> None:
+    def stop(self, grace_s: float = 0.0) -> None:
+        """Stop the app. ``grace_s > 0`` drains first, k8s-style: pub/sub
+        consumption stops (no new work generated), the TPU generation
+        engine refuses new requests but finishes every in-flight stream
+        (up to the grace window) WHILE the HTTP/gRPC listeners stay up —
+        clients receive complete streams over their live connections —
+        then everything tears down. The reference stops its servers with
+        Go's graceful http.Server.Shutdown; streaming engines need the
+        engine-level drain on top."""
+        if grace_s > 0:
+            self.subscription_manager.stop()
+            tpu = getattr(self.container, "tpu", None)
+            gen = getattr(tpu, "generator", None)
+            if gen is not None:
+                drained = gen.drain(grace_s)
+                self.logger.info({"event": "generation engine drained",
+                                  "clean": drained})
         for srv in (self._http_server, self._metrics_server):
             if srv is not None:
                 srv.stop()

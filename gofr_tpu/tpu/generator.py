@@ -227,6 +227,11 @@ class GenerationEngine:
         # guards the _closed check-then-enqueue in generate() against close()
         self._admission_lock = threading.Lock()
         self._closed = False
+        self._draining = False
+        # requests popped off _pending but not yet visible in _active —
+        # the admission window (prefill can compile for seconds on a
+        # first-shape request); drain() must count them as in-flight
+        self._admitting = 0
         self.total_tokens = 0
         self.total_requests = 0
 
@@ -429,6 +434,8 @@ class GenerationEngine:
         saturates to 64 rather than widening the distribution."""
         if self._closed:
             raise GenerationError("generation engine is closed")
+        if self._draining:
+            raise GenerationError("generation engine is draining")
         if self.down is not None:
             raise GenerationError(f"generation engine is down: {self.down}")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -462,6 +469,7 @@ class GenerationEngine:
             "slots": self.n_slots,
             "active": int(self._active.sum()),
             "queued": self._pending.qsize(),
+            "draining": self._draining,
             "max_seq": self.max_seq,
             "prompt_buckets": list(self.prompt_buckets),
             "total_requests": self.total_requests,
@@ -530,6 +538,27 @@ class GenerationEngine:
             # restore cursors dirtied by the dummy dispatches
             self.cache = self.cache._replace(lengths=jnp.asarray(cursors))
 
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful shutdown, phase 1: refuse NEW requests (generate()
+        raises), keep serving everything already accepted — active slots
+        and the admission queue — until idle or ``timeout``. Returns
+        True when fully drained; either way the caller still owns the
+        final close(). The k8s-style stop sequence is
+        ``app.stop(grace_s=...)``: listeners stay up through the drain
+        so in-flight streams complete over their live connections."""
+        with self._admission_lock:
+            self._draining = True
+        def idle() -> bool:
+            return (not self._active.any() and self._pending.empty()
+                    and self._admitting == 0)
+
+        deadline = time.monotonic() + max(0.0, timeout)
+        while time.monotonic() < deadline:
+            if idle():
+                return True
+            time.sleep(0.05)
+        return idle()
+
     def close(self) -> None:
         with self._admission_lock:
             self._closed = True
@@ -564,7 +593,11 @@ class GenerationEngine:
             if req.stream.cancelled.is_set():
                 req.stream._q.put(None)
                 continue
-            self._start(idx, slot, req)
+            self._admitting += 1
+            try:
+                self._start(idx, slot, req)
+            finally:
+                self._admitting -= 1
 
     def _admit_prefill(self, idx: int, req: _Request) -> int:
         """Run the request's prompt through prefill into slot ``idx`` and

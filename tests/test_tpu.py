@@ -276,6 +276,68 @@ def test_admit_window_yields_between_blocks(tiny_llama):
             eng.close()
 
 
+def test_drain_finishes_inflight_and_refuses_new(tiny_llama):
+    """drain(): in-flight streams run to completion, new requests are
+    refused with a clear error, and the engine reports drained."""
+    eng = GenerationEngine(TINY, tiny_llama, slots=2, max_seq=64,
+                           prompt_buckets=(8,))
+    try:
+        s = eng.generate([5, 17, 42, 7], max_new_tokens=24)
+        it = iter(s)
+        next(it)  # stream is live
+        done = []
+        t = threading.Thread(target=lambda: done.append(eng.drain(30.0)))
+        t.start()
+        time.sleep(0.05)  # drain engaged
+        with pytest.raises(GenerationError, match="draining"):
+            eng.generate([1, 2, 3], max_new_tokens=2)
+        rest = list(it)  # completes fully despite the drain
+        assert len(rest) == 23
+        t.join(timeout=60)
+        assert done == [True]
+        assert eng.stats()["draining"] is True
+    finally:
+        eng.close()
+
+
+def test_app_stop_graceful_drains_engine():
+    """app.stop(grace_s): the engine finishes in-flight streams while
+    the servers stay up, then everything tears down."""
+    from gofr_tpu import App
+
+    app = App(MapConfig({"HTTP_PORT": "0", "METRICS_PORT": "0",
+                         "TPU_MODEL": "tiny", "TPU_MAX_SEQ": "64",
+                         "TPU_SLOTS": "2", "TPU_SEQ_BUCKETS": "8,16"}))
+
+    @app.get("/gen")
+    def gen(ctx):
+        return {"tokens": ctx.tpu.generate([1, 2, 3],
+                                           max_new_tokens=30).tokens()}
+
+    app.run(block=False)
+    try:
+        import json
+        import urllib.request
+
+        results = []
+
+        def client():
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{app.http_port}/gen", timeout=120) as r:
+                results.append(json.loads(r.read()))
+
+        t = threading.Thread(target=client)
+        t.start()
+        time.sleep(0.3)  # request in flight, stream decoding
+        app.stop(grace_s=60.0)
+        t.join(timeout=60)
+        assert not t.is_alive()
+        assert results and len(results[0]["data"]["tokens"]) == 30
+    finally:
+        if app._running.is_set():
+            app.stop()
+
+
 def test_chunked_admission_keeps_decode_flowing():
     """A long chunked admission must not stall active decode streams:
     decode blocks interleave between prompt chunks (VERDICT r2 weak #5 —
