@@ -7,11 +7,11 @@ named mesh axes and collectives the compiler can see:
   - The [L, ...]-stacked layer weights shard L over ``pp``
     (sharding.spec_for ``stacked=True``): stage s owns layers
     [s*L/pp, (s+1)*L/pp) as a LOCAL stack — no gathering, ever.
-  - The step runs inside ``jax.shard_map`` MANUAL over pp only
-    (``axis_names={"pp"}``): dp/fsdp/ep/sp/tp stay "auto", so GSPMD
-    keeps partitioning the batch and the per-layer matmuls exactly as
-    in the non-pp step. pp composes with the other axes instead of
-    replacing them (Megatron-style dp x pp x tp).
+  - The step runs inside ``jax.shard_map`` MANUAL over pp (and sp when
+    the mesh has it): dp/fsdp/ep/tp stay "auto", so GSPMD keeps
+    partitioning the batch and the per-layer matmuls exactly as in the
+    non-pp step. pp composes with the other axes instead of replacing
+    them (Megatron-style dp x pp x tp).
   - Microbatches conveyor through stages with ``lax.ppermute``: at tick
     t, stage s works on microbatch t-s; activations AND their lengths
     ride the conveyor (the causal mask travels with its microbatch).
@@ -24,14 +24,21 @@ named mesh axes and collectives the compiler can see:
     so correctness is unconditional; the waste is the standard GPipe
     bubble fraction (pp-1)/(n_micro+pp-1) — raise n_microbatches to
     amortize.
+  - **pp x sp (long-context pipelining)**: with sp > 1 the manual set
+    grows to {pp, sp} and each stage holds only its SEQUENCE SHARD of
+    each microbatch ([mb, S/sp, D] rides the conveyor). Attention runs
+    ``ops.ring_attention.ring_causal_attention`` DIRECTLY — the stage
+    is already manual over sp, so the ring's ppermutes compose with the
+    conveyor's without nesting shard_maps. Tokens stay replicated over
+    sp (ids are cheap); embeddings/logits/loss are computed on the
+    local shard only, and the loss shift across shard boundaries reads
+    its targets from the replicated token ids.
 
 Scope: dense decoders and dense-dispatch MoE (aux loss collected
-exactly across stages — see make_pp_loss_fn). pp with sp>1 ring
-attention is rejected — ring's own collective runs over sp inside the
-stage and has not been validated under a manual-pp trace; pp with
-grouped MoE dispatch is rejected (XLA partitioner limitation). Serving
-meshes keep pp=1 (decode wants every layer resident; pipelining decode
-trades latency for nothing at batch-1 token cadence).
+exactly across stages — see make_pp_loss_fn). pp with grouped MoE
+dispatch is rejected (XLA partitioner limitation — dense dispatch
+works). Serving meshes keep pp=1 (decode wants every layer resident;
+pipelining decode trades latency for nothing at batch-1 token cadence).
 """
 
 from __future__ import annotations
@@ -44,16 +51,13 @@ from jax.sharding import PartitionSpec as P
 
 from ..models import llama
 from ..models.common import ModelConfig
-from .mesh import AXIS_PP, Mesh
+from .mesh import AXIS_PP, AXIS_SP, Mesh
 from .train import loss_parts
 
 
 def _stage_apply(layers_local: Any, x: jnp.ndarray, cfg: ModelConfig,
-                 cos, sin, positions, valid) -> jnp.ndarray:
-    """Run this stage's local layer stack over one microbatch."""
-
-    def attend(q, k, v):
-        return llama.causal_attention(q, k, v, mask=valid)
+                 cos, sin, positions, valid, attend) -> jnp.ndarray:
+    """Run this stage's local layer stack over one microbatch (shard)."""
 
     def body(x, layer_w):
         x, _, probs = llama._layer(x, layer_w, cfg, cos, sin, positions,
@@ -65,24 +69,43 @@ def _stage_apply(layers_local: Any, x: jnp.ndarray, cfg: ModelConfig,
     return x, probs
 
 
+def _local_loss_parts(logits, toks_full, lens, g0, S):
+    """loss_parts on a SEQUENCE SHARD: ``logits`` [mb, Sn, V] sits at
+    global positions [g0, g0+Sn); targets come from the replicated full
+    token ids, so the next-token shift crosses shard boundaries exactly.
+    Summing these parts over sp shards (psum) reproduces the global
+    loss_parts — same additive-form contract as the pp conveyor."""
+    mb, sn, _ = logits.shape
+    tgt_i = g0 + jnp.arange(sn, dtype=jnp.int32) + 1          # [Sn] global
+    safe = jnp.minimum(tgt_i, S - 1)
+    tgt = jnp.take_along_axis(toks_full, jnp.broadcast_to(safe, (mb, sn)),
+                              axis=1)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, tgt[..., None].astype(jnp.int32),
+                               axis=-1)[..., 0]
+    mask = ((tgt_i[None, :] < lens[:, None])
+            & (tgt_i[None, :] <= S - 1)).astype(jnp.float32)
+    return jnp.sum(nll * mask), jnp.sum(mask)
+
+
 def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *, n_microbatches: int,
                     remat: bool = True, moe_aux_weight: float = 0.01):
     """loss_fn(params, tokens [B,S], lengths [B]) -> (loss, aux) running
-    the forward as a pp-stage conveyor. Differentiable; use under
-    jax.value_and_grad exactly like the dense loss_fn.
+    the forward as a pp-stage conveyor (sequence-sharded over sp when
+    the mesh has it). Differentiable; use under jax.value_and_grad
+    exactly like the dense loss_fn.
 
     MoE aux collection under pp: each stage accumulates per-local-layer
     [E] vectors of top-1 counts and router-probability sums over the
     microbatches it actually processed (bubble ticks weighted 0), the
-    balance term sums over local layers, and one psum over pp rebuilds
-    train.load_balance_loss EXACTLY — the nonlinear f·P product is formed
-    per layer AFTER accumulation, never across partial batches."""
+    balance term sums over local layers, and one psum over pp (and sp)
+    rebuilds train.load_balance_loss EXACTLY — the nonlinear f·P product
+    is formed per layer AFTER accumulation, never across partial
+    batches or shards."""
     pp = mesh.shape[AXIS_PP]
+    n_sp = mesh.shape.get(AXIS_SP, 1)
     if cfg.n_layers % pp:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
-    if mesh.shape.get("sp", 1) > 1:
-        raise ValueError("pp + sp (ring attention) is not supported yet; "
-                         "use pp with dp/fsdp/ep/tp")
     if cfg.n_experts > 0 and cfg.moe_capacity_factor > 0:
         # XLA's SPMD partitioner CHECK-crashes (spmd_partitioner_util.cc
         # replica-group mismatch) partitioning the grouped-dispatch
@@ -101,21 +124,40 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *, n_microbatches: int,
         if B % n_micro:
             raise ValueError(f"batch {B} not divisible by "
                              f"n_microbatches={n_micro}")
+        if S % n_sp:
+            raise ValueError(f"sequence {S} not divisible by sp={n_sp}")
         mb = B // n_micro
+        sn = S // n_sp
         cos, sin = llama.get_rope_tables(cfg, S)
-        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (mb, S))
+        if n_sp > 1:
+            g0 = jax.lax.axis_index(AXIS_SP) * sn
+        else:
+            g0 = jnp.int32(0)
+        positions = jnp.broadcast_to(
+            g0 + jnp.arange(sn, dtype=jnp.int32), (mb, sn))
 
-        # every stage embeds (embedding is replicated over pp; computing
-        # it everywhere beats a conveyor warm-up special case)
-        x_all = params["embedding"][tokens].astype(cfg.jdtype)
-        xs = x_all.reshape(n_micro, mb, S, -1)
+        # every stage embeds ITS shard (embedding + token ids replicate
+        # over pp/sp; slicing before the embedding lookup keeps the
+        # [*, Sn, D] activations — the memory that matters — sharded)
+        toks_local = jax.lax.dynamic_slice_in_dim(tokens, g0, sn, axis=1)
+        x_all = params["embedding"][toks_local].astype(cfg.jdtype)
+        xs = x_all.reshape(n_micro, mb, sn, -1)
         toks_mb = tokens.reshape(n_micro, mb, S)
         lens_mb = lengths.reshape(n_micro, mb)
 
         def tick_compute(layers_local, x_in, lens_in):
             valid = positions < lens_in[:, None]
+            if n_sp > 1:
+                from ..ops.ring_attention import ring_causal_attention
+
+                def attend(q, k, v):
+                    return ring_causal_attention(q, k, v, lens_in,
+                                                 axis_name=AXIS_SP)
+            else:
+                def attend(q, k, v):
+                    return llama.causal_attention(q, k, v, mask=valid)
             return _stage_apply(layers_local, x_in, cfg, cos, sin,
-                                positions, valid)
+                                positions, valid, attend)
 
         if remat:
             tick_compute = jax.checkpoint(tick_compute)
@@ -144,7 +186,7 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *, n_microbatches: int,
                 vmask = (positions < lens_in[:, None]
                          ).astype(jnp.float32)[None, ..., None]
                 top1 = jax.nn.one_hot(jnp.argmax(probs, axis=-1),
-                                      cfg.n_experts)  # [l, mb, S, E]
+                                      cfg.n_experts)  # [l, mb, Sn, E]
                 cnt_sum = cnt_sum + in_range * jnp.sum(
                     top1 * vmask, axis=(1, 2))
                 prob_sum = prob_sum + in_range * jnp.sum(
@@ -152,15 +194,20 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *, n_microbatches: int,
             j_out = t - last               # microbatch draining at the
             if 0 <= j_out < n_micro:       # last stage this tick (static)
                 logits = llama._logits(params, cfg, y)  # final_norm inside
-                n, m = loss_parts(logits, toks_mb[j_out], lens_in)
+                n, m = _local_loss_parts(logits, toks_mb[j_out], lens_in,
+                                         g0, S)
                 on_last = (stage == last).astype(jnp.float32)
                 nll_sum = nll_sum + n * on_last
                 mask_sum = mask_sum + m * on_last
             state_x = jax.lax.ppermute(y, AXIS_PP, perm)
             state_len = jax.lax.ppermute(lens_in, AXIS_PP, perm)
-        # only the last stage accumulated: psum publishes to all stages
+        # only the last stage accumulated; sp shards each hold partial
+        # sums: psum over both manual axes publishes the global scalars
         nll_sum = jax.lax.psum(nll_sum, AXIS_PP)
         mask_sum = jax.lax.psum(mask_sum, AXIS_PP)
+        if n_sp > 1:
+            nll_sum = jax.lax.psum(nll_sum, AXIS_SP)
+            mask_sum = jax.lax.psum(mask_sum, AXIS_SP)
         lm = nll_sum / jnp.maximum(mask_sum, 1.0)
         if not moe:
             return lm, jnp.zeros(())
@@ -168,20 +215,24 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *, n_microbatches: int,
         # shape: E * mean_layers(sum_e f_e P_e) over valid tokens)
         total = jnp.maximum(
             jnp.sum(jnp.minimum(lengths, S).astype(jnp.float32)), 1.0)
-        local = jnp.sum((cnt_sum / total) * (prob_sum / total))
+        cnt_g = jax.lax.psum(cnt_sum, AXIS_SP) if n_sp > 1 else cnt_sum
+        prob_g = jax.lax.psum(prob_sum, AXIS_SP) if n_sp > 1 else prob_sum
+        local = jnp.sum((cnt_g / total) * (prob_g / total))
         aux = cfg.n_experts * jax.lax.psum(local, AXIS_PP) / cfg.n_layers
         return lm + moe_aux_weight * aux, aux
 
     def loss_fn(params, tokens, lengths):
-        # manual over pp only: layer stacks enter stage-local ([L/pp]);
-        # everything else replicates over pp. All other mesh axes stay
-        # auto — GSPMD partitions inside the stages as usual. in_specs
-        # is a prefix pytree: one spec per top-level param entry.
+        # manual over pp (+ sp): layer stacks enter stage-local
+        # ([L/pp]); everything else replicates over the manual axes.
+        # dp/fsdp/ep/tp stay auto — GSPMD partitions inside the stages
+        # as usual. in_specs is a prefix pytree: one spec per top-level
+        # param entry.
         param_specs = {k: (P(AXIS_PP) if k == "layers" else P())
                        for k in params}
+        manual = {AXIS_PP} | ({AXIS_SP} if n_sp > 1 else set())
         fn = jax.shard_map(pp_body, mesh=mesh,
                            in_specs=(param_specs, P(), P()),
-                           out_specs=(P(), P()), axis_names={AXIS_PP},
+                           out_specs=(P(), P()), axis_names=manual,
                            check_vma=False)
         return fn(params, tokens, lengths)
 

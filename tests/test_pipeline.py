@@ -63,6 +63,35 @@ def test_pp_step_learns_and_remat_matches():
     assert losses[-1] < losses[0], losses
 
 
+def test_pp_sp_ring_conveyor_matches_dense_step():
+    """pp x sp: sequence-sharded stages with RING attention inside the
+    conveyor (the ring's ppermutes over sp compose with the conveyor's
+    over pp in one manual shard_map). Loss and grad-norm pinned to the
+    dense single-axis step on identical params/data, ragged lengths
+    crossing shard boundaries."""
+    opt = parallel.default_optimizer(lr=1e-3, warmup=1, total_steps=10)
+    tokens, lengths = _data()
+
+    dense_mesh = parallel.make_mesh(dp=8)
+    state_d = parallel.init_train_state(CFG, jax.random.PRNGKey(0),
+                                        dense_mesh, opt)
+    step_d = parallel.make_train_step(CFG, opt, dense_mesh, remat=False)
+    _, md = step_d(state_d, tokens, lengths)
+
+    mesh = parallel.make_mesh(pp=2, sp=2, dp=2)
+    state = parallel.init_train_state(CFG, jax.random.PRNGKey(0), mesh, opt)
+    step = parallel.make_train_step(CFG, opt, mesh, remat=False,
+                                    n_microbatches=2)
+    state, mp = step(state, tokens, lengths)
+    np.testing.assert_allclose(float(mp["loss"]), float(md["loss"]),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(mp["grad_norm"]), float(md["grad_norm"]),
+                               rtol=1e-4, atol=1e-4)
+    # and it keeps training
+    state, m2 = step(state, tokens, lengths)
+    assert np.isfinite(float(m2["loss"]))
+
+
 def test_pp_composes_with_ep_dense_moe_and_matches_aux():
     """3-axis composition pp x ep x dp on a dense-dispatch MoE: expert
     dim over ep, layer dim over pp, batch over (dp, ep). Loss AND the
@@ -106,10 +135,16 @@ def test_pp_rejects_bad_configs():
     with pytest.raises(ValueError, match="divisible"):
         parallel.make_pp_loss_fn(CFG.with_(n_layers=3), mesh,
                                  n_microbatches=2)
-    # pp + sp ring attention unsupported
+    # sequence not divisible by sp fails at trace time
     sp_mesh = parallel.make_mesh(pp=2, sp=2, dp=2)
-    with pytest.raises(ValueError, match="sp"):
-        parallel.make_pp_loss_fn(CFG, sp_mesh, n_microbatches=2)
+    sp_step = parallel.make_train_step(CFG, opt, sp_mesh, remat=False,
+                                       n_microbatches=2)
+    sp_state = parallel.init_train_state(CFG, jax.random.PRNGKey(0),
+                                         sp_mesh, opt)
+    bad_tokens = jax.random.randint(jax.random.PRNGKey(9), (8, 31), 0,
+                                    CFG.vocab_size)
+    with pytest.raises(ValueError, match="divisible by sp"):
+        sp_step(sp_state, bad_tokens, jnp.full((8,), 31, jnp.int32))
     # pp + grouped MoE dispatch would CHECK-crash XLA's partitioner
     moe_cfg = LLAMA_CONFIGS["tiny-moe"].with_(n_layers=4)
     with pytest.raises(ValueError, match="grouped"):
