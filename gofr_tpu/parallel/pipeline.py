@@ -52,7 +52,7 @@ from jax.sharding import PartitionSpec as P
 from ..models import llama
 from ..models.common import ModelConfig
 from .mesh import AXIS_PP, AXIS_SP, Mesh
-from .train import loss_parts
+from .train import loss_parts_local
 
 
 def _stage_apply(layers_local: Any, x: jnp.ndarray, cfg: ModelConfig,
@@ -67,25 +67,6 @@ def _stage_apply(layers_local: Any, x: jnp.ndarray, cfg: ModelConfig,
 
     x, probs = jax.lax.scan(body, x, layers_local)
     return x, probs
-
-
-def _local_loss_parts(logits, toks_full, lens, g0, S):
-    """loss_parts on a SEQUENCE SHARD: ``logits`` [mb, Sn, V] sits at
-    global positions [g0, g0+Sn); targets come from the replicated full
-    token ids, so the next-token shift crosses shard boundaries exactly.
-    Summing these parts over sp shards (psum) reproduces the global
-    loss_parts — same additive-form contract as the pp conveyor."""
-    mb, sn, _ = logits.shape
-    tgt_i = g0 + jnp.arange(sn, dtype=jnp.int32) + 1          # [Sn] global
-    safe = jnp.minimum(tgt_i, S - 1)
-    tgt = jnp.take_along_axis(toks_full, jnp.broadcast_to(safe, (mb, sn)),
-                              axis=1)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, tgt[..., None].astype(jnp.int32),
-                               axis=-1)[..., 0]
-    mask = ((tgt_i[None, :] < lens[:, None])
-            & (tgt_i[None, :] <= S - 1)).astype(jnp.float32)
-    return jnp.sum(nll * mask), jnp.sum(mask)
 
 
 def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *, n_microbatches: int,
@@ -194,8 +175,8 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *, n_microbatches: int,
             j_out = t - last               # microbatch draining at the
             if 0 <= j_out < n_micro:       # last stage this tick (static)
                 logits = llama._logits(params, cfg, y)  # final_norm inside
-                n, m = _local_loss_parts(logits, toks_mb[j_out], lens_in,
-                                         g0, S)
+                n, m = loss_parts_local(logits, toks_mb[j_out], lens_in,
+                                        g0, S)
                 on_last = (stage == last).astype(jnp.float32)
                 nll_sum = nll_sum + n * on_last
                 mask_sum = mask_sum + m * on_last

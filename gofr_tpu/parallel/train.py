@@ -49,20 +49,36 @@ def default_optimizer(lr: float = 3e-4, *, warmup: int = 100,
     )
 
 
+def loss_parts_local(logits: jnp.ndarray, tokens_full: jnp.ndarray,
+                     lengths: jnp.ndarray, g0, S: int
+                     ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(sum of masked next-token NLL, number of masked positions) for a
+    SEQUENCE SHARD: ``logits`` [B, Sn, V] sits at global positions
+    [g0, g0+Sn) of a length-S sequence whose full token ids are
+    ``tokens_full`` [B, S] — the next-token shift reads cross-boundary
+    targets from the full ids. The ONE definition of the
+    shift/mask/log-softmax math: loss_parts is the g0=0, Sn=S case,
+    next_token_loss its ratio, and the pipeline conveyor psums these
+    parts over microbatches and sp shards into exactly the full mean."""
+    B, sn, _ = logits.shape
+    tgt_i = g0 + jnp.arange(sn, dtype=jnp.int32) + 1          # [Sn] global
+    safe = jnp.minimum(tgt_i, S - 1)
+    tgt = jnp.take_along_axis(tokens_full,
+                              jnp.broadcast_to(safe, (B, sn)), axis=1)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, tgt[..., None].astype(jnp.int32),
+                               axis=-1)[..., 0]               # [B, Sn]
+    mask = ((tgt_i[None, :] < lengths[:, None])
+            & (tgt_i[None, :] <= S - 1)).astype(jnp.float32)
+    return jnp.sum(nll * mask), jnp.sum(mask)
+
+
 def loss_parts(logits: jnp.ndarray, tokens: jnp.ndarray,
                lengths: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """(sum of masked next-token NLL, number of masked positions) — the
-    additive form of the causal-LM loss. The ONE definition of the
-    shift/mask/log-softmax math: next_token_loss is its ratio, and the
-    pipeline conveyor sums these parts over microbatches so pp losses
-    combine into exactly the full-batch mean."""
-    B, S, _ = logits.shape
-    targets = tokens[:, 1:]                       # [B, S-1]
-    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32),
-                               axis=-1)[..., 0]   # [B, S-1]
-    mask = (jnp.arange(1, S)[None, :] < lengths[:, None]).astype(jnp.float32)
-    return jnp.sum(nll * mask), jnp.sum(mask)
+    """Additive causal-LM loss over the full sequence — the unsharded
+    case of loss_parts_local."""
+    return loss_parts_local(logits, tokens, lengths, jnp.int32(0),
+                            logits.shape[1])
 
 
 def next_token_loss(logits: jnp.ndarray, tokens: jnp.ndarray,

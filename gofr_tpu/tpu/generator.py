@@ -218,7 +218,11 @@ class GenerationEngine:
             self._verify_jit = jax.jit(self._verify_fn, donate_argnums=(0,))
             self._spec_windows = 0
             self._spec_emitted = 0
-        self._hist: list[list[int]] = [[] for _ in range(slots)]
+            # per-slot token history as preallocated buffers: _draft
+            # slices VIEWS (no list boxing on the decode loop's
+            # GIL-held critical path); append is one index write
+            self._hist_buf = np.zeros((slots, self.max_seq), np.int32)
+            self._hist_n = np.zeros((slots,), np.int64)
 
         self._pending: queue.Queue[_Request] = queue.Queue()
         self._work = threading.Event()
@@ -397,28 +401,38 @@ class GenerationEngine:
         lengths = stepped.lengths + emit
         return greedy, emit, stepped._replace(lengths=lengths)
 
+    def _hist_set(self, idx: int, tokens) -> None:
+        n = min(len(tokens), self._hist_buf.shape[1])
+        self._hist_buf[idx, :n] = tokens[:n]
+        self._hist_n[idx] = n
+
+    def _hist_append(self, idx: int, token: int) -> None:
+        n = self._hist_n[idx]
+        if n < self._hist_buf.shape[1]:
+            self._hist_buf[idx, n] = token
+            self._hist_n[idx] = n + 1
+
     def _draft(self, idx: int) -> list[int] | None:
         """Prompt-lookup draft: the K tokens that followed the most
         recent earlier occurrence of the history's trailing 2-gram.
-        None = no match (this slot proposes nothing). Vectorized — a
-        Python scan over a 2k-token history per slot per tick would put
-        milliseconds of GIL-held work on the decode loop's critical
-        path at high slot counts."""
-        hist = self._hist[idx]
+        None = no match (this slot proposes nothing). Pure numpy over
+        buffer views — no per-tick list boxing on the decode loop's
+        GIL-held critical path."""
+        n = int(self._hist_n[idx])
         K = self._spec_k
-        if len(hist) < 3:
+        if n < 3:
             return None
-        h = np.asarray(hist, np.int32)
+        h = self._hist_buf[idx, :n]  # view, no copy
         a, b = h[-2], h[-1]
-        # positions j <= len-3 with h[j] == a and h[j+1] == b
+        # positions j <= n-3 with h[j] == a and h[j+1] == b
         hits = np.flatnonzero((h[:-2] == a) & (h[1:-1] == b))
         if len(hits) == 0:
             return None
         j = int(hits[-1])  # most recent earlier occurrence
-        cont = hist[j + 2:j + 2 + K]
-        if not cont:
+        cont = h[j + 2:j + 2 + K]
+        if cont.size == 0:
             return None
-        return cont + [0] * (K - len(cont))
+        return cont.tolist() + [0] * (K - cont.size)
 
     # -- public API ----------------------------------------------------------
     def generate(self, prompt, max_new_tokens: int = 128,
@@ -535,6 +549,18 @@ class GenerationEngine:
                 self.cache, self.params, jnp.asarray(self._last_tokens),
                 jnp.zeros((self.n_slots,), bool), jnp.asarray(self._temps),
                 jnp.asarray(self._top_ks), self._key))
+            if self._spec_k:
+                # the verify program too — its first real tick would
+                # otherwise compile mid-serving under the device lock,
+                # freezing every live stream. All-inactive dispatch:
+                # emit 0, cursors frozen, garbage KV lands beyond
+                # cursors like the step warmup's.
+                window = jnp.zeros((self.n_slots, self._spec_k + 1),
+                                   jnp.int32)
+                _, _, cache_w = self._verify_jit(
+                    self.cache, self.params, window,
+                    jnp.zeros((self.n_slots,), bool), self._key)
+                self.cache = jax.block_until_ready(cache_w)
             # restore cursors dirtied by the dummy dispatches
             self.cache = self.cache._replace(lengths=jnp.asarray(cursors))
 
@@ -586,15 +612,20 @@ class GenerationEngine:
         for idx, slot in enumerate(self._slots):
             if not slot.free:
                 continue
-            try:
-                req = self._pending.get_nowait()
-            except queue.Empty:
-                return
-            if req.stream.cancelled.is_set():
-                req.stream._q.put(None)
-                continue
+            # _admitting goes up BEFORE the pop: between get_nowait and
+            # any later increment a request would be invisible to all of
+            # drain()'s idle conditions (not pending, not active, not
+            # admitting) and a graceful shutdown could kill an accepted
+            # stream. Only this thread mutates the counter.
             self._admitting += 1
             try:
+                try:
+                    req = self._pending.get_nowait()
+                except queue.Empty:
+                    return
+                if req.stream.cancelled.is_set():
+                    req.stream._q.put(None)
+                    continue
                 self._start(idx, slot, req)
             finally:
                 self._admitting -= 1
@@ -709,7 +740,7 @@ class GenerationEngine:
             raise
         self._prefix_store(idx, req)
         if self._spec_k:
-            self._hist[idx] = list(int(t) for t in req.prompt)
+            self._hist_set(idx, req.prompt)
         if self.metrics is not None:
             self.metrics.record_histogram("app_tpu_batch_wait_duration",
                                           t0 - req.enqueued_at, program="generate")
@@ -720,7 +751,7 @@ class GenerationEngine:
         self._temps[idx] = req.temperature
         self._top_ks[idx] = req.top_k
         if self._spec_k:
-            self._hist[idx].append(int(first))
+            self._hist_append(idx, int(first))
         self._deliver(idx, slot, first)
         if slot.request is not None:  # not finished by the first token
             self._last_tokens[idx] = first
@@ -873,7 +904,7 @@ class GenerationEngine:
                     break  # retired mid-window (EOS/budget/cancel)
                 t = int(toks_np[idx, k])
                 self._last_tokens[idx] = t
-                self._hist[idx].append(t)
+                self._hist_append(idx, t)
                 self._deliver(idx, slot, t)
 
     def _decode_tick(self) -> None:
@@ -898,5 +929,5 @@ class GenerationEngine:
                     continue
                 self._last_tokens[idx] = toks_np[k, idx]
                 if self._spec_k:
-                    self._hist[idx].append(int(toks_np[k, idx]))
+                    self._hist_append(idx, int(toks_np[k, idx]))
                 self._deliver(idx, slot, int(toks_np[k, idx]))
