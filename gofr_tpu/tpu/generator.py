@@ -437,7 +437,7 @@ class GenerationEngine:
     # -- public API ----------------------------------------------------------
     def generate(self, prompt, max_new_tokens: int = 128,
                  temperature: float = 0.0, top_k: int = 0,
-                 eos_id: int | None = None) -> GenStream:
+                 eos_id=None) -> GenStream:
         """Enqueue a prompt (sequence of token ids); returns a GenStream
         yielding generated ids as the device produces them.
 
@@ -445,13 +445,20 @@ class GenerationEngine:
         sampling to the k most likely tokens; k is CAPPED at
         TOP_K_MAX (64) — the compiled step extracts a fixed top set
         once and masks within it, so larger requested k silently
-        saturates to 64 rather than widening the distribution."""
+        saturates to 64 rather than widening the distribution.
+
+        ``eos_id``: a single stop token id, or any iterable of them
+        (OpenAI-style ``stop`` sets) — the stream ends at (and includes)
+        the first generated token in the set. Checked host-side per
+        delivered token; never a compile key."""
         if self._closed:
             raise GenerationError("generation engine is closed")
         if self._draining:
             raise GenerationError("generation engine is draining")
         if self.down is not None:
             raise GenerationError(f"generation engine is down: {self.down}")
+        if eos_id is not None and not isinstance(eos_id, int):
+            eos_id = frozenset(int(t) for t in eos_id) or None
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         stream = GenStream(next(_REQ_IDS), self)
         stream.prompt_len = len(prompt)
@@ -769,7 +776,9 @@ class GenerationEngine:
         self.total_tokens += 1
         if self.metrics is not None:
             self.metrics.increment_counter("app_tpu_tokens_generated_total")
-        at_eos = req.eos_id is not None and token == req.eos_id
+        at_eos = req.eos_id is not None and (
+            token in req.eos_id if isinstance(req.eos_id, frozenset)
+            else token == req.eos_id)
         # cursor positions used so far: prompt_len + generated
         at_capacity = req.stream.prompt_len + slot.generated >= self.max_seq - 1
         if at_eos or slot.remaining <= 0 or at_capacity:

@@ -190,6 +190,18 @@ def test_concurrent_generation_isolated_and_continuous(gen_engine, tiny_llama):
     assert gen_engine.stats()["total_requests"] == 6
 
 
+def test_generation_eos_set(gen_engine):
+    """eos_id accepts an iterable (OpenAI-style stop sets): the stream
+    ends at the FIRST generated token in the set."""
+    base = gen_engine.generate([5, 17, 42, 7], max_new_tokens=6).tokens()
+    stop = base[2]
+    first = base.index(stop)  # greedy may loop: stop at FIRST occurrence
+    unused = next(t for t in range(TINY.vocab_size) if t not in base)
+    got = gen_engine.generate([5, 17, 42, 7], max_new_tokens=50,
+                              eos_id={stop, unused}).tokens()
+    assert got == base[:first + 1]
+
+
 def test_generation_eos_and_limits(gen_engine):
     # eos: whatever token greedy emits first, use it as eos -> length 1
     first = gen_engine.generate([5, 17, 42, 7], max_new_tokens=4).tokens()[0]
