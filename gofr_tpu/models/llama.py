@@ -124,6 +124,52 @@ def init(cfg: ModelConfig, key) -> dict:
     return params
 
 
+LORA_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+def init_lora(cfg: ModelConfig, n_adapters: int, rank: int, key) -> dict:
+    """Stacked multi-LoRA leaves for the attention projections: per
+    target, A [L, n_adapters, in, r] (kaiming-ish) and B
+    [L, n_adapters, r, out] (ZEROS — the standard LoRA init, so every
+    adapter starts as an exact no-op and adapter 0 conventionally stays
+    that way: the base model). Merge the returned dict into
+    params["layers"]; the layer scan slices the adapter stacks alongside
+    the base weights and _lora() gathers each batch row's adapter —
+    multi-tenant serving over ONE shared weight stream, a few rank-r
+    GEMMs per layer of extra compute."""
+    dt = cfg.jdtype
+    L, D, H, KV, hd = (cfg.n_layers, cfg.dim, cfg.n_heads,
+                       cfg.n_kv_heads, cfg.head_dim)
+    dims = {"wq": (D, H * hd), "wk": (D, KV * hd),
+            "wv": (D, KV * hd), "wo": (H * hd, D)}
+    keys = jax.random.split(key, len(LORA_TARGETS))
+    out = {}
+    for k, name in zip(keys, LORA_TARGETS):
+        din, dout = dims[name]
+        out[f"lora_a_{name}"] = (jax.random.normal(
+            k, (L, n_adapters, din, rank)) * din ** -0.5).astype(dt)
+        out[f"lora_b_{name}"] = jnp.zeros((L, n_adapters, rank, dout), dt)
+    return out
+
+
+def merge_lora(params: dict, cfg: ModelConfig, adapter: int) -> dict:
+    """Fold ONE adapter into dense base weights (W + A_i @ B_i) and drop
+    the adapter stacks — the single-tenant deployment path, and the
+    oracle the multi-LoRA tests pin the gathered path against. Requires
+    unquantized base weights."""
+    layers = dict(params["layers"])
+    for name in LORA_TARGETS:
+        a = layers.pop(f"lora_a_{name}", None)
+        b = layers.pop(f"lora_b_{name}", None)
+        if a is None:
+            continue
+        delta = jnp.einsum("ldr,lro->ldo", a[:, adapter].astype(jnp.float32),
+                           b[:, adapter].astype(jnp.float32))
+        layers[name] = (layers[name].astype(jnp.float32)
+                        + delta).astype(layers[name].dtype)
+    return {**params, "layers": layers}
+
+
 def _expert_mm(h, w, pattern: str, scale_expand=(None, None)):
     """Per-expert einsum that consumes int8 QuantizedLinear expert stacks
     ([E, in, out] int8 + [E, out] scale) the same way ops.quant.qmatmul
@@ -251,24 +297,44 @@ def _moe_ffn(h, layer_w, cfg: ModelConfig, valid=None):
                        combine.astype(out.dtype)), probs)
 
 
+def _lora(h, layer_w, name: str, adapter):
+    """Per-row LoRA delta for projection ``name``: h @ A[adapter[b]] @
+    B[adapter[b]] — rank-r bottleneck, a few extra GEMMs of width r per
+    layer. Zero when the params carry no adapter stacks or the caller
+    passed no adapter ids. Adapter 0 is the no-op base by convention
+    (init_lora zeros every B matrix, the standard LoRA init)."""
+    a = layer_w.get(f"lora_a_{name}")
+    if a is None or adapter is None:
+        return 0
+    b = layer_w[f"lora_b_{name}"]
+    ha = jnp.einsum("bsd,bdr->bsr", h, a[adapter].astype(h.dtype))
+    return jnp.einsum("bsr,bro->bso", ha, b[adapter].astype(h.dtype))
+
+
 def _layer(x, layer_w, cfg: ModelConfig, cos, sin, positions,
-           kv_write, attend, valid=None):
+           kv_write, attend, valid=None, adapter=None):
     """One transformer block. ``kv_write(k_new, v_new) -> (k_all, v_all)``
     handles cache interaction; ``attend(q, k, v)`` runs attention.
+    ``adapter`` [B] int32 selects each row's LoRA adapter when the
+    params carry adapter stacks (multi-LoRA serving).
     Returns (x_out, (k_stored, v_stored))."""
     B, S = x.shape[0], x.shape[1]
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     h = rms_norm(x, layer_w["attn_norm"], cfg.norm_eps)
-    q = qmatmul(h, layer_w["wq"]).reshape(B, S, H, hd)
-    k = qmatmul(h, layer_w["wk"]).reshape(B, S, KV, hd)
-    v = qmatmul(h, layer_w["wv"]).reshape(B, S, KV, hd)
+    q = (qmatmul(h, layer_w["wq"])
+         + _lora(h, layer_w, "wq", adapter)).reshape(B, S, H, hd)
+    k = (qmatmul(h, layer_w["wk"])
+         + _lora(h, layer_w, "wk", adapter)).reshape(B, S, KV, hd)
+    v = (qmatmul(h, layer_w["wv"])
+         + _lora(h, layer_w, "wv", adapter)).reshape(B, S, KV, hd)
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
 
     k_all, v_all = kv_write(k, v)
     attn = attend(q, k_all, v_all).reshape(B, S, H * hd)
-    x = x + qmatmul(attn, layer_w["wo"])
+    x = x + qmatmul(attn, layer_w["wo"]) + _lora(attn, layer_w, "wo",
+                                                 adapter)
 
     h = rms_norm(x, layer_w["ffn_norm"], cfg.norm_eps)
     router_probs = None
@@ -292,7 +358,8 @@ def _logits(params, cfg: ModelConfig, x):
 def _causal_scan(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                  lengths: jnp.ndarray | None, rope_max: int, rope_tables,
                  constrain, collect_kv: bool, flash: bool = False,
-                 attend_override=None, collect_router: bool = False):
+                 attend_override=None, collect_router: bool = False,
+                 adapter=None):
     """Shared causal body for forward/prefill: embed, mask, scan layers.
 
     Returns (x [B,S,D], kv  — stacked [L,B,S,KV,hd] pair when
@@ -336,7 +403,7 @@ def _causal_scan(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     def body(x, layer_w):
         x, kv, probs = _layer(x, layer_w, cfg, cos, sin, positions,
                               kv_write=lambda k, v: (k, v), attend=attend,
-                              valid=valid)
+                              valid=valid, adapter=adapter)
         # Training drops the per-layer k/v so the scan never materializes
         # the [L,B,S,KV,hd] stacks it would otherwise carry.
         return constrain(x), (kv if collect_kv else None,
@@ -349,7 +416,7 @@ def _causal_scan(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
 def forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
             lengths: jnp.ndarray | None = None, rope_tables=None,
             constrain=None, attend_override=None,
-            return_router_probs: bool = False):
+            return_router_probs: bool = False, adapter=None):
     """Cache-free causal forward over [B, S] tokens -> [B, S, V] f32 logits.
     The training/scoring path: no KV-cache allocation or writes.
     ``attend_override``: see _causal_scan (ring attention hook).
@@ -360,7 +427,8 @@ def forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                                   tokens.shape[1], rope_tables, constrain,
                                   collect_kv=False,
                                   attend_override=attend_override,
-                                  collect_router=return_router_probs)
+                                  collect_router=return_router_probs,
+                                  adapter=adapter)
     logits = _logits(params, cfg, x)
     if return_router_probs:
         return logits, probs
@@ -369,7 +437,8 @@ def forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
 
 def prefill(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
             cache: KVCache, lengths: jnp.ndarray | None = None,
-            rope_tables=None, flash: bool = False) -> tuple[jnp.ndarray, KVCache]:
+            rope_tables=None, flash: bool = False,
+            adapter=None) -> tuple[jnp.ndarray, KVCache]:
     """Process prompts [B, S] (right-padded), fill the cache.
 
     ``lengths`` [B]: true prompt lengths (defaults to full S).
@@ -381,7 +450,7 @@ def prefill(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     S = tokens.shape[1]
     x, (k_stack, v_stack), lengths, _ = _causal_scan(
         params, cfg, tokens, lengths, cache.k.shape[2], rope_tables,
-        constrain=None, collect_kv=True, flash=flash)
+        constrain=None, collect_kv=True, flash=flash, adapter=adapter)
     # k_stack: [L, B, S, KV, hd] -> write into the cache's first S slots
     if S > cache.k.shape[2]:
         raise ValueError(f"prompt length {S} exceeds cache capacity {cache.k.shape[2]}")
@@ -413,7 +482,7 @@ def write_kv(cache: KVCache, k_stack, v_stack, index5, lengths) -> KVCache:
 
 def prefill_kv(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                lengths: jnp.ndarray | None = None, rope_max: int | None = None,
-               rope_tables=None, flash: bool = False):
+               rope_tables=None, flash: bool = False, adapter=None):
     """Causal forward returning the raw KV stacks instead of a filled cache.
 
     The continuous-batching serving engine prefills ONE sequence at a time
@@ -426,13 +495,14 @@ def prefill_kv(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     """
     x, (k_stack, v_stack), lengths, _ = _causal_scan(
         params, cfg, tokens, lengths, rope_max or tokens.shape[1],
-        rope_tables, constrain=None, collect_kv=True, flash=flash)
+        rope_tables, constrain=None, collect_kv=True, flash=flash,
+        adapter=adapter)
     return _logits(params, cfg, x), k_stack, v_stack, lengths
 
 
 def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                   cache: KVCache, start, rope_tables=None,
-                  compute_logits: bool = True):
+                  compute_logits: bool = True, adapter=None):
     """Process a chunk of C prompt tokens at positions [start, start+C)
     against the growing cache — the long-prompt path (chunked prefill):
     prompts of any length up to cache capacity run as a sequence of
@@ -464,7 +534,8 @@ def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                                    ks_layer, vs_layer)
 
         x, kv, _ = _layer(x, layer_w, cfg, cos, sin, positions,
-                          kv_write=lambda k, v: (k, v), attend=attend)
+                          kv_write=lambda k, v: (k, v), attend=attend,
+                          adapter=adapter)
         return x, kv
 
     x, (k_chunk, v_chunk) = jax.lax.scan(
@@ -477,7 +548,8 @@ def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
 
 
 def verify_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
-                cache: KVCache, rope_tables=None) -> tuple[jnp.ndarray, KVCache]:
+                cache: KVCache, rope_tables=None,
+                adapter=None) -> tuple[jnp.ndarray, KVCache]:
     """Multi-token verify pass — speculative decoding's target forward.
 
     ``tokens`` [B, W]: column 0 is each slot's pending last sampled
@@ -517,7 +589,8 @@ def verify_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                                              vs_layer)
 
         x, kv, _ = _layer(x, layer_w, cfg, cos, sin, positions,
-                          kv_write=lambda k, v: (k, v), attend=attend)
+                          kv_write=lambda k, v: (k, v), attend=attend,
+                          adapter=adapter)
         return x, kv
 
     x, (k_w, v_w) = jax.lax.scan(
@@ -561,8 +634,8 @@ def multi_request_serving_config(cfg: ModelConfig) -> ModelConfig:
 
 
 def decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
-                cache: KVCache, rope_tables=None,
-                flash: bool = False) -> tuple[jnp.ndarray, KVCache]:
+                cache: KVCache, rope_tables=None, flash: bool = False,
+                adapter=None) -> tuple[jnp.ndarray, KVCache]:
     """One decode step for tokens [B] against the cache.
 
     Returns (logits [B, V] f32, updated cache with lengths+1).
@@ -610,7 +683,8 @@ def decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                                 lengths, ks_layer, vs_layer)
 
         x, kv_tok, _ = _layer(x, layer_w, cfg, cos, sin, positions,
-                              kv_write=lambda k, v: (k, v), attend=attend)
+                              kv_write=lambda k, v: (k, v), attend=attend,
+                              adapter=adapter)
         return x, kv_tok
 
     x, (k_toks, v_toks) = jax.lax.scan(

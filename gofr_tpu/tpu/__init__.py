@@ -41,6 +41,11 @@ Config keys (reference config style, pkg/gofr/config/config.go:3):
                       weight stream emits 1..K+1 tokens per greedy slot
                       when its history's trailing n-gram repeats;
                       single-device engines only
+  TPU_LORA_ADAPTERS   multi-LoRA serving: adapter slots (default 0 =
+                      off; slot 0 is the base no-op). Per-request
+                      selection via generate(adapter=i); install
+                      weights with engine.generator.load_adapter
+  TPU_LORA_RANK       LoRA bottleneck rank (default 16)
   TPU_BATCH_BUCKETS   csv of predict batch buckets (default 1,2,4,8)
   TPU_SEQ_BUCKETS     csv of token-length buckets  (default 32..512)
   TPU_MAX_BATCH_DELAY coalescing window in seconds (default 0.004)
@@ -162,7 +167,9 @@ def new_engine_from_config(cfg, logger=None, metrics=None) -> TPUEngine:
             admit_window_ms=cfg.get_float("TPU_ADMIT_WINDOW_MS", 2.0),
             prefix_cache_slots=cfg.get_int("TPU_PREFIX_CACHE", 0),
             prefix_store_min=cfg.get_int("TPU_PREFIX_MIN", 0) or None,
-            spec_decode_k=cfg.get_int("TPU_SPEC_DECODE", 0))
+            spec_decode_k=cfg.get_int("TPU_SPEC_DECODE", 0),
+            lora_adapters=cfg.get_int("TPU_LORA_ADAPTERS", 0),
+            lora_rank=cfg.get_int("TPU_LORA_RANK", 16))
 
         # scoring program: next-token logits at the prompt end (the
         # non-streaming sibling of generate, e.g. for classification

@@ -96,16 +96,18 @@ class GenStream:
 
 class _Request:
     __slots__ = ("stream", "prompt", "max_new", "temperature", "top_k",
-                 "eos_id", "enqueued_at")
+                 "eos_id", "adapter", "enqueued_at")
 
     def __init__(self, stream: GenStream, prompt: np.ndarray, max_new: int,
-                 temperature: float, top_k: int, eos_id: int | None):
+                 temperature: float, top_k: int, eos_id: int | None,
+                 adapter: int = 0):
         self.stream = stream
         self.prompt = prompt
         self.max_new = max_new
         self.temperature = temperature
         self.top_k = top_k
         self.eos_id = eos_id
+        self.adapter = adapter
         self.enqueued_at = time.monotonic()
 
 
@@ -131,10 +133,30 @@ class GenerationEngine:
                  admit_window_ms: float = 2.0,
                  prefix_cache_slots: int = 0,
                  prefix_store_min: int | None = None,
-                 spec_decode_k: int = 0):
+                 spec_decode_k: int = 0,
+                 lora_adapters: int = 0, lora_rank: int = 16):
         self.cfg = cfg
         self.params = params
         self.n_slots = slots
+        # Multi-LoRA serving: n adapter slots of rank-r deltas on the
+        # attention projections, stacked inside params["layers"] so the
+        # layer scan slices them with the base weights; each request
+        # picks its adapter (generate(adapter=i)) and every program
+        # gathers per-row — multi-tenant fine-tunes over ONE shared
+        # weight stream. Adapter 0 is the base no-op (B initialized
+        # zero); fill others via load_adapter()/checkpoints.
+        self._n_adapters = max(0, int(lora_adapters))
+        if self._n_adapters:
+            if mesh is not None:
+                raise ValueError("lora_adapters requires a single-device "
+                                 "engine (mesh=None)")
+            if "lora_a_wq" not in params["layers"]:
+                self.params = {**params, "layers": {
+                    **params["layers"],
+                    **llama.init_lora(cfg, self._n_adapters,
+                                      int(lora_rank),
+                                      jax.random.PRNGKey(seed + 1))}}
+        self._slot_adapter = np.zeros((slots,), np.int32)
         # K decode steps fused into one dispatch (lax.scan on device): the
         # host sees K tokens per roundtrip instead of one, amortizing
         # dispatch/tunnel latency K-fold. Cost: a finished stream wastes at
@@ -301,7 +323,7 @@ class GenerationEngine:
         return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
     def _prefill_fn(self, cache, params, tokens, length, slot, temp,
-                    top_k, key):
+                    top_k, key, adapter=None):
         """tokens [1, Sb] (padded), length/slot scalars. Writes the slot's
         KV, sets its cursor, returns (first_token scalar, cache)."""
         # flash prefill only off-mesh: a Pallas call inside a GSPMD-sharded
@@ -310,7 +332,7 @@ class GenerationEngine:
         logits, k, v, _ = llama.prefill_kv(
             params, self.cfg, tokens, jnp.asarray([length]),
             rope_max=self.max_seq, rope_tables=self.rope_tables,
-            flash=self.mesh is None)
+            flash=self.mesh is None, adapter=adapter)
         lengths = cache.lengths.at[slot].set(length)
         cache = llama.write_kv(cache, k, v, (0, slot, 0, 0, 0), lengths)
         last = jnp.take(logits[0], length - 1, axis=0)  # [V] at the true end
@@ -318,7 +340,7 @@ class GenerationEngine:
         return tok, cache
 
     def _chunk_fn(self, cache, params, tokens, start, slot, total_len,
-                  pos_in_chunk, temp, top_k, key, sample: bool):
+                  pos_in_chunk, temp, top_k, key, adapter, sample: bool):
         """Chunked prefill for prompts longer than the largest bucket:
         slice the slot's cache view, run one chunk against it, write back.
         The final chunk (``sample=True``) also sets the slot's cursor to
@@ -338,7 +360,8 @@ class GenerationEngine:
             slot_view(cache.v_scale, False) if quant else None)
         logits, small = llama.prefill_chunk(
             params, self.cfg, tokens, small, start,
-            rope_tables=self.rope_tables, compute_logits=sample)
+            rope_tables=self.rope_tables, compute_logits=sample,
+            adapter=adapter)
         k_new = jax.lax.dynamic_update_slice(cache.k, small.k, (0, slot, 0, 0, 0))
         v_new = jax.lax.dynamic_update_slice(cache.v, small.v, (0, slot, 0, 0, 0))
         ks, vs = cache.k_scale, cache.v_scale
@@ -360,7 +383,7 @@ class GenerationEngine:
         return tok, llama.KVCache(k_new, v_new, lengths, ks, vs)
 
     def _step_fn(self, cache, params, last_tokens, active, temps, top_ks,
-                 key):
+                 key, adapter=None):
         """K fused decode steps over all slots (K = decode_block); one
         dispatch returns [K, B] tokens. Each step feeds its sampled token
         to the next on device — the host is off the per-token critical
@@ -373,7 +396,8 @@ class GenerationEngine:
             tokens, cache = carry
             logits, stepped = llama.decode_step(
                 params, self.cfg, tokens, cache,
-                rope_tables=self.rope_tables, flash=self._flash_decode)
+                rope_tables=self.rope_tables, flash=self._flash_decode,
+                adapter=adapter)
             lengths = jnp.where(active, stepped.lengths, cache.lengths)
             stepped = stepped._replace(lengths=lengths)
             toks = self._sample(logits, temps, step_key, top_ks)
@@ -383,7 +407,7 @@ class GenerationEngine:
         (_, cache), toks = jax.lax.scan(body, (last_tokens, cache), keys)
         return toks, cache
 
-    def _verify_fn(self, cache, params, window, active, key):
+    def _verify_fn(self, cache, params, window, active, key, adapter=None):
         """One speculative verify pass. ``window`` [B, W]: col 0 = each
         slot's pending last token, cols 1.. = prompt-lookup drafts.
         Greedy-only (callers route sampling slots to the decode path).
@@ -393,7 +417,8 @@ class GenerationEngine:
         the signature matches _step_fn's calling convention."""
         logits, stepped = llama.verify_step(params, self.cfg, window,
                                             cache,
-                                            rope_tables=self.rope_tables)
+                                            rope_tables=self.rope_tables,
+                                            adapter=adapter)
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, W]
         agree = (greedy[:, :-1] == window[:, 1:]).astype(jnp.int32)
         accept = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)     # [B]
@@ -437,7 +462,7 @@ class GenerationEngine:
     # -- public API ----------------------------------------------------------
     def generate(self, prompt, max_new_tokens: int = 128,
                  temperature: float = 0.0, top_k: int = 0,
-                 eos_id=None) -> GenStream:
+                 eos_id=None, adapter: int = 0) -> GenStream:
         """Enqueue a prompt (sequence of token ids); returns a GenStream
         yielding generated ids as the device produces them.
 
@@ -459,6 +484,10 @@ class GenerationEngine:
             raise GenerationError(f"generation engine is down: {self.down}")
         if eos_id is not None and not isinstance(eos_id, int):
             eos_id = frozenset(int(t) for t in eos_id) or None
+        if adapter and not 0 <= adapter < max(self._n_adapters, 1):
+            raise GenerationError(
+                f"adapter {adapter} out of range (engine has "
+                f"{self._n_adapters} LoRA adapter slots)")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         stream = GenStream(next(_REQ_IDS), self)
         stream.prompt_len = len(prompt)
@@ -479,7 +508,8 @@ class GenerationEngine:
             if self._closed:
                 raise GenerationError("generation engine is closed")
             self._pending.put(_Request(stream, prompt, max_new_tokens,
-                                       temperature, top_k, eos_id))
+                                       temperature, top_k, eos_id,
+                                       adapter=int(adapter)))
         self._work.set()
         return stream
 
@@ -498,6 +528,10 @@ class GenerationEngine:
         }
         if self._prefix_idx is not None:
             out["prefix_cache"] = self._prefix_idx.stats()
+        if self._n_adapters:
+            out["lora"] = {"adapters": self._n_adapters,
+                           "rank": int(self.params["layers"]
+                                       ["lora_a_wq"].shape[-1])}
         if self._spec_k:
             out["spec_decode"] = {
                 "k": self._spec_k,
@@ -534,7 +568,7 @@ class GenerationEngine:
                     _, self.cache = jax.block_until_ready(self._prefill_jit(
                         self.cache, self.params, toks, jnp.int32(1),
                         jnp.int32(free), jnp.float32(0.0), jnp.int32(0),
-                        self._key))
+                        self._key, self._adapter1(None)))
                     if chunked_reachable:
                         # chunked-admission lattice: the final chunk
                         # compiles per bucket, mid chunks only at C
@@ -542,20 +576,22 @@ class GenerationEngine:
                             self._chunk_final_jit(
                                 self.cache, self.params, toks, jnp.int32(0),
                                 jnp.int32(free), jnp.int32(1), jnp.int32(0),
-                                jnp.float32(0.0), jnp.int32(0), self._key))
+                                jnp.float32(0.0), jnp.int32(0), self._key,
+                                self._adapter1(None)))
                 if chunked_reachable:
                     toks = jnp.zeros((1, C), jnp.int32)
                     self.cache = jax.block_until_ready(self._chunk_mid_jit(
                         self.cache, self.params, toks, jnp.int32(0),
                         jnp.int32(free), jnp.int32(0), jnp.int32(0),
-                        jnp.float32(0.0), jnp.int32(0), self._key))
+                        jnp.float32(0.0), jnp.int32(0), self._key,
+                        self._adapter1(None)))
             elif self.logger is not None:
                 self.logger.debug({"event": "generator warmup skipped prefill",
                                    "reason": "no free slot"})
             _, self.cache = jax.block_until_ready(self._step_jit(
                 self.cache, self.params, jnp.asarray(self._last_tokens),
                 jnp.zeros((self.n_slots,), bool), jnp.asarray(self._temps),
-                jnp.asarray(self._top_ks), self._key))
+                jnp.asarray(self._top_ks), self._key, self._adapters()))
             if self._spec_k:
                 # the verify program too — its first real tick would
                 # otherwise compile mid-serving under the device lock,
@@ -566,10 +602,36 @@ class GenerationEngine:
                                    jnp.int32)
                 _, _, cache_w = self._verify_jit(
                     self.cache, self.params, window,
-                    jnp.zeros((self.n_slots,), bool), self._key)
+                    jnp.zeros((self.n_slots,), bool), self._key,
+                    self._adapters())
                 self.cache = jax.block_until_ready(cache_w)
             # restore cursors dirtied by the dummy dispatches
             self.cache = self.cache._replace(lengths=jnp.asarray(cursors))
+
+    def load_adapter(self, idx: int, tree: dict) -> None:
+        """Install adapter weights into slot ``idx``: ``tree`` maps a
+        projection name ('wq'/'wk'/'wv'/'wo') to its (A [L, in, r],
+        B [L, r, out]) pair — the layout LoRA training produces per
+        layer. Safe while serving: the swap happens under the device
+        lock between iterations; params are never donated, so in-flight
+        dispatches keep their snapshot."""
+        if not self._n_adapters:
+            raise GenerationError("engine built without lora_adapters")
+        if not 0 < idx < self._n_adapters:
+            raise GenerationError(
+                f"adapter slot {idx} invalid (1..{self._n_adapters - 1}; "
+                "slot 0 is the base no-op)")
+        with self._device_lock:
+            layers = dict(self.params["layers"])
+            for name, (a, b) in tree.items():
+                ka, kb = f"lora_a_{name}", f"lora_b_{name}"
+                if ka not in layers:
+                    raise GenerationError(f"unknown LoRA target {name!r}")
+                layers[ka] = layers[ka].at[:, idx].set(
+                    jnp.asarray(a, layers[ka].dtype))
+                layers[kb] = layers[kb].at[:, idx].set(
+                    jnp.asarray(b, layers[kb].dtype))
+            self.params = {**self.params, "layers": layers}
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Graceful shutdown, phase 1: refuse NEW requests (generate()
@@ -611,6 +673,17 @@ class GenerationEngine:
             req.stream._q.put(None)
 
     # -- the serving loop ----------------------------------------------------
+    def _adapters(self):
+        """[B] adapter ids for batch dispatches, or None when LoRA is
+        off (None is an empty pytree: the jit signature stays stable
+        and the model paths skip the gather entirely)."""
+        return jnp.asarray(self._slot_adapter) if self._n_adapters else None
+
+    def _adapter1(self, req: "_Request | None"):
+        if not self._n_adapters:
+            return None
+        return jnp.asarray([0 if req is None else req.adapter], jnp.int32)
+
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
         return sub
@@ -651,6 +724,7 @@ class GenerationEngine:
         waste in the cache: capacity used == prompt length."""
         L = len(req.prompt)
         C = self.prompt_buckets[-1]
+        self._slot_adapter[idx] = req.adapter
         pos = self._prefix_restore(idx, req, L, C)
         if pos == 0 and L <= C:
             Sb = pad_bucket(L, self.prompt_buckets)
@@ -659,7 +733,8 @@ class GenerationEngine:
             tok, self.cache = self._prefill_jit(
                 self.cache, self.params, jnp.asarray(padded), jnp.int32(L),
                 jnp.int32(idx), jnp.float32(req.temperature),
-                jnp.int32(req.top_k), self._next_key())
+                jnp.int32(req.top_k), self._next_key(),
+                self._adapter1(req))
             return int(tok)
         while L - pos > C:
             if req.stream.cancelled.is_set():
@@ -668,7 +743,8 @@ class GenerationEngine:
             self.cache = self._chunk_mid_jit(
                 self.cache, self.params, jnp.asarray(chunk[None, :]),
                 jnp.int32(pos), jnp.int32(idx), jnp.int32(0),
-                jnp.int32(0), jnp.float32(0.0), jnp.int32(0), self._key)
+                jnp.int32(0), jnp.float32(0.0), jnp.int32(0), self._key,
+                self._adapter1(req))
             pos += C
             # Long admissions must not stall active decode streams
             # (VERDICT r2 weak #5): run one decode block between chunks
@@ -685,7 +761,7 @@ class GenerationEngine:
             self.cache, self.params, jnp.asarray(final[None, :]),
             jnp.int32(L - Sb), jnp.int32(idx), jnp.int32(L),
             jnp.int32(Sb - 1), jnp.float32(req.temperature),
-            jnp.int32(req.top_k), self._next_key())
+            jnp.int32(req.top_k), self._next_key(), self._adapter1(req))
         return int(tok)
 
     def _prefix_restore(self, idx: int, req: _Request, L: int,
@@ -790,6 +866,7 @@ class GenerationEngine:
         self._active[idx] = False
         self._temps[idx] = 0.0
         self._top_ks[idx] = 0
+        self._slot_adapter[idx] = 0
 
     def _loop(self) -> None:
         while not self._closed:
@@ -900,7 +977,7 @@ class GenerationEngine:
                 window[idx, 1:] = d
         toks, emit, self.cache = self._verify_jit(
             self.cache, self.params, jnp.asarray(window),
-            jnp.asarray(self._active), self._next_key())
+            jnp.asarray(self._active), self._next_key(), self._adapters())
         toks_np = np.asarray(jax.device_get(toks))
         emit_np = np.asarray(jax.device_get(emit))
         self._spec_windows += int(self._active.sum())
@@ -926,7 +1003,7 @@ class GenerationEngine:
         toks, self.cache = self._step_jit(
             self.cache, self.params, jnp.asarray(self._last_tokens),
             jnp.asarray(self._active), jnp.asarray(self._temps),
-            jnp.asarray(self._top_ks), self._next_key())
+            jnp.asarray(self._top_ks), self._next_key(), self._adapters())
         toks_np = np.asarray(jax.device_get(toks))  # [K, B]
         if self.metrics is not None:
             self.metrics.set_gauge("app_tpu_batch_fill",
