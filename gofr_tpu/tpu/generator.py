@@ -776,7 +776,7 @@ class GenerationEngine:
         if self._prefix_idx is None:
             return 0
         prompt = np.asarray(req.prompt, np.int32)
-        row, m = self._prefix_idx.match(prompt)
+        row, m = self._prefix_idx.match(prompt, req.adapter)
         m_eff = min(int(m), L - 1)
         rem = L - m_eff
         while rem > C:
@@ -805,9 +805,10 @@ class GenerationEngine:
         if self._prefix_idx is None or req.stream.cancelled.is_set():
             return
         prompt = np.asarray(req.prompt, np.int32)
-        if len(prompt) < self._store_min or self._prefix_idx.covered(prompt):
+        if len(prompt) < self._store_min or \
+                self._prefix_idx.covered(prompt, req.adapter):
             return
-        row = self._prefix_idx.store_row(prompt)
+        row = self._prefix_idx.store_row(prompt, req.adapter)
         self._pool = self._pool_store_jit(self._pool, self.cache,
                                           jnp.int32(row), jnp.int32(idx))
 

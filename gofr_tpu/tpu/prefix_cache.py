@@ -33,6 +33,10 @@ class PrefixIndex:
     def __init__(self, slots: int):
         self.slots = int(slots)
         self._keys: list[np.ndarray | None] = [None] * self.slots
+        # KV depends on which LoRA adapter computed it (wk/wv flow
+        # through the adapter), so entries are keyed by (tokens,
+        # adapter) — a stored prefix never restores across adapters
+        self._adapter = [0] * self.slots
         self._tick = 0
         self._used = [0] * self.slots
         self.hits = 0
@@ -41,7 +45,7 @@ class PrefixIndex:
     def __len__(self) -> int:
         return sum(1 for k in self._keys if k is not None)
 
-    def match(self, prompt: np.ndarray) -> tuple[int, int]:
+    def match(self, prompt: np.ndarray, adapter: int = 0) -> tuple[int, int]:
         """(pool_row, matched_len) for the longest common prefix between
         ``prompt`` and any stored entry — a PARTIAL match of a stored
         prefix is still valid KV (a prefix of a prefix). (-1, 0) when
@@ -52,7 +56,7 @@ class PrefixIndex:
         Prometheus counter and keep useless entries alive at eviction."""
         best, best_len = -1, 0
         for i, key in enumerate(self._keys):
-            if key is None:
+            if key is None or self._adapter[i] != adapter:
                 continue
             n = min(len(key), len(prompt))
             if n <= best_len:
@@ -73,18 +77,19 @@ class PrefixIndex:
         """No usable match for this admission."""
         self.misses += 1
 
-    def covered(self, prompt: np.ndarray) -> bool:
-        """True when some stored entry already contains ``prompt`` as a
-        prefix — storing it again would only duplicate."""
-        for key in self._keys:
-            if key is not None and len(key) >= len(prompt) and \
-                    np.array_equal(key[:len(prompt)], prompt):
+    def covered(self, prompt: np.ndarray, adapter: int = 0) -> bool:
+        """True when some stored entry (same adapter) already contains
+        ``prompt`` as a prefix — storing it again would only duplicate."""
+        for i, key in enumerate(self._keys):
+            if key is not None and self._adapter[i] == adapter \
+                    and len(key) >= len(prompt) \
+                    and np.array_equal(key[:len(prompt)], prompt):
                 return True
         return False
 
-    def store_row(self, prompt: np.ndarray) -> int:
+    def store_row(self, prompt: np.ndarray, adapter: int = 0) -> int:
         """Pick the row for a new entry (free row, else LRU victim),
-        record the key, return the row index."""
+        record the (key, adapter), return the row index."""
         victim = None
         for i, key in enumerate(self._keys):
             if key is None:
@@ -94,6 +99,7 @@ class PrefixIndex:
             victim = min(range(self.slots), key=lambda i: self._used[i])
         self._tick += 1
         self._keys[victim] = np.asarray(prompt, np.int32).copy()
+        self._adapter[victim] = int(adapter)
         self._used[victim] = self._tick
         return victim
 

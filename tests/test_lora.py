@@ -122,6 +122,55 @@ def test_engine_load_adapter_roundtrip(lora_params):
         eng.close()
 
 
+def test_lora_composes_with_spec_decode_and_prefix_cache(lora_params):
+    """Adapters flow through the speculative verify pass and prefix
+    restores: a repetitive prompt on adapter 1 streams exactly the
+    merged-model reference with both features on."""
+    eng = GenerationEngine(TINY, lora_params, slots=2, max_seq=64,
+                           prompt_buckets=(8, 16), lora_adapters=3,
+                           spec_decode_k=3, prefix_cache_slots=2,
+                           prefix_store_min=8)
+    prompt = [7, 9, 7, 9, 7, 9, 7, 9]
+    try:
+        want = _ref_greedy(lora_params, prompt, 12, 1)
+        assert eng.generate(prompt, max_new_tokens=12,
+                            adapter=1).tokens() == want
+        # repeat: prefix hit + spec verify, same adapter, same stream
+        assert eng.generate(prompt, max_new_tokens=12,
+                            adapter=1).tokens() == want
+        # same prompt on the BASE adapter must not reuse adapter-1 KV...
+        base_want = _ref_greedy(lora_params, prompt, 12, 0)
+        got0 = eng.generate(prompt, max_new_tokens=12).tokens()
+        assert got0 == base_want
+    finally:
+        eng.close()
+
+
+def test_prefix_cache_never_crosses_adapters(lora_params):
+    """THE hazard test: KV flows through the adapter's wk/wv, so a
+    stored adapter-1 prefix restored into a base request would serve
+    wrong attention keys. Prompt long enough (40 tokens, buckets
+    (8,16)) that a cross-adapter restore would SURVIVE the final-chunk
+    recompute — the prefix index must refuse the match instead."""
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(1, TINY.vocab_size, 40).tolist()
+    eng = GenerationEngine(TINY, lora_params, slots=2, max_seq=64,
+                           prompt_buckets=(8, 16), lora_adapters=3,
+                           prefix_cache_slots=2, prefix_store_min=16)
+    try:
+        got1 = eng.generate(prompt, max_new_tokens=6, adapter=1).tokens()
+        assert got1 == _ref_greedy(lora_params, prompt, 6, 1)
+        # base request, same tokens: must NOT hit adapter-1's entry
+        got0 = eng.generate(prompt, max_new_tokens=6).tokens()
+        assert got0 == _ref_greedy(lora_params, prompt, 6, 0)
+        # but a same-adapter repeat DOES hit and stays correct
+        again = eng.generate(prompt, max_new_tokens=6, adapter=1).tokens()
+        assert again == got1
+        assert eng.stats()["prefix_cache"]["hits"] >= 1
+    finally:
+        eng.close()
+
+
 def test_engine_from_config_with_lora():
     eng = new_engine_from_config(MapConfig({
         "TPU_MODEL": "tiny", "TPU_SEQ_BUCKETS": "8,16", "TPU_SLOTS": "2",
