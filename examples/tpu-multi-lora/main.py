@@ -1,0 +1,60 @@
+"""Multi-tenant LoRA serving: per-request adapters over one engine.
+
+Every tenant's fine-tune is a rank-r LoRA adapter living in the
+engine's adapter stacks; requests pick theirs per call and share the
+slot pool and the single weight stream (docs/tpu/serving-engine.md).
+Admin surface: POST an adapter's weights (npz of A/B pairs) into a
+slot while serving — the swap happens between device iterations.
+
+    POST /generate   {"tokens": [...], "adapter": 1, "max_new_tokens": 32}
+    POST /adapters/2 (body: npz bytes with wq.a/wq.b/... arrays)
+    GET  /adapters
+"""
+
+import io
+
+import numpy as np
+
+import os as _os
+import sys as _sys
+
+# appended (not prepended): an installed gofr_tpu always wins
+_sys.path.append(_os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                               "..", ".."))
+
+from gofr_tpu import App
+from gofr_tpu.models.llama import LORA_TARGETS
+
+app = App()  # configs/.env sets TPU_MODEL + TPU_LORA_ADAPTERS
+
+
+@app.post("/generate")
+def generate(ctx):
+    body = ctx.bind()
+    stream = ctx.tpu.generate(body["tokens"],
+                              max_new_tokens=body.get("max_new_tokens", 32),
+                              temperature=body.get("temperature", 0.0),
+                              adapter=body.get("adapter", 0))
+    return {"tokens": stream.tokens(), "adapter": body.get("adapter", 0)}
+
+
+@app.get("/adapters")
+def list_adapters(ctx):
+    return ctx.tpu.generator.stats().get("lora", {})
+
+
+@app.post("/adapters/{idx}")
+def install_adapter(ctx):
+    """Hot-swap one adapter slot from an npz body: arrays named
+    '<target>.a' [L, in, r] and '<target>.b' [L, r, out] for each of
+    wq/wk/wv/wo (absent targets keep their current weights)."""
+    idx = int(ctx.path_param("idx"))
+    with np.load(io.BytesIO(ctx.request.body)) as f:
+        tree = {name: (f[f"{name}.a"], f[f"{name}.b"])
+                for name in LORA_TARGETS if f"{name}.a" in f.files}
+    ctx.tpu.generator.load_adapter(idx, tree)
+    return {"installed": idx, "targets": sorted(tree)}
+
+
+if __name__ == "__main__":
+    app.run()

@@ -199,6 +199,56 @@ def test_tpu_embedding_server_example():
         assert status == 200 and out["data"]["dim"] == 64
 
 
+def test_tpu_multi_lora_example():
+    import io
+    import urllib.request
+
+    import numpy as np
+
+    mod = load_example("tpu-multi-lora",
+                       {**BASE, "TPU_MODEL": "tiny", "TPU_MAX_SEQ": "64",
+                        "TPU_SLOTS": "2", "TPU_SEQ_BUCKETS": "8,16",
+                        "TPU_LORA_ADAPTERS": "3", "TPU_LORA_RANK": "4"})
+    with mod.app:
+        port = mod.app.http_port
+        status, out = http("POST", f"http://127.0.0.1:{port}/generate",
+                           {"tokens": [1, 2, 3], "adapter": 0,
+                            "max_new_tokens": 4})
+        assert status == 200 and len(out["data"]["tokens"]) == 4
+        base_tokens = out["data"]["tokens"]
+        status, out = http("GET", f"http://127.0.0.1:{port}/adapters")
+        assert status == 200 and out["data"]["adapters"] == 3
+        # install a real adapter into slot 1 via the npz admin route
+        from gofr_tpu.models import llama
+        from gofr_tpu.models.common import LLAMA_CONFIGS
+
+        cfg = LLAMA_CONFIGS["tiny"]
+        lora = llama.init_lora(cfg, 1, 4, __import__("jax").random.PRNGKey(7))
+        buf = io.BytesIO()
+        arrays = {}
+        for name in llama.LORA_TARGETS:
+            a = np.asarray(lora[f"lora_a_{name}"][:, 0])
+            arrays[f"{name}.a"] = a
+            arrays[f"{name}.b"] = np.full(
+                (a.shape[0], a.shape[-1],
+                 cfg.dim if name == "wo" else
+                 (cfg.n_heads if name == "wq" else cfg.n_kv_heads)
+                 * cfg.head_dim), 0.5, np.float32)
+        np.savez(buf, **arrays)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/adapters/1", data=buf.getvalue(),
+            method="POST")
+        with urllib.request.urlopen(req, timeout=60) as r:
+            body = json.loads(r.read())
+        assert body["data"]["installed"] == 1
+        # the installed (nonzero) adapter changes the stream vs base
+        status, out = http("POST", f"http://127.0.0.1:{port}/generate",
+                           {"tokens": [1, 2, 3], "adapter": 1,
+                            "max_new_tokens": 4})
+        assert status == 200 and len(out["data"]["tokens"]) == 4
+        assert out["data"]["tokens"] != base_tokens
+
+
 def test_tpu_token_streaming_example():
     from gofr_tpu.grpcx import dial
 
