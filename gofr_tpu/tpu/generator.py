@@ -156,6 +156,18 @@ class GenerationEngine:
                     **llama.init_lora(cfg, self._n_adapters,
                                       int(lora_rank),
                                       jax.random.PRNGKey(seed + 1))}}
+            else:
+                # a checkpoint brought its own stacks: their width is
+                # the truth. A silent mismatch would CLAMP the device
+                # gather (tenant 4 served tenant 2's fine-tune) and
+                # DROP out-of-bounds load_adapter scatters.
+                n_stack = int(params["layers"]["lora_a_wq"].shape[1])
+                if n_stack != self._n_adapters:
+                    raise ValueError(
+                        f"params carry {n_stack} LoRA adapter slots but "
+                        f"lora_adapters={self._n_adapters}; they must "
+                        "match (gather clamping would silently serve "
+                        "the wrong tenant)")
         self._slot_adapter = np.zeros((slots,), np.int32)
         # K decode steps fused into one dispatch (lax.scan on device): the
         # host sees K tokens per roundtrip instead of one, amortizing
@@ -482,8 +494,10 @@ class GenerationEngine:
             raise GenerationError("generation engine is draining")
         if self.down is not None:
             raise GenerationError(f"generation engine is down: {self.down}")
-        if eos_id is not None and not isinstance(eos_id, int):
+        if eos_id is not None and not isinstance(eos_id, (int, np.integer)):
             eos_id = frozenset(int(t) for t in eos_id) or None
+        elif isinstance(eos_id, np.integer):
+            eos_id = int(eos_id)
         if adapter and not 0 <= adapter < max(self._n_adapters, 1):
             raise GenerationError(
                 f"adapter {adapter} out of range (engine has "
@@ -621,6 +635,11 @@ class GenerationEngine:
             raise GenerationError(
                 f"adapter slot {idx} invalid (1..{self._n_adapters - 1}; "
                 "slot 0 is the base no-op)")
+        if self._prefix_idx is not None:
+            # stored prefix KV was computed through the OLD adapter
+            # weights — restoring it after the swap would serve wrong
+            # attention keys (same hazard as cross-adapter reuse)
+            self._prefix_idx.invalidate_adapter(idx)
         with self._device_lock:
             layers = dict(self.params["layers"])
             for name, (a, b) in tree.items():

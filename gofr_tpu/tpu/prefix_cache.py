@@ -103,6 +103,19 @@ class PrefixIndex:
         self._used[victim] = self._tick
         return victim
 
+    def invalidate_adapter(self, adapter: int) -> int:
+        """Drop every entry stored under ``adapter`` — required when its
+        LoRA weights are hot-swapped (the stored KV was computed through
+        the OLD wk/wv and would serve wrong attention keys). Returns the
+        number of dropped entries."""
+        n = 0
+        for i, key in enumerate(self._keys):
+            if key is not None and self._adapter[i] == int(adapter):
+                self._keys[i] = None
+                self._used[i] = 0
+                n += 1
+        return n
+
     def stats(self) -> dict:
         return {"slots": self.slots, "entries": len(self),
                 "hits": self.hits, "misses": self.misses}

@@ -171,6 +171,48 @@ def test_prefix_cache_never_crosses_adapters(lora_params):
         eng.close()
 
 
+def test_load_adapter_invalidates_its_prefix_entries(lora_params):
+    """Hot-swapping an adapter's weights must drop its stored prefix KV
+    (computed through the OLD wk/wv); the next same-adapter request
+    recomputes with the new weights instead of restoring stale keys."""
+    rng = np.random.default_rng(10)
+    prompt = rng.integers(1, TINY.vocab_size, 40).tolist()
+    eng = GenerationEngine(TINY, lora_params, slots=2, max_seq=64,
+                           prompt_buckets=(8, 16), lora_adapters=3,
+                           prefix_cache_slots=2, prefix_store_min=16)
+    try:
+        eng.generate(prompt, max_new_tokens=4, adapter=1).tokens()
+        assert eng.stats()["prefix_cache"]["entries"] == 1
+        # swap adapter 1 to adapter-2's weights
+        tree = {name: (lora_params["layers"][f"lora_a_{name}"][:, 2],
+                       lora_params["layers"][f"lora_b_{name}"][:, 2])
+                for name in llama.LORA_TARGETS}
+        eng.load_adapter(1, tree)
+        assert eng.stats()["prefix_cache"]["entries"] == 0  # invalidated
+        got = eng.generate(prompt, max_new_tokens=6, adapter=1).tokens()
+        assert got == _ref_greedy(lora_params, prompt, 6, 2)
+    finally:
+        eng.close()
+
+
+def test_adapter_stack_width_mismatch_rejected(lora_params):
+    with pytest.raises(ValueError, match="must\n? ?match|match"):
+        GenerationEngine(TINY, lora_params, slots=2, max_seq=64,
+                         prompt_buckets=(8,), lora_adapters=5)
+
+
+def test_numpy_integer_eos(lora_params):
+    eng = GenerationEngine(TINY, lora_params, slots=2, max_seq=64,
+                           prompt_buckets=(8,), lora_adapters=3)
+    try:
+        base = eng.generate([5, 17, 42, 7], max_new_tokens=4).tokens()
+        got = eng.generate([5, 17, 42, 7], max_new_tokens=50,
+                           eos_id=np.int32(base[1])).tokens()
+        assert got == base[:base.index(base[1]) + 1]
+    finally:
+        eng.close()
+
+
 def test_engine_from_config_with_lora():
     eng = new_engine_from_config(MapConfig({
         "TPU_MODEL": "tiny", "TPU_SEQ_BUCKETS": "8,16", "TPU_SLOTS": "2",
