@@ -576,6 +576,10 @@ def main() -> None:
     except Exception as e:
         log(f"  flash smoke FAILED: {type(e).__name__}: {str(e)[:200]}")
         payload["flash_smoke"] = f"FAILED: {type(e).__name__}: {str(e)[:200]}"
+    # snapshot: if a runner kills the remaining (slower) sections, the
+    # stream still ends with a parsable headline line; the complete
+    # payload re-emits at the end and supersedes this one.
+    emit({**payload, "partial": "ttft/prefix/engine sections pending"})
     try:
         ttft = bench_ttft(cfg, slots=min(used or 8, 32))
         payload["ttft_p50_ms"] = round(ttft["p50_ms"], 1)
