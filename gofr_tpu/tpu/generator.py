@@ -70,12 +70,14 @@ class GenerationError(RuntimeError):
 class GenStream:
     """Iterator over generated token ids; ``cancel()`` releases the slot."""
 
-    def __init__(self, request_id: int, engine: "GenerationEngine"):
+    def __init__(self, request_id: int, engine: "GenerationEngine",
+                 logprobs: bool = False):
         self.request_id = request_id
         self._engine = engine
         self._q: queue.Queue = queue.Queue()
         self.cancelled = threading.Event()
         self.prompt_len = 0
+        self.logprobs = logprobs  # items are (token, logprob) tuples
 
     def __iter__(self) -> Iterator[int]:
         while True:
@@ -87,8 +89,9 @@ class GenStream:
             yield item
 
     def tokens(self) -> list[int]:
-        """Drain the whole stream (blocking) into a list."""
-        return list(self)
+        """Drain the whole stream (blocking) into a list of ids
+        (logprobs, when enabled, are dropped here — iterate for them)."""
+        return [t[0] if isinstance(t, tuple) else t for t in self]
 
     def cancel(self) -> None:
         self.cancelled.set()
@@ -97,6 +100,10 @@ class GenStream:
 class _Request:
     __slots__ = ("stream", "prompt", "max_new", "temperature", "top_k",
                  "eos_id", "adapter", "enqueued_at")
+
+    @property
+    def logprobs(self) -> bool:
+        return self.stream.logprobs
 
     def __init__(self, stream: GenStream, prompt: np.ndarray, max_new: int,
                  temperature: float, top_k: int, eos_id: int | None,
@@ -290,15 +297,17 @@ class GenerationEngine:
             self._cache_sh = cache_sh
             self.cache = jax.device_put(self.cache, cache_sh)
             rep = replicated(mesh)
+            # outputs: (token, logprob, cache) for prefill/final-chunk,
+            # (tokens, logprobs, cache) for the fused step
             self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(0,),
-                                        out_shardings=(rep, cache_sh))
+                                        out_shardings=(rep, rep, cache_sh))
             self._step_jit = jax.jit(self._step_fn, donate_argnums=(0,),
-                                     out_shardings=(rep, cache_sh))
+                                     out_shardings=(rep, rep, cache_sh))
             self._chunk_mid_jit = jax.jit(self._chunk_mid, donate_argnums=(0,),
                                           out_shardings=cache_sh)
             self._chunk_final_jit = jax.jit(self._chunk_final,
                                             donate_argnums=(0,),
-                                            out_shardings=(rep, cache_sh))
+                                            out_shardings=(rep, rep, cache_sh))
         else:
             self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(0,))
             self._step_jit = jax.jit(self._step_fn, donate_argnums=(0,))
@@ -332,7 +341,12 @@ class GenerationEngine:
         topk_tok = jnp.take_along_axis(idx, in_k[:, None], axis=1)[:, 0]
         sampled = jnp.where(top_ks > 0, topk_tok, sampled)
         greedy = jnp.argmax(logits, axis=-1)
-        return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+        tok = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+        # logprob of the chosen token under the MODEL's (untempered)
+        # distribution — the number OpenAI-style logprobs report
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        lp = jnp.take_along_axis(logp, tok[:, None], axis=1)[:, 0]
+        return tok, lp
 
     def _prefill_fn(self, cache, params, tokens, length, slot, temp,
                     top_k, key, adapter=None):
@@ -348,8 +362,8 @@ class GenerationEngine:
         lengths = cache.lengths.at[slot].set(length)
         cache = llama.write_kv(cache, k, v, (0, slot, 0, 0, 0), lengths)
         last = jnp.take(logits[0], length - 1, axis=0)  # [V] at the true end
-        tok = self._sample(last[None, :], temp[None], key, top_k[None])[0]
-        return tok, cache
+        tok, lp = self._sample(last[None, :], temp[None], key, top_k[None])
+        return tok[0], lp[0], cache
 
     def _chunk_fn(self, cache, params, tokens, start, slot, total_len,
                   pos_in_chunk, temp, top_k, key, adapter, sample: bool):
@@ -391,8 +405,8 @@ class GenerationEngine:
             return llama.KVCache(k_new, v_new, lengths, ks, vs)
         lengths = cache.lengths.at[slot].set(total_len)
         last = jnp.take(logits[0], pos_in_chunk, axis=0)
-        tok = self._sample(last[None, :], temp[None], key, top_k[None])[0]
-        return tok, llama.KVCache(k_new, v_new, lengths, ks, vs)
+        tok, lp = self._sample(last[None, :], temp[None], key, top_k[None])
+        return tok[0], lp[0], llama.KVCache(k_new, v_new, lengths, ks, vs)
 
     def _step_fn(self, cache, params, last_tokens, active, temps, top_ks,
                  key, adapter=None):
@@ -412,12 +426,13 @@ class GenerationEngine:
                 adapter=adapter)
             lengths = jnp.where(active, stepped.lengths, cache.lengths)
             stepped = stepped._replace(lengths=lengths)
-            toks = self._sample(logits, temps, step_key, top_ks)
+            toks, lps = self._sample(logits, temps, step_key, top_ks)
             toks = jnp.where(active, toks, tokens)
-            return (toks, stepped), toks
+            return (toks, stepped), (toks, lps)
 
-        (_, cache), toks = jax.lax.scan(body, (last_tokens, cache), keys)
-        return toks, cache
+        (_, cache), (toks, lps) = jax.lax.scan(body, (last_tokens, cache),
+                                               keys)
+        return toks, lps, cache
 
     def _verify_fn(self, cache, params, window, active, key, adapter=None):
         """One speculative verify pass. ``window`` [B, W]: col 0 = each
@@ -432,11 +447,13 @@ class GenerationEngine:
                                             rope_tables=self.rope_tables,
                                             adapter=adapter)
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, W]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        lps = jnp.take_along_axis(logp, greedy[..., None], axis=-1)[..., 0]
         agree = (greedy[:, :-1] == window[:, 1:]).astype(jnp.int32)
         accept = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)     # [B]
         emit = jnp.where(active, accept + 1, 0)
         lengths = stepped.lengths + emit
-        return greedy, emit, stepped._replace(lengths=lengths)
+        return greedy, lps, emit, stepped._replace(lengths=lengths)
 
     def _hist_set(self, idx: int, tokens) -> None:
         n = min(len(tokens), self._hist_buf.shape[1])
@@ -474,7 +491,8 @@ class GenerationEngine:
     # -- public API ----------------------------------------------------------
     def generate(self, prompt, max_new_tokens: int = 128,
                  temperature: float = 0.0, top_k: int = 0,
-                 eos_id=None, adapter: int = 0) -> GenStream:
+                 eos_id=None, adapter: int = 0,
+                 logprobs: bool = False) -> GenStream:
         """Enqueue a prompt (sequence of token ids); returns a GenStream
         yielding generated ids as the device produces them.
 
@@ -503,7 +521,7 @@ class GenerationEngine:
                 f"adapter {adapter} out of range (engine has "
                 f"{self._n_adapters} LoRA adapter slots)")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        stream = GenStream(next(_REQ_IDS), self)
+        stream = GenStream(next(_REQ_IDS), self, logprobs=logprobs)
         stream.prompt_len = len(prompt)
         if len(prompt) == 0:
             stream._q.put(GenerationError("empty prompt"))
@@ -579,14 +597,14 @@ class GenerationEngine:
                                      or self._prefix_idx is not None)
                 for b in self.prompt_buckets:
                     toks = jnp.zeros((1, b), jnp.int32)
-                    _, self.cache = jax.block_until_ready(self._prefill_jit(
+                    _, _, self.cache = jax.block_until_ready(self._prefill_jit(
                         self.cache, self.params, toks, jnp.int32(1),
                         jnp.int32(free), jnp.float32(0.0), jnp.int32(0),
                         self._key, self._adapter1(None)))
                     if chunked_reachable:
                         # chunked-admission lattice: the final chunk
                         # compiles per bucket, mid chunks only at C
-                        _, self.cache = jax.block_until_ready(
+                        _, _, self.cache = jax.block_until_ready(
                             self._chunk_final_jit(
                                 self.cache, self.params, toks, jnp.int32(0),
                                 jnp.int32(free), jnp.int32(1), jnp.int32(0),
@@ -602,7 +620,7 @@ class GenerationEngine:
             elif self.logger is not None:
                 self.logger.debug({"event": "generator warmup skipped prefill",
                                    "reason": "no free slot"})
-            _, self.cache = jax.block_until_ready(self._step_jit(
+            _, _, self.cache = jax.block_until_ready(self._step_jit(
                 self.cache, self.params, jnp.asarray(self._last_tokens),
                 jnp.zeros((self.n_slots,), bool), jnp.asarray(self._temps),
                 jnp.asarray(self._top_ks), self._key, self._adapters()))
@@ -614,7 +632,7 @@ class GenerationEngine:
                 # cursors like the step warmup's.
                 window = jnp.zeros((self.n_slots, self._spec_k + 1),
                                    jnp.int32)
-                _, _, cache_w = self._verify_jit(
+                _, _, _, cache_w = self._verify_jit(
                     self.cache, self.params, window,
                     jnp.zeros((self.n_slots,), bool), self._key,
                     self._adapters())
@@ -749,12 +767,12 @@ class GenerationEngine:
             Sb = pad_bucket(L, self.prompt_buckets)
             padded = np.zeros((1, Sb), np.int32)
             padded[0, :L] = req.prompt
-            tok, self.cache = self._prefill_jit(
+            tok, lp, self.cache = self._prefill_jit(
                 self.cache, self.params, jnp.asarray(padded), jnp.int32(L),
                 jnp.int32(idx), jnp.float32(req.temperature),
                 jnp.int32(req.top_k), self._next_key(),
                 self._adapter1(req))
-            return int(tok)
+            return int(tok), float(lp)
         while L - pos > C:
             if req.stream.cancelled.is_set():
                 break
@@ -772,16 +790,16 @@ class GenerationEngine:
         if req.stream.cancelled.is_set():
             # token is discarded anyway (_deliver retires cancelled slots
             # before use) — skip the final-chunk dispatch entirely
-            return 0
+            return 0, 0.0
         rem = L - pos
         Sb = pad_bucket(rem, self.prompt_buckets)
         final = req.prompt[L - Sb:]
-        tok, self.cache = self._chunk_final_jit(
+        tok, lp, self.cache = self._chunk_final_jit(
             self.cache, self.params, jnp.asarray(final[None, :]),
             jnp.int32(L - Sb), jnp.int32(idx), jnp.int32(L),
             jnp.int32(Sb - 1), jnp.float32(req.temperature),
             jnp.int32(req.top_k), self._next_key(), self._adapter1(req))
-        return int(tok)
+        return int(tok), float(lp)
 
     def _prefix_restore(self, idx: int, req: _Request, L: int,
                         C: int) -> int:
@@ -834,7 +852,7 @@ class GenerationEngine:
     def _start(self, idx: int, slot: _Slot, req: _Request) -> None:
         t0 = time.monotonic()
         try:
-            first = self._admit_prefill(idx, req)
+            first, first_lp = self._admit_prefill(idx, req)
         except BaseException as e:  # noqa: BLE001 — the request is already
             # off the pending queue and owns no slot: fail ITS stream here,
             # then let _loop's handler deal with engine-level fallout.
@@ -855,18 +873,19 @@ class GenerationEngine:
         self._top_ks[idx] = req.top_k
         if self._spec_k:
             self._hist_append(idx, int(first))
-        self._deliver(idx, slot, first)
+        self._deliver(idx, slot, first, first_lp)
         if slot.request is not None:  # not finished by the first token
             self._last_tokens[idx] = first
             self._active[idx] = True
 
-    def _deliver(self, idx: int, slot: _Slot, token: int) -> None:
+    def _deliver(self, idx: int, slot: _Slot, token: int,
+                 lp: float | None = None) -> None:
         """Push one token to the consumer; retire the slot when finished."""
         req = slot.request
         if req.stream.cancelled.is_set():
             self._retire(idx, slot)
             return
-        req.stream._q.put(token)
+        req.stream._q.put((token, lp) if req.logprobs else token)
         slot.generated += 1
         slot.remaining -= 1
         self.total_tokens += 1
@@ -995,11 +1014,10 @@ class GenerationEngine:
         for idx, d in drafts.items():
             if d is not None:
                 window[idx, 1:] = d
-        toks, emit, self.cache = self._verify_jit(
+        toks, lps, emit, self.cache = self._verify_jit(
             self.cache, self.params, jnp.asarray(window),
             jnp.asarray(self._active), self._next_key(), self._adapters())
-        toks_np = np.asarray(jax.device_get(toks))
-        emit_np = np.asarray(jax.device_get(emit))
+        toks_np, lps_np, emit_np = jax.device_get((toks, lps, emit))
         self._spec_windows += int(self._active.sum())
         self._spec_emitted += int(emit_np.sum())
         for idx, slot in enumerate(self._slots):
@@ -1011,7 +1029,7 @@ class GenerationEngine:
                 t = int(toks_np[idx, k])
                 self._last_tokens[idx] = t
                 self._hist_append(idx, t)
-                self._deliver(idx, slot, t)
+                self._deliver(idx, slot, t, float(lps_np[idx, k]))
 
     def _decode_tick(self) -> None:
         """One fused decode block: dispatch, fetch [K, B] tokens, deliver
@@ -1020,11 +1038,11 @@ class GenerationEngine:
         buys K-fold fewer device roundtrips."""
         if not self._active.any():
             return
-        toks, self.cache = self._step_jit(
+        toks, lps, self.cache = self._step_jit(
             self.cache, self.params, jnp.asarray(self._last_tokens),
             jnp.asarray(self._active), jnp.asarray(self._temps),
             jnp.asarray(self._top_ks), self._next_key(), self._adapters())
-        toks_np = np.asarray(jax.device_get(toks))  # [K, B]
+        toks_np, lps_np = jax.device_get((toks, lps))  # [K, B] each
         if self.metrics is not None:
             self.metrics.set_gauge("app_tpu_batch_fill",
                                    float(self._active.sum()) / self.n_slots,
@@ -1036,4 +1054,5 @@ class GenerationEngine:
                 self._last_tokens[idx] = toks_np[k, idx]
                 if self._spec_k:
                     self._hist_append(idx, int(toks_np[k, idx]))
-                self._deliver(idx, slot, int(toks_np[k, idx]))
+                self._deliver(idx, slot, int(toks_np[k, idx]),
+                              float(lps_np[k, idx]))

@@ -555,3 +555,24 @@ def test_container_wires_tpu_from_config():
         assert h["tpu"]["details"]["model"] == "bert-tiny"
     finally:
         c.close()
+
+
+def test_logprobs_stream(gen_engine, tiny_llama):
+    """logprobs=True streams (token, logprob) pairs; each logprob is the
+    model's log-softmax at the chosen token — pinned against the
+    cache-free forward at every position, through prefill AND decode."""
+    prompt = [5, 17, 42, 7]
+    pairs = list(gen_engine.generate(prompt, max_new_tokens=6,
+                                     logprobs=True))
+    toks = [t for t, _ in pairs]
+    assert toks == _reference_greedy(tiny_llama, prompt, 6)
+    ctx = list(prompt)
+    for tok, lp in pairs:
+        logits = llama.forward(tiny_llama, TINY,
+                               jnp.asarray([ctx], jnp.int32))
+        want = float(jax.nn.log_softmax(
+            logits[0, -1].astype(jnp.float32))[tok])
+        assert abs(lp - want) < 1e-3, (tok, lp, want)
+        ctx.append(tok)
+    # default stays plain ints, tokens() strips pairs
+    assert gen_engine.generate(prompt, max_new_tokens=3).tokens() == toks[:3]
