@@ -25,9 +25,13 @@ def generate_grpc(ctx, req):
                               max_new_tokens=req.get("max_new_tokens", 64),
                               temperature=req.get("temperature", 0.0),
                               top_k=req.get("top_k", 0),
-                              eos_id=req.get("eos_id"))
-    for tok in stream:
-        yield {"token": tok}
+                              eos_id=req.get("eos_id"),
+                              logprobs=req.get("logprobs", False))
+    for item in stream:
+        if isinstance(item, tuple):
+            yield {"token": item[0], "logprob": item[1]}
+        else:
+            yield {"token": item}
 
 
 @llm.bidi_stream("Chat")
