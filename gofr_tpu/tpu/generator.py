@@ -79,7 +79,7 @@ class GenStream:
         self.prompt_len = 0
         self.logprobs = logprobs  # items are (token, logprob) tuples
 
-    def __iter__(self) -> Iterator[int]:
+    def __iter__(self) -> "Iterator[int] | Iterator[tuple[int, float]]":
         while True:
             item = self._q.get()
             if item is None:
@@ -747,9 +747,9 @@ class GenerationEngine:
             finally:
                 self._admitting -= 1
 
-    def _admit_prefill(self, idx: int, req: _Request) -> int:
+    def _admit_prefill(self, idx: int, req: _Request) -> tuple[int, float]:
         """Run the request's prompt through prefill into slot ``idx`` and
-        return the first sampled token.
+        return (first sampled token, its logprob).
 
         Prompts within the bucket lattice go through one padded prefill
         dispatch. Longer prompts run CHUNKED: full chunks of the largest
