@@ -95,4 +95,17 @@ for _ in range(5):
     tok, cache = decode(params, tok, cache)
     out.append(int(tok[0]))
 print(f"GEN tokens={out}", flush=True)
+
+# -- pipeline conveyor ACROSS the process boundary: pp=2 puts stage 0 on
+# process 0 and stage 1 on process 1, so every conveyor ppermute (and
+# the loss psum) rides DCN — the multi-host story for the pp axis.
+pp_mesh = parallel.make_mesh(parallel.MeshPlan(pp=2, dp=1, tp=4))
+pp_state = parallel.init_train_state(MCFG, jax.random.PRNGKey(3), pp_mesh,
+                                     opt)
+pp_step = parallel.make_train_step(MCFG, opt, pp_mesh, remat=False,
+                                   n_microbatches=2)
+pp_state, pp_metrics = pp_step(pp_state, tokens, lengths)
+pp_loss = float(pp_metrics["loss"])
+assert np.isfinite(pp_loss)
+print(f"PPTRAIN loss={pp_loss:.6f}", flush=True)
 print("WORKER OK", flush=True)

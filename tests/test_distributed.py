@@ -68,3 +68,6 @@ def test_two_process_sharded_train_and_generate():
     # SPMD agreement: identical loss and identical greedy tokens
     assert field(outs[0][1], "TRAIN") == field(outs[1][1], "TRAIN")
     assert field(outs[0][1], "GEN") == field(outs[1][1], "GEN")
+    # the pipeline conveyor ran ACROSS the process boundary (stage 0 on
+    # proc 0, stage 1 on proc 1; ppermutes over DCN) with agreeing loss
+    assert field(outs[0][1], "PPTRAIN") == field(outs[1][1], "PPTRAIN")
