@@ -48,23 +48,48 @@ def init_backend(retries: int = 4, backoff_s: float = 20.0):
 
     --cpu / GOFR_BENCH_CPU=1 forces the host backend via jax.config (env
     vars are too late here: the ambient sitecustomize pins JAX_PLATFORMS
-    at interpreter boot)."""
+    at interpreter boot).
+
+    A watchdog guards the HANG failure mode (observed r03: the tunnel
+    spent hours alternating ~25-minute silent init hangs with
+    UNAVAILABLE errors): if init hasn't finished within
+    GOFR_BENCH_INIT_BUDGET_S (default 600 s), the process emits a
+    structured error line and exits 0 — an external timeout-kill would
+    leave no JSON at all."""
+    import threading
+
     import jax
 
     if "--cpu" in sys.argv[1:] or os.environ.get("GOFR_BENCH_CPU"):
         jax.config.update("jax_platforms", "cpu")
 
+    done = threading.Event()
+    budget = float(os.environ.get("GOFR_BENCH_INIT_BUDGET_S", "600"))
+
+    def watchdog():
+        if not done.wait(budget):
+            emit({"metric": "llama3_8b_int8_decode_tok_s_chip",
+                  "value": 0.0, "unit": "tok/s", "vs_baseline": 0.0,
+                  "error": f"backend init hung > {budget:.0f}s "
+                           "(tunnel outage; no grant acquired)"})
+            os._exit(0)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+
     last = None
-    for attempt in range(retries):
-        try:
-            return jax.devices()
-        except Exception as e:  # backend init failure — retry after backoff
-            last = e
-            log(f"  backend init attempt {attempt + 1}/{retries} failed: "
-                f"{type(e).__name__}: {str(e)[:200]}")
-            if attempt + 1 < retries:
-                time.sleep(backoff_s * (attempt + 1))
-    raise last
+    try:
+        for attempt in range(retries):
+            try:
+                return jax.devices()
+            except Exception as e:  # backend init failure — retry/backoff
+                last = e
+                log(f"  backend init attempt {attempt + 1}/{retries} failed: "
+                    f"{type(e).__name__}: {str(e)[:200]}")
+                if attempt + 1 < retries:
+                    time.sleep(backoff_s * (attempt + 1))
+        raise last
+    finally:
+        done.set()  # success OR clean failure: the watchdog stands down
 
 
 def int8_random_params(cfg, key) -> dict:
