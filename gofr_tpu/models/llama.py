@@ -384,6 +384,14 @@ def _causal_scan(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     valid = positions < lengths[:, None]
     constrain = constrain or (lambda x: x)
+    # Gather the per-token rope slices ONCE, outside the layer scan, and
+    # pin them to the activation layout (data, sp, None). Gathering inside
+    # each layer left the [B, S, hd/2] result's sharding to the
+    # partitioner, which chose a feature-dim split and paid an
+    # involuntary full-remat (replicate + repartition) per step to get
+    # back to the (data, sp) layout — see apply_rope.
+    cos_g = constrain(cos[positions])
+    sin_g = constrain(sin[positions])
 
     if attend_override is not None:
         def attend(q, k, v):
@@ -401,7 +409,7 @@ def _causal_scan(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     x = constrain(params["embedding"][tokens].astype(cfg.jdtype))
 
     def body(x, layer_w):
-        x, kv, probs = _layer(x, layer_w, cfg, cos, sin, positions,
+        x, kv, probs = _layer(x, layer_w, cfg, cos_g, sin_g, None,
                               kv_write=lambda k, v: (k, v), attend=attend,
                               valid=valid, adapter=adapter)
         # Training drops the per-layer k/v so the scan never materializes

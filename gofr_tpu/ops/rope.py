@@ -37,15 +37,27 @@ def rope_frequencies(head_dim: int, max_seq: int, theta: float = 500000.0,
 
 
 def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
-               positions: jnp.ndarray) -> jnp.ndarray:
+               positions: jnp.ndarray | None) -> jnp.ndarray:
     """Rotate ``x`` [..., seq, heads, head_dim] by per-token positions.
 
     ``positions`` is [..., seq] int32 — explicit positions (not an offset)
     so continuous batching can give every sequence its own cursor.
+
+    ``positions=None`` means ``cos``/``sin`` are already per-token
+    ([..., seq, hd/2], i.e. pre-gathered by the caller). Sharded forwards
+    use this to gather ONCE outside the layer scan under an activation
+    sharding constraint — gathering inside each layer let GSPMD pick a
+    feature-dim sharding for the [B, S, hd/2] result and then
+    involuntarily full-rematerialize it back to the (data, sp) layout
+    every step (the MULTICHIP_r03 spmd_partitioner warnings).
     """
     dtype = x.dtype
-    c = cos[positions][..., :, None, :]  # [..., seq, 1, hd/2]
-    s = sin[positions][..., :, None, :]
+    if positions is None:
+        c = cos[..., :, None, :]             # [..., seq, 1, hd/2]
+        s = sin[..., :, None, :]
+    else:
+        c = cos[positions][..., :, None, :]  # [..., seq, 1, hd/2]
+        s = sin[positions][..., :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
     return out.astype(dtype)

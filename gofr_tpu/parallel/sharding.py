@@ -9,7 +9,7 @@ source of truth.
 Rule set (Megatron-style TP + ZeRO-3-style fsdp, both expressed as specs):
   column-parallel  [L, D, out]  (wq/wk/wv/w_gate/w_up/w_in) → (None, fsdp, tp)
   row-parallel     [L, in, D]   (wo/w_down/w_out)           → (None, tp, fsdp)
-  embeddings       [V, D]                                    → (tp, fsdp)
+  embeddings       [V, D]                                    → ((tp, fsdp), None)
   lm_head          [D, V]                                    → (fsdp, tp)
   norms/biases                                               → replicated/minor
 Int8 `QuantizedLinear` leaves shard like their parent weight; the per-output
@@ -59,7 +59,16 @@ def spec_for(name: str, ndim: int, stacked: bool = False) -> P:
     if name in _ROW_BIAS:
         return P(lead, AXIS_FSDP) if ndim == 2 else P(AXIS_FSDP)
     if name == "embedding":
-        return P(AXIS_TP, AXIS_FSDP)
+        # Vocab over (tp, fsdp), FEATURE REPLICATED. Sharding the feature
+        # dim (the r1–r3 layout: P(tp, fsdp)) made every token-embedding
+        # gather inherit a feature-split output that GSPMD could only
+        # reshard to the (data, sp) activation layout by involuntary full
+        # rematerialization — an all-gather of [B, S, D] per train step
+        # (the MULTICHIP_r03 spmd_partitioner warnings). A vocab-only
+        # shard partitions the gather as local-lookup + mask + psum and
+        # the output is born replicated, so the activation constraint is
+        # a free slice.
+        return P((AXIS_TP, AXIS_FSDP), None)
     if name == "lm_head":
         return P(AXIS_FSDP, AXIS_TP)
     if name in ("pos_embedding", "patch_proj", "pooler_w", "head"):

@@ -62,3 +62,10 @@ def test_dryrun_self_provisions_in_driver_environment():
         cwd=repo, env=env, capture_output=True, text=True, timeout=budget)
     assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-2000:])
     assert "OK" in r.stdout
+    # The fsdp×sp×tp train step must partition WITHOUT involuntary full
+    # rematerialization (MULTICHIP_r03 tail: the feature-sharded embedding
+    # table made GSPMD replicate the [B, S, D] token-embedding gather
+    # every step). The warning is emitted by spmd_partitioner.cc on the
+    # child's stderr, which passes through here — grep it like the driver
+    # artifact's tail would show it.
+    assert "full rematerialization" not in r.stderr, r.stderr[-3000:]
