@@ -188,7 +188,9 @@ def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
     Meshes with pp > 1 run the forward as a GPipe microbatch conveyor
     (parallel/pipeline.py) over ``n_microbatches`` (default 2*pp; the
     batch must divide by it), MoE aux loss included. pp composes with
-    dp/fsdp/ep/tp; pp+sp and pp+grouped-MoE-dispatch are rejected."""
+    dp/fsdp/ep/tp and with sp (the conveyor runs ring attention inside
+    each stage for long-context pipelining); only pp + grouped MoE
+    dispatch is rejected."""
     constrain = activation_constraint(mesh)
     moe = cfg.n_experts > 0
     pp = mesh.shape.get(AXIS_PP, 1)

@@ -63,6 +63,39 @@ def _copy_row(dst, src, dst_idx, src_idx):
         v_scale=cp(dst.v_scale, src.v_scale) if quant else None)
 
 
+def _copy_row_masked(dst, src, dst_idx, src_idx):
+    """GSPMD-friendly _copy_row for sharded engines. _copy_row's dynamic
+    slice/update puts a TRACED start index on the batch axis — the axis
+    kv_cache_specs shards over the data mesh axes — and GSPMD's only
+    lowering for that is replicating the whole cache (the same
+    involuntary-full-remat class as MULTICHIP_r03's embedding gather).
+    Mask-and-reduce instead: select the source row by one-hot mask and
+    sum over the batch axis (partitioned as local reduce + psum over the
+    data axes), then blend it into the destination row with an
+    elementwise where over a broadcast of the (replicated) row — every
+    op here partitions cleanly under any batch/tp sharding. Reads both
+    caches fully instead of one row each; that extra HBM stream is the
+    price of mesh support and stays well under one decode block."""
+    import jax.numpy as jnp
+
+    def cp(d, s):
+        sel_s = (jnp.arange(s.shape[1]) == src_idx)
+        sel_s = sel_s.reshape((1, -1) + (1,) * (s.ndim - 2))
+        # int8 KV sums exactly in int32 (one nonzero term per position)
+        acc = jnp.int32 if jnp.issubdtype(s.dtype, jnp.integer) else s.dtype
+        row = jnp.sum(jnp.where(sel_s, s, 0).astype(acc), axis=1,
+                      keepdims=True)                       # [L, 1, ...]
+        sel_d = (jnp.arange(d.shape[1]) == dst_idx)
+        sel_d = sel_d.reshape((1, -1) + (1,) * (d.ndim - 2))
+        return jnp.where(sel_d, row.astype(d.dtype), d)
+
+    quant = dst.k_scale is not None
+    return dst._replace(
+        k=cp(dst.k, src.k), v=cp(dst.v, src.v),
+        k_scale=cp(dst.k_scale, src.k_scale) if quant else None,
+        v_scale=cp(dst.v_scale, src.v_scale) if quant else None)
+
+
 class GenerationError(RuntimeError):
     pass
 
@@ -224,14 +257,14 @@ class GenerationEngine:
         # prompt-prefix KV. A hit replaces MXU prefill work for the
         # matched positions with one HBM row copy; the remainder (always
         # >= 1 token, so the first sample recomputes) prefills from the
-        # match point. Single-device engines only for now: the row copies
-        # use traced batch indices, which reshard poorly under GSPMD.
+        # match point. On mesh engines the pool shards like the serving
+        # cache and the row copies run mask-and-reduce (_copy_row_masked)
+        # instead of traced-index dynamic slices, which GSPMD could only
+        # lower by replicating the cache; the jits are built after the
+        # mesh block below, where the shardings exist.
         self._prefix_idx = None
         self._pool = None
         if prefix_cache_slots > 0:
-            if mesh is not None:
-                raise ValueError("prefix_cache_slots requires a "
-                                 "single-device engine (mesh=None)")
             from .prefix_cache import PrefixIndex
 
             self._prefix_idx = PrefixIndex(prefix_cache_slots)
@@ -239,8 +272,6 @@ class GenerationEngine:
                                           self.max_seq, dtype=kv_dtype)
             self._store_min = int(prefix_store_min
                                   or self.prompt_buckets[-1])
-            self._pool_load_jit = jax.jit(_copy_row, donate_argnums=(0,))
-            self._pool_store_jit = jax.jit(_copy_row, donate_argnums=(0,))
 
         # Prompt-lookup speculative decoding (greedy slots only): each
         # tick proposes K draft tokens per slot by matching the trailing
@@ -249,14 +280,11 @@ class GenerationEngine:
         # weights once and emits 1..K+1 tokens per slot. Misses cost a
         # normal decode tick (the engine falls back when no slot drafts,
         # any active slot samples, or a slot is within a window of
-        # capacity). Single-device engines only for now, like the
-        # prefix pool.
+        # capacity). Drafting is host-side numpy either way; on mesh
+        # engines the verify dispatch shards exactly like the decode
+        # step (batch over data axes, KV heads over tp).
         self._spec_k = max(0, int(spec_decode_k))
         if self._spec_k:
-            if mesh is not None:
-                raise ValueError("spec_decode_k requires a single-device "
-                                 "engine (mesh=None)")
-            self._verify_jit = jax.jit(self._verify_fn, donate_argnums=(0,))
             self._spec_windows = 0
             self._spec_emitted = 0
             # per-slot token history as preallocated buffers: _draft
@@ -308,12 +336,35 @@ class GenerationEngine:
             self._chunk_final_jit = jax.jit(self._chunk_final,
                                             donate_argnums=(0,),
                                             out_shardings=(rep, rep, cache_sh))
+            if self._prefix_idx is not None:
+                # pool shards like the serving cache (batch rows over the
+                # data axes when they divide, KV heads over tp); pinning
+                # out_shardings keeps donation aliasing across copies
+                pool_sh = kv_cache_specs(mesh, self._pool)
+                self._pool = jax.device_put(self._pool, pool_sh)
+                self._pool_load_jit = jax.jit(_copy_row_masked,
+                                              donate_argnums=(0,),
+                                              out_shardings=cache_sh)
+                self._pool_store_jit = jax.jit(_copy_row_masked,
+                                               donate_argnums=(0,),
+                                               out_shardings=pool_sh)
+            if self._spec_k:
+                self._verify_jit = jax.jit(self._verify_fn,
+                                           donate_argnums=(0,),
+                                           out_shardings=(rep, rep, rep,
+                                                          cache_sh))
         else:
             self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(0,))
             self._step_jit = jax.jit(self._step_fn, donate_argnums=(0,))
             self._chunk_mid_jit = jax.jit(self._chunk_mid, donate_argnums=(0,))
             self._chunk_final_jit = jax.jit(self._chunk_final,
                                             donate_argnums=(0,))
+            if self._prefix_idx is not None:
+                self._pool_load_jit = jax.jit(_copy_row, donate_argnums=(0,))
+                self._pool_store_jit = jax.jit(_copy_row, donate_argnums=(0,))
+            if self._spec_k:
+                self._verify_jit = jax.jit(self._verify_fn,
+                                           donate_argnums=(0,))
         self._thread = threading.Thread(target=self._loop, name="gofr-tpu-gen",
                                         daemon=True)
         self._thread.start()
@@ -539,6 +590,11 @@ class GenerationEngine:
         with self._admission_lock:
             if self._closed:
                 raise GenerationError("generation engine is closed")
+            if self._draining:
+                # drain() sets the flag under this lock; without this
+                # re-check a racing generate() could slip a request in
+                # after the drain snapshot and silently extend the window
+                raise GenerationError("generation engine is draining")
             self._pending.put(_Request(stream, prompt, max_new_tokens,
                                        temperature, top_k, eos_id,
                                        adapter=int(adapter)))
@@ -653,22 +709,27 @@ class GenerationEngine:
             raise GenerationError(
                 f"adapter slot {idx} invalid (1..{self._n_adapters - 1}; "
                 "slot 0 is the base no-op)")
-        if self._prefix_idx is not None:
-            # stored prefix KV was computed through the OLD adapter
-            # weights — restoring it after the swap would serve wrong
-            # attention keys (same hazard as cross-adapter reuse)
-            self._prefix_idx.invalidate_adapter(idx)
+        for name in tree:
+            if f"lora_a_{name}" not in self.params["layers"]:
+                raise GenerationError(f"unknown LoRA target {name!r}")
         with self._device_lock:
             layers = dict(self.params["layers"])
             for name, (a, b) in tree.items():
                 ka, kb = f"lora_a_{name}", f"lora_b_{name}"
-                if ka not in layers:
-                    raise GenerationError(f"unknown LoRA target {name!r}")
                 layers[ka] = layers[ka].at[:, idx].set(
                     jnp.asarray(a, layers[ka].dtype))
                 layers[kb] = layers[kb].at[:, idx].set(
                     jnp.asarray(b, layers[kb].dtype))
             self.params = {**self.params, "layers": layers}
+            if self._prefix_idx is not None:
+                # Stored prefix KV was computed through the OLD adapter
+                # weights — restoring it after the swap would serve
+                # wrong attention keys (same hazard as cross-adapter
+                # reuse). Invalidating inside the device lock, AFTER the
+                # swap, serializes against _iteration's match/store: no
+                # old-weight entry can be stored after we invalidate,
+                # and PrefixIndex is only ever mutated under this lock.
+                self._prefix_idx.invalidate_adapter(idx)
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Graceful shutdown, phase 1: refuse NEW requests (generate()

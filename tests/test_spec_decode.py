@@ -195,11 +195,27 @@ def test_spec_coverage_gate_mixed_workload(params):
         eng.close()
 
 
-def test_spec_mesh_rejected(params):
+@pytest.mark.parametrize("axes", [{"dp": 2, "fsdp": 2, "tp": 2},
+                                  {"tp": 8}])
+def test_spec_mesh_engine_matches_plain(params, axes):
+    """Sharded engines support speculative decoding (VERDICT r3 #4):
+    drafting stays host-side numpy, the verify dispatch shards exactly
+    like the decode step (batch over data axes, KV heads over tp,
+    out_shardings pinned so cache donation aliases). Streams must equal
+    the unsharded plain engine's token for token and the verify pass
+    must actually run (windows > 0)."""
     from gofr_tpu import parallel
 
-    mesh = parallel.make_mesh(dp=8)
-    with pytest.raises(ValueError, match="single-device"):
-        GenerationEngine(TINY, parallel.shard_params(params, mesh),
-                         slots=2, max_seq=64, prompt_buckets=(8,),
-                         mesh=mesh, spec_decode_k=2)
+    rep = [7, 9, 7, 9, 7, 9, 7, 9, 7, 9]           # lookup hits
+    want = _ref_stream(params, rep, 24)
+    mesh = parallel.make_mesh(**axes)
+    eng = GenerationEngine(TINY, parallel.shard_params(params, mesh),
+                           slots=2, max_seq=64, prompt_buckets=(8, 16),
+                           mesh=mesh, spec_decode_k=3)
+    try:
+        got = eng.generate(rep, max_new_tokens=24).tokens()
+        assert got == want
+        st = eng.stats()["spec_decode"]
+        assert st["emitted"] >= st["windows"] > 0
+    finally:
+        eng.close()
