@@ -48,6 +48,13 @@ class GRPCChannel:
     def __init__(self, host: str, port: int, connect_timeout: float = 5.0):
         self.target = f"{host}:{port}"
         self.sock = socket.create_connection((host, port), connect_timeout)
+        # create_connection leaves connect_timeout as the PER-READ timeout;
+        # a server-stream gap longer than it (first-request compile, long
+        # decode) would kill the whole channel with a reader TimeoutError.
+        # Reads block indefinitely; close() wakes the reader via the
+        # shutdown-then-close in FrameIO.close, and per-CALL deadlines are
+        # carried by grpc-timeout, not the socket.
+        self.sock.settimeout(None)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.io = h2.FrameIO(self.sock)
         self.encoder = Encoder()

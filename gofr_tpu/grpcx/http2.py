@@ -148,14 +148,24 @@ class FrameIO:
     # -- writes (any thread) -------------------------------------------------
     def send_frame(self, type_: int, flags: int, stream_id: int,
                    payload: bytes = b"") -> None:
-        if len(payload) > self.peer_max_frame:
-            raise ConnectionError_(FRAME_SIZE_ERROR, "frame too large for peer")
-        head = (len(payload).to_bytes(3, "big") + bytes((type_, flags))
-                + stream_id.to_bytes(4, "big"))
+        self.send_frames([(type_, flags, stream_id, payload)])
+
+    def send_frames(self, frames) -> None:
+        """Write one or more frames in ONE sendall — the first-token
+        fast path coalesces the response HEADERS and the first DATA
+        frame so a streaming client sees one packet (one syscall, one
+        wakeup) instead of two back-to-back."""
+        buf = bytearray()
+        for type_, flags, stream_id, payload in frames:
+            if len(payload) > self.peer_max_frame:
+                raise ConnectionError_(FRAME_SIZE_ERROR,
+                                       "frame too large for peer")
+            buf += (len(payload).to_bytes(3, "big") + bytes((type_, flags))
+                    + stream_id.to_bytes(4, "big") + payload)
         with self._wlock:
             if self._closed:
                 raise EOFError("connection closed")
-            self.sock.sendall(head + payload)
+            self.sock.sendall(buf)
 
     def close(self) -> None:
         with self._wlock:

@@ -275,8 +275,15 @@ class _Connection:
             flags = h2.FLAG_END_HEADERS | (h2.FLAG_END_STREAM if end_stream else 0)
             self.io.send_frame(h2.HEADERS, flags, st.id, block)
 
-    def send_message(self, st: _Stream, payload: bytes) -> None:
-        """One gRPC length-prefixed message as flow-controlled DATA."""
+    def send_message(self, st: _Stream, payload: bytes,
+                     headers=None) -> None:
+        """One gRPC length-prefixed message as flow-controlled DATA.
+
+        ``headers``: response headers to coalesce with the FIRST data
+        frame in a single socket write — the first-token fast path for
+        streaming RPCs (one packet on the wire instead of HEADERS then
+        DATA; saves a syscall and a client-reader wakeup on the latency
+        path the BASELINE gRPC-TTFT target measures)."""
         data = b"\x00" + len(payload).to_bytes(4, "big") + payload
         view = memoryview(data)
         while view:
@@ -287,7 +294,20 @@ class _Connection:
             n = self.conn_window.consume(n_stream, timeout=30.0)
             if n < n_stream:  # refund stream credit the connection couldn't cover
                 st.send_window.credit(n_stream - n)
-            self.io.send_frame(h2.DATA, 0, st.id, bytes(view[:n]))
+            if headers is not None:
+                with self._enc_lock:  # HPACK is stateful: encode+send in order
+                    block = self.encoder.encode(headers)
+                    self.io.send_frames([
+                        (h2.HEADERS, h2.FLAG_END_HEADERS, st.id, block),
+                        (h2.DATA, 0, st.id, bytes(view[:n]))])
+                # flag only AFTER the frames hit the wire: an earlier
+                # flow-control timeout/cancel must leave headers_sent
+                # False so _finish still emits a full trailers-only
+                # response (:status + grpc-status), not bare trailers
+                st.headers_sent = True
+                headers = None
+            else:
+                self.io.send_frame(h2.DATA, 0, st.id, bytes(view[:n]))
             view = view[n:]
 
     def close_stream(self, st: _Stream) -> None:
@@ -349,6 +369,16 @@ class GRPCServer:
         self._stopping = True
         if self._sock is not None:
             try:
+                # shutdown() BEFORE close(): on Linux a thread blocked in
+                # accept() is NOT woken by close() from another thread
+                # (the in-progress syscall pins the open file
+                # description) — shutdown is what interrupts it. Without
+                # this every stopped server leaked its accept thread
+                # (caught by the conftest session-teardown assertion).
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
                 self._sock.close()
             except OSError:
                 pass
@@ -357,6 +387,8 @@ class GRPCServer:
         for c in conns:
             c._send_goaway(h2.NO_ERROR)
             c.io.close()
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
 
     # -- RPC dispatch --------------------------------------------------------
     def _handle_stream(self, conn: _Connection, st: _Stream) -> None:
@@ -466,15 +498,16 @@ class GRPCServer:
         if method.server_streaming:
             for item in result:
                 check_alive()
-                if not st.headers_sent:
-                    conn.send_headers(st, _response_headers())
-                    st.headers_sent = True
-                conn.send_message(st, method.response_codec.serialize(item))
+                payload = method.response_codec.serialize(item)
+                # coalesced HEADERS+DATA: one write for the first token;
+                # send_message flips headers_sent once they're on the wire
+                conn.send_message(st, payload,
+                                  headers=None if st.headers_sent
+                                  else _response_headers())
         else:
             check_alive()
-            conn.send_headers(st, _response_headers())
-            st.headers_sent = True
-            conn.send_message(st, method.response_codec.serialize(result))
+            payload = method.response_codec.serialize(result)
+            conn.send_message(st, payload, headers=_response_headers())
         return svc.OK, ""
 
     def _finish(self, conn: _Connection, st: _Stream, status: int,
