@@ -1,0 +1,36 @@
+#!/bin/bash
+# Retry `python bench.py` until one clean (error-free, value>0) line lands,
+# then save it to BENCH_CANDIDATE.json with a timestamp. Rationale: the
+# axon tunnel outages (r03) are multi-hour but intermittent — measuring
+# once at round end loses the round; retrying across the whole round
+# captures numbers whenever a grant appears (VERDICT r3 "Next round" #1).
+#
+# Usage: nohup tools/bench_retry.sh > /tmp/bench_retry.log 2>&1 &
+cd "$(dirname "$0")/.."
+ATTEMPT=0
+while true; do
+  ATTEMPT=$((ATTEMPT + 1))
+  echo "=== attempt $ATTEMPT at $(date -u +%FT%TZ) ===" >&2
+  OUT=$(GOFR_BENCH_INIT_BUDGET_S=480 timeout 3600 python bench.py 2>/tmp/bench_attempt.stderr)
+  LINE=$(echo "$OUT" | tail -1)
+  echo "$LINE" >&2
+  if echo "$LINE" | python -c '
+import json, sys
+d = json.loads(sys.stdin.readline())
+ok = "error" not in d and d.get("value", 0) > 0 and "partial" not in d
+sys.exit(0 if ok else 1)
+' 2>/dev/null; then
+    python - "$LINE" <<'EOF'
+import json, sys, time
+d = json.loads(sys.argv[1])
+d["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+with open("BENCH_CANDIDATE.json", "w") as f:
+    json.dump(d, f, indent=2)
+print("saved BENCH_CANDIDATE.json")
+EOF
+    echo "=== SUCCESS at $(date -u +%FT%TZ) after $ATTEMPT attempts ===" >&2
+    exit 0
+  fi
+  tail -5 /tmp/bench_attempt.stderr >&2
+  sleep 180
+done
