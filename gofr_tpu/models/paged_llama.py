@@ -1,0 +1,233 @@
+"""Paged (block-pool) KV cache for Llama-family serving.
+
+The contiguous ``llama.KVCache`` reserves [B, Smax] rows per slot; HBM
+capacity caps the decode batch long before the MXU or the weight stream
+does (8B int8 at batch 128 x 1024: ~9.7 GB KV on top of 8 GB weights —
+over a v5e's 16 GB). This module keeps the same model math (the layer
+scan calls the SAME ``llama._layer``) but stores KV in a shared pool of
+fixed T-token blocks with a per-slot block table:
+
+    k_pool/v_pool  [L, N, T, KV, hd]   (int8 with [L, N, T, KV] scales)
+    table          [B, MB] int32       host-owned, passed per dispatch
+    lengths        [B]    int32        device state, donated
+
+TPU-first constraints drive every choice: N/T/MB are static so one
+program serves all occupancies; the table is data, not shape; block
+boundaries are crossed with host-side allocation between fused decode
+blocks (the device never allocates); attention runs the scalar-prefetch
+Pallas kernel (ops.paged_attention) whose HBM stream is proportional to
+LIVE tokens, with a dense-gather jnp reference for CPU/tests.
+
+Table invariants (maintained by the engine's allocator):
+  - entries for live logical blocks hold real pool block ids;
+  - entries past the live range repeat the LAST live block (clamping —
+    the kernel's DMA-skip), or block 0 for empty/retired slots;
+  - block 0 is a reserved trash block no slot ever owns: retired slots'
+    frozen-cursor garbage writes land there.
+
+Reference provenance: the reference serves via torch/CUDA allocators
+with pointer indirection; this is the TPU-native equivalent (SURVEY.md
+§2 TPU serving rows; design cross-checked against the public
+PagedAttention idea, rebuilt for static shapes + Mosaic).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.paged_attention import paged_attention_auto
+from . import llama
+from .common import ModelConfig
+from .llama import (_layer, _logits, get_rope_tables,
+                    multi_request_serving_config, quantize_kv)
+
+
+class PagedKVCache(NamedTuple):
+    k: jnp.ndarray        # [L, N, T, KV, hd]
+    v: jnp.ndarray        # [L, N, T, KV, hd]
+    lengths: jnp.ndarray  # [B] int32 — live tokens per slot
+    k_scale: jnp.ndarray | None = None  # [L, N, T, KV] f32 (int8 pools)
+    v_scale: jnp.ndarray | None = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def n_blocks(self) -> int:
+        return self.k.shape[1]
+
+
+def init_paged_cache(cfg: ModelConfig, slots: int, n_blocks: int,
+                     block_size: int = 128, dtype=None) -> PagedKVCache:
+    """Pool of ``n_blocks`` blocks (block 0 is the reserved trash block —
+    size the pool as usable_tokens // block_size + 1). ``dtype=jnp.int8``
+    allocates the quantized pool with scale planes."""
+    dtype = dtype or cfg.jdtype
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.n_kv_heads,
+             cfg.head_dim)
+    quant = jnp.dtype(dtype) == jnp.int8
+    return PagedKVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        lengths=jnp.zeros((slots,), jnp.int32),
+        k_scale=jnp.zeros(shape[:-1], jnp.float32) if quant else None,
+        v_scale=jnp.zeros(shape[:-1], jnp.float32) if quant else None,
+    )
+
+
+def paged_decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
+                      cache: PagedKVCache, table: jnp.ndarray,
+                      rope_tables=None, flash: bool = True,
+                      adapter=None) -> tuple[jnp.ndarray, PagedKVCache]:
+    """One decode step for tokens [B] against the paged pool.
+
+    ``table`` [B, MB] int32: clamped block ids (see module docstring).
+    Returns (logits [B, V] f32, cache with lengths+1). Same structure as
+    llama.decode_step (reference hot loop): pool READ-ONLY inside the
+    layer scan, the new token's [L, B, KV, hd] written by one scatter
+    after it.
+
+    CAPACITY CONTRACT: the caller guarantees each slot's current block
+    (table[b, lengths[b] // T]) is allocated and lengths < MB*T; the
+    write position is clamped into the table's range, so a violated
+    contract corrupts only that slot's own (or the trash) block.
+    ``flash=False`` routes attention through the dense-gather reference
+    (CPU tests; the kernel gate also falls back off-TPU)."""
+    cfg = multi_request_serving_config(cfg)
+    B = tokens.shape[0]
+    T = cache.block_size
+    mb = table.shape[1]
+    max_seq = mb * T
+    cos, sin = rope_tables or get_rope_tables(cfg, max_seq)
+    positions = cache.lengths[:, None]
+    lengths = cache.lengths
+
+    x = params["embedding"][tokens[:, None]].astype(cfg.jdtype)
+
+    attn = paged_attention_auto if flash else _reference_attention
+
+    def body(x, xs):
+        layer_w, k_layer, v_layer, ks_layer, vs_layer = xs
+
+        def attend(q, k_new, v_new):
+            return attn(q, k_layer, v_layer, k_new, v_new, table,
+                        lengths, ks_layer, vs_layer)
+
+        x, kv_tok, _ = _layer(x, layer_w, cfg, cos, sin, positions,
+                              kv_write=lambda k, v: (k, v), attend=attend,
+                              adapter=adapter)
+        return x, kv_tok
+
+    x, (k_toks, v_toks) = jax.lax.scan(
+        body, x, (params["layers"], cache.k, cache.v,
+                  cache.k_scale, cache.v_scale))
+    # one scatter for all layers into each slot's current block: pool
+    # coords (block, offset) = (table[b, len // T], len % T)
+    blk = jnp.take_along_axis(
+        table, jnp.minimum(lengths // T, mb - 1)[:, None], axis=1)[:, 0]
+    # past-capacity cursors write to the trash block — the paged mirror
+    # of the contiguous scatter's mode="drop" (without this the offset
+    # would wrap into the slot's own live last block)
+    blk = jnp.where(lengths < mb * T, blk, 0)
+    off = lengths % T
+    k_tok, v_tok = k_toks[:, :, 0], v_toks[:, :, 0]      # [L, B, KV, hd]
+    if cache.quantized:
+        qk, sk = quantize_kv(k_tok)
+        qv, sv = quantize_kv(v_tok)
+        new = cache._replace(
+            k=cache.k.at[:, blk, off].set(qk, mode="drop"),
+            v=cache.v.at[:, blk, off].set(qv, mode="drop"),
+            k_scale=cache.k_scale.at[:, blk, off].set(sk, mode="drop"),
+            v_scale=cache.v_scale.at[:, blk, off].set(sv, mode="drop"),
+            lengths=lengths + 1)
+    else:
+        new = cache._replace(
+            k=cache.k.at[:, blk, off].set(k_tok.astype(cache.k.dtype),
+                                          mode="drop"),
+            v=cache.v.at[:, blk, off].set(v_tok.astype(cache.v.dtype),
+                                          mode="drop"),
+            lengths=lengths + 1)
+    return _logits(params, cfg, x[:, 0]), new
+
+
+def _reference_attention(q, k_pool, v_pool, k_new, v_new, table, lengths,
+                         k_scale, v_scale):
+    from ..ops.paged_attention import paged_attention_reference
+
+    return paged_attention_reference(q, k_pool, v_pool, k_new, v_new,
+                                     table, lengths, k_scale, v_scale)
+
+
+def write_prompt_blocks(cache: PagedKVCache, k_stack, v_stack,
+                        blocks: jnp.ndarray, length) -> PagedKVCache:
+    """Write one admitted prompt's KV stacks [L, 1, S, KV, hd] into its
+    allocated blocks. ``blocks`` [ceil(S/T)] int32 (traced values, static
+    count — one program per prompt bucket); ``length`` is the true prompt
+    length: rows in [length, S) are bucket padding — they land in the
+    slot's own blocks past its cursor, invisible behind ``lengths`` and
+    overwritten as decode advances (the same contract as the contiguous
+    cache's write_kv)."""
+    T = cache.block_size
+    S = k_stack.shape[2]
+    n_wr = (S + T - 1) // T
+    k, v, ks, vs = cache.k, cache.v, cache.k_scale, cache.v_scale
+    quant = cache.quantized
+    if quant:
+        qk_all, sk_all = quantize_kv(k_stack)
+        qv_all, sv_all = quantize_kv(v_stack)
+    for j in range(n_wr):
+        lo, hi = j * T, min((j + 1) * T, S)
+        bj = blocks[j]
+        if quant:
+            k = jax.lax.dynamic_update_slice(
+                k, qk_all[:, 0, lo:hi][:, None], (0, bj, 0, 0, 0))
+            v = jax.lax.dynamic_update_slice(
+                v, qv_all[:, 0, lo:hi][:, None], (0, bj, 0, 0, 0))
+            ks = jax.lax.dynamic_update_slice(
+                ks, sk_all[:, 0, lo:hi][:, None], (0, bj, 0, 0))
+            vs = jax.lax.dynamic_update_slice(
+                vs, sv_all[:, 0, lo:hi][:, None], (0, bj, 0, 0))
+        else:
+            k = jax.lax.dynamic_update_slice(
+                k, k_stack[:, 0, lo:hi][:, None].astype(k.dtype),
+                (0, bj, 0, 0, 0))
+            v = jax.lax.dynamic_update_slice(
+                v, v_stack[:, 0, lo:hi][:, None].astype(v.dtype),
+                (0, bj, 0, 0, 0))
+    return cache._replace(k=k, v=v, k_scale=ks, v_scale=vs)
+
+
+class BlockAllocator:
+    """Host-side free-list over pool blocks 1..N-1 (block 0 is the
+    reserved trash block). Thread-compatible: the engine calls it only
+    from the serving loop under its device lock."""
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError("paged pool needs >= 2 blocks "
+                             "(block 0 is reserved)")
+        self._free = list(range(n_blocks - 1, 0, -1))
+        self.n_blocks = n_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """n block ids, or None (nothing allocated) if the pool can't
+        cover the request — the caller picks the eviction policy."""
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, blocks) -> None:
+        self._free.extend(blocks)

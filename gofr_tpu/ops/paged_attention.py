@@ -1,0 +1,199 @@
+"""Paged decode attention over a block-pool KV cache.
+
+The contiguous serving cache allocates [B, Smax] KV rows per slot — at
+batch 128 x 1024 that is ~9.7 GB of int8 KV + f32 scales on top of the
+8 GB weight stream, which does not fit a v5e chip. Paging replaces the
+per-slot rows with a shared pool of fixed [T]-token blocks plus a
+per-slot block TABLE (vLLM's design, rebuilt TPU-first): shapes stay
+static, the pool is sized to the expected TOTAL live tokens instead of
+batch x max_seq, and slots grow/free blocks host-side.
+
+The kernel is ops.flash_decode's v2 kernel (block-diagonal GQA, online
+softmax, int8 tiles upcast in-register) with ONE change: the K/V/scale
+index maps look the next tile up in a scalar-prefetched block table
+instead of walking the sequence linearly. Two properties the engine's
+host side maintains make this fast and safe:
+
+  - table rows are CLAMPED: entries past a slot's last live block repeat
+    the last live block. Pallas skips the DMA when consecutive grid
+    steps map to the same block, so a slot's HBM stream is proportional
+    to its LIVE length, not the grid's max — and the in-kernel
+    ``pl.when(si * T < length)`` skips the compute.
+  - retired slots' rows point at block 0, a reserved trash block no live
+    slot ever owns, so their frozen-cursor garbage writes land nowhere.
+
+The jnp reference (``paged_attention_reference``) gathers each slot's
+blocks into a dense view and calls the exact reference attention — the
+numerics oracle for interpret-mode tests and the CPU/sharded fallback.
+
+Reference provenance: the reference framework serves its models through
+torch+CUDA paged allocators; this module is the TPU-native equivalent
+(static block lattice + scalar-prefetch index maps instead of pointer
+indirection). See SURVEY.md §2 (TPU serving rows).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import decode_attention_appended
+from .flash_decode import _LANES, _decode_kernel
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_decode_cache(q, k_pool, v_pool, table, lengths, k_scale, v_scale,
+                        *, interpret: bool = False):
+    """Pool-side running stats: (acc [B,H,KV*D] f32 unnormalized,
+    m [B,H,LANES], l [B,H,LANES]) over each slot's valid positions.
+
+    q: [B, H, D]; k_pool/v_pool: [N, T, KV, D] (int8 with scales
+    [N, T, KV], or dense); table: [B, MB] int32 CLAMPED block ids;
+    lengths: [B] int32 valid tokens per slot."""
+    b, h, d = q.shape
+    n_blocks, block_t, n_kv = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
+    mb = table.shape[1]
+    g = h // n_kv
+    quant = k_scale is not None
+    if not quant:
+        k_scale = jnp.ones((n_blocks, block_t, n_kv), jnp.float32)
+        v_scale = jnp.ones((n_blocks, block_t, n_kv), jnp.float32)
+    # [N, T, KV] -> [N, KV, T]: the [KV, T] tile broadcasts to [H, T]
+    # along sublanes for free inside the kernel
+    ks_t = jnp.swapaxes(k_scale, 1, 2).astype(jnp.float32)
+    vs_t = jnp.swapaxes(v_scale, 1, 2).astype(jnp.float32)
+    # block-diagonal query expansion (see ops.flash_decode docstring)
+    qh = (q * (d ** -0.5)).reshape(b, n_kv, g, d)
+    eye = jnp.eye(n_kv, dtype=q.dtype)
+    q_bd = jnp.einsum("bkgd,kK->bgkKd", qh, eye,
+                      preferred_element_type=q.dtype)
+    q_bd = jnp.swapaxes(q_bd, 1, 2).reshape(b, h, n_kv * d)
+
+    def kernel(lengths_ref, table_ref, *refs):
+        # the table is consumed by the index maps only; the compute body
+        # is EXACTLY the flash-decode kernel (si is the logical block
+        # index either way, so its position masking carries over)
+        del table_ref
+        _decode_kernel(lengths_ref, *refs, block_s=block_t, n_kv=n_kv,
+                       quant=quant)
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,  # lengths, table
+            grid=(b, mb),
+            in_specs=[
+                pl.BlockSpec((1, h, n_kv * d),
+                             lambda bi, si, lens, tab: (bi, 0, 0)),
+                # the paged difference: the next K/V/scale tile is
+                # table[bi, si], not si — clamped rows repeat their last
+                # block so Pallas skips the DMA past a slot's live length
+                pl.BlockSpec((1, block_t, n_kv, d),
+                             lambda bi, si, lens, tab: (tab[bi, si], 0, 0, 0)),
+                pl.BlockSpec((1, block_t, n_kv, d),
+                             lambda bi, si, lens, tab: (tab[bi, si], 0, 0, 0)),
+                pl.BlockSpec((1, n_kv, block_t),
+                             lambda bi, si, lens, tab: (tab[bi, si], 0, 0)),
+                pl.BlockSpec((1, n_kv, block_t),
+                             lambda bi, si, lens, tab: (tab[bi, si], 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, h, n_kv * d),
+                             lambda bi, si, lens, tab: (bi, 0, 0)),
+                pl.BlockSpec((1, h, _LANES),
+                             lambda bi, si, lens, tab: (bi, 0, 0)),
+                pl.BlockSpec((1, h, _LANES),
+                             lambda bi, si, lens, tab: (bi, 0, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, n_kv * d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), table.astype(jnp.int32),
+      q_bd, k_pool, v_pool, ks_t, vs_t)
+    acc = acc.reshape(b, n_kv, g, n_kv, d)
+    acc = jnp.einsum("bkgKd,kK->bkgd", acc,
+                     jnp.eye(n_kv, dtype=acc.dtype)).reshape(b, h, d)
+    return acc, m, l
+
+
+def paged_decode_attention(q, k_pool, v_pool, k_new, v_new, table, lengths,
+                           k_scale=None, v_scale=None, *,
+                           interpret: bool = False) -> jnp.ndarray:
+    """Single-token decode attention against a paged pool.
+
+    q: [B, 1, H, D]; k_pool/v_pool: [N, T, KV, D]; k_new/v_new:
+    [B, 1, KV, D] (bf16, this step's fresh KV — not yet in the pool);
+    table [B, MB] clamped block ids; lengths [B] EXCLUDING the current
+    token. Returns [B, 1, H, D] in q.dtype."""
+    b, _, h, d = q.shape
+    n_kv = k_pool.shape[2]
+    g = h // n_kv
+    acc, m, l = _paged_decode_cache(q[:, 0], k_pool, v_pool, table, lengths,
+                                    k_scale, v_scale, interpret=interpret)
+    m = m[..., 0]
+    l = l[..., 0]
+    # fold the appended token (exact flash combination; see flash_decode)
+    qh = (q[:, 0] * (d ** -0.5)).reshape(b, n_kv, g, d)
+    s_new = jnp.einsum("bkgd,bkd->bkg", qh,
+                       k_new[:, 0].astype(qh.dtype),
+                       preferred_element_type=jnp.float32).reshape(b, h)
+    m_t = jnp.maximum(m, s_new)
+    alpha = jnp.exp(m - m_t)
+    beta = jnp.exp(s_new - m_t)
+    l_t = l * alpha + beta
+    v_rep = jnp.repeat(v_new[:, 0], g, axis=1)
+    out = (acc * alpha[..., None]
+           + beta[..., None] * v_rep.astype(jnp.float32)) / l_t[..., None]
+    return out.astype(q.dtype).reshape(b, 1, h, d)
+
+
+def gather_blocks(pool, table):
+    """Dense per-slot view of a paged buffer: [N, T, ...] gathered by
+    table [B, MB] -> [B, MB*T, ...]. Materializes the full dense cache —
+    the REFERENCE/fallback path only (tests, CPU); the kernel never does
+    this."""
+    g = pool[table]                       # [B, MB, T, ...]
+    return g.reshape((g.shape[0], g.shape[1] * g.shape[2]) + g.shape[3:])
+
+
+def paged_attention_reference(q, k_pool, v_pool, k_new, v_new, table,
+                              lengths, k_scale=None, v_scale=None):
+    """Numerics oracle: gather the table's dense view, run the exact
+    reference decode attention."""
+    k_dense = gather_blocks(k_pool, table)
+    v_dense = gather_blocks(v_pool, table)
+    ks = gather_blocks(k_scale, table) if k_scale is not None else None
+    vs = gather_blocks(v_scale, table) if v_scale is not None else None
+    return decode_attention_appended(q, k_dense, v_dense, k_new, v_new,
+                                     lengths, ks, vs)
+
+
+def _kernel_ok(q, k_pool) -> bool:
+    from .flash import tpu_backend_ok
+
+    b, _, h, d = q.shape
+    block_t, n_kv = k_pool.shape[1], k_pool.shape[2]
+    if d % _LANES or h % n_kv or block_t % 8:
+        return False
+    return tpu_backend_ok()
+
+
+def paged_attention_auto(q, k_pool, v_pool, k_new, v_new, table, lengths,
+                         k_scale=None, v_scale=None, *,
+                         interpret: bool = False) -> jnp.ndarray:
+    """Kernel when backend+shapes allow, dense-gather reference otherwise."""
+    if interpret or _kernel_ok(q, k_pool):
+        return paged_decode_attention(q, k_pool, v_pool, k_new, v_new,
+                                      table, lengths, k_scale, v_scale,
+                                      interpret=interpret)
+    return paged_attention_reference(q, k_pool, v_pool, k_new, v_new,
+                                     table, lengths, k_scale, v_scale)

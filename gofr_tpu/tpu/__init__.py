@@ -32,15 +32,21 @@ Config keys (reference config style, pkg/gofr/config/config.go:3):
                       block's admission check; 0 disables)
   TPU_PREFIX_CACHE    prefix-KV pool rows (default 0 = off): stored
                       prompt prefixes restore as one HBM row copy
-                      instead of prefill compute (tpu/prefix_cache.py);
-                      single-device engines only
+                      instead of prefill compute (tpu/prefix_cache.py)
   TPU_PREFIX_MIN      min prompt length stored in the pool (default:
                       the largest prompt bucket)
   TPU_SPEC_DECODE     prompt-lookup speculative decoding: K draft
                       tokens per verify pass (default 0 = off). One
                       weight stream emits 1..K+1 tokens per greedy slot
-                      when its history's trailing n-gram repeats;
-                      single-device engines only
+                      when its history's trailing n-gram repeats
+  TPU_PAGED_BLOCKS    paged KV cache: pool blocks incl. the reserved
+                      trash block (default 0 = contiguous rows). Slots
+                      share fixed-size blocks via a block table, so HBM
+                      sizes to expected LIVE tokens and decode batch
+                      scales past what [slots, max_seq] rows fit
+                      (models/paged_llama.py; single-device, prompts
+                      within the bucket lattice, no prefix/spec yet)
+  TPU_PAGED_BLOCK     block size in tokens (default 128)
   TPU_LORA_ADAPTERS   multi-LoRA serving: adapter slots (default 0 =
                       off; slot 0 is the base no-op). Per-request
                       selection via generate(adapter=i); install
@@ -169,7 +175,9 @@ def new_engine_from_config(cfg, logger=None, metrics=None) -> TPUEngine:
             prefix_store_min=cfg.get_int("TPU_PREFIX_MIN", 0) or None,
             spec_decode_k=cfg.get_int("TPU_SPEC_DECODE", 0),
             lora_adapters=cfg.get_int("TPU_LORA_ADAPTERS", 0),
-            lora_rank=cfg.get_int("TPU_LORA_RANK", 16))
+            lora_rank=cfg.get_int("TPU_LORA_RANK", 16),
+            paged_blocks=cfg.get_int("TPU_PAGED_BLOCKS", 0),
+            paged_block_size=cfg.get_int("TPU_PAGED_BLOCK", 128))
 
         # scoring program: next-token logits at the prompt end (the
         # non-streaming sibling of generate, e.g. for classification

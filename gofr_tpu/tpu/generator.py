@@ -76,8 +76,6 @@ def _copy_row_masked(dst, src, dst_idx, src_idx):
     op here partitions cleanly under any batch/tp sharding. Reads both
     caches fully instead of one row each; that extra HBM stream is the
     price of mesh support and stays well under one decode block."""
-    import jax.numpy as jnp
-
     def cp(d, s):
         sel_s = (jnp.arange(s.shape[1]) == src_idx)
         sel_s = sel_s.reshape((1, -1) + (1,) * (s.ndim - 2))
@@ -174,7 +172,8 @@ class GenerationEngine:
                  prefix_cache_slots: int = 0,
                  prefix_store_min: int | None = None,
                  spec_decode_k: int = 0,
-                 lora_adapters: int = 0, lora_rank: int = 16):
+                 lora_adapters: int = 0, lora_rank: int = 16,
+                 paged_blocks: int = 0, paged_block_size: int = 128):
         self.cfg = cfg
         self.params = params
         self.n_slots = slots
@@ -187,15 +186,22 @@ class GenerationEngine:
         # zero); fill others via load_adapter()/checkpoints.
         self._n_adapters = max(0, int(lora_adapters))
         if self._n_adapters:
-            if mesh is not None:
-                raise ValueError("lora_adapters requires a single-device "
-                                 "engine (mesh=None)")
             if "lora_a_wq" not in params["layers"]:
+                stacks = llama.init_lora(cfg, self._n_adapters,
+                                         int(lora_rank),
+                                         jax.random.PRNGKey(seed + 1))
+                if mesh is not None:
+                    # stacks shard like any stacked leaf (layer dim over
+                    # pp, rank-r matrices replicated — they're tiny next
+                    # to the weight stream); the per-row adapter gather
+                    # reads a replicated table with batch-sharded
+                    # indices, which GSPMD partitions cleanly
+                    from ..parallel import shardings_for
+
+                    stacks = jax.device_put(stacks,
+                                            shardings_for(stacks, mesh))
                 self.params = {**params, "layers": {
-                    **params["layers"],
-                    **llama.init_lora(cfg, self._n_adapters,
-                                      int(lora_rank),
-                                      jax.random.PRNGKey(seed + 1))}}
+                    **params["layers"], **stacks}}
             else:
                 # a checkpoint brought its own stacks: their width is
                 # the truth. A silent mismatch would CLAMP the device
@@ -233,6 +239,38 @@ class GenerationEngine:
         self.max_seq = min(max_seq or cfg.max_seq, cfg.max_seq)
         self.prompt_buckets = tuple(sorted(b for b in prompt_buckets
                                            if b <= self.max_seq)) or (self.max_seq,)
+
+        # Paged (block-pool) KV cache: slots share a pool of fixed
+        # T-token blocks via a host-owned block table instead of owning
+        # [max_seq] rows — HBM sized to expected LIVE tokens, so decode
+        # batch scales past what contiguous rows fit (the road past
+        # batch 96 on 8B/v5e; models/paged_llama.py). v1 scope:
+        # single-device, prompts within the bucket lattice, no prefix
+        # pool / spec decode (each needs paged-aware row copies or
+        # window writes — composable later).
+        self._paged = paged_blocks > 0
+        if self._paged:
+            if mesh is not None:
+                raise ValueError("paged_blocks requires a single-device "
+                                 "engine (the kernel's block-table "
+                                 "prefetch does not partition)")
+            if prefix_cache_slots or spec_decode_k:
+                raise ValueError("paged_blocks does not compose with "
+                                 "prefix_cache_slots/spec_decode_k yet")
+            self._block_t = int(paged_block_size)
+            self._mb = -(-self.max_seq // self._block_t)
+            min_blocks = 2 + (self.prompt_buckets[-1] // self._block_t)
+            if paged_blocks < min_blocks:
+                raise ValueError(f"paged_blocks={paged_blocks} too small: "
+                                 f"need >= {min_blocks} (trash block + "
+                                 "one prompt's worth)")
+            from ..models.paged_llama import BlockAllocator
+
+            self._alloc = BlockAllocator(paged_blocks)
+            self._table = np.zeros((slots, self._mb), np.int32)
+            self._slot_blocks: list[list[int]] = [[] for _ in range(slots)]
+            self._cursors = np.zeros((slots,), np.int64)  # device cursor
+            self._paged_evictions = 0
         self.logger = logger
         self.metrics = metrics
         self.mesh = mesh
@@ -244,8 +282,14 @@ class GenerationEngine:
         self._kv_dtype = kv_dtype
         self._cache_sh = None  # set below for mesh engines
         self.down: str | None = None  # set when the device loop is bricked
-        self.cache = llama.init_cache(cfg, slots, self.max_seq,
-                                      dtype=kv_dtype)
+        if self._paged:
+            from ..models.paged_llama import init_paged_cache
+
+            self.cache = init_paged_cache(cfg, slots, paged_blocks,
+                                          self._block_t, dtype=kv_dtype)
+        else:
+            self.cache = llama.init_cache(cfg, slots, self.max_seq,
+                                          dtype=kv_dtype)
         self._slots = [_Slot() for _ in range(slots)]
         self._last_tokens = np.zeros((slots,), np.int32)
         self._active = np.zeros((slots,), bool)
@@ -353,6 +397,10 @@ class GenerationEngine:
                                            donate_argnums=(0,),
                                            out_shardings=(rep, rep, rep,
                                                           cache_sh))
+        elif self._paged:
+            self._prefill_jit = jax.jit(self._paged_prefill_fn,
+                                        donate_argnums=(0,))
+            self._step_jit = jax.jit(self._paged_step_fn, donate_argnums=(0,))
         else:
             self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(0,))
             self._step_jit = jax.jit(self._step_fn, donate_argnums=(0,))
@@ -485,6 +533,48 @@ class GenerationEngine:
                                                keys)
         return toks, lps, cache
 
+    def _paged_prefill_fn(self, cache, params, tokens, length, blocks,
+                          slot, temp, top_k, key, adapter=None):
+        """Paged admission: prefill the prompt, write its KV into the
+        slot's allocated ``blocks`` ([ceil(Sb/T)] int32 — entries past
+        the prompt's own blocks point at the trash block so bucket
+        padding lands nowhere), set the cursor, sample the first token."""
+        from ..models import paged_llama
+
+        logits, k, v, _ = llama.prefill_kv(
+            params, self.cfg, tokens, jnp.asarray([length]),
+            rope_max=self.max_seq, rope_tables=self.rope_tables,
+            flash=True, adapter=adapter)
+        cache = paged_llama.write_prompt_blocks(cache, k, v, blocks, length)
+        cache = cache._replace(lengths=cache.lengths.at[slot].set(length))
+        last = jnp.take(logits[0], length - 1, axis=0)
+        tok, lp = self._sample(last[None, :], temp[None], key, top_k[None])
+        return tok[0], lp[0], cache
+
+    def _paged_step_fn(self, cache, params, last_tokens, active, temps,
+                       top_ks, key, table, adapter=None):
+        """K fused paged decode steps — _step_fn over the block pool.
+        ``table`` [B, MB] is host-owned and constant through the block
+        (the host pre-allocates blocks covering K tokens per slot)."""
+        from ..models import paged_llama
+
+        keys = jax.random.split(key, self.decode_block)
+
+        def body(carry, step_key):
+            tokens, cache = carry
+            logits, stepped = paged_llama.paged_decode_step(
+                params, self.cfg, tokens, cache, table,
+                rope_tables=self.rope_tables, adapter=adapter)
+            lengths = jnp.where(active, stepped.lengths, cache.lengths)
+            stepped = stepped._replace(lengths=lengths)
+            toks, lps = self._sample(logits, temps, step_key, top_ks)
+            toks = jnp.where(active, toks, tokens)
+            return (toks, stepped), (toks, lps)
+
+        (_, cache), (toks, lps) = jax.lax.scan(body, (last_tokens, cache),
+                                               keys)
+        return toks, lps, cache
+
     def _verify_fn(self, cache, params, window, active, key, adapter=None):
         """One speculative verify pass. ``window`` [B, W]: col 0 = each
         slot's pending last token, cols 1.. = prompt-lookup drafts.
@@ -581,10 +671,15 @@ class GenerationEngine:
         # Prompts longer than the largest bucket run through chunked
         # prefill at admission (see _start); the only hard limit is cache
         # capacity minus one position for the first generated token.
-        limit = self.max_seq - 1
+        # Paged engines (v1) admit only bucket-lattice prompts — chunked
+        # prefill against the pool needs a paged chunk_attention.
+        limit = (self.prompt_buckets[-1] if self._paged
+                 else self.max_seq - 1)
         if len(prompt) > limit:
             stream._q.put(GenerationError(
-                f"prompt length {len(prompt)} exceeds serving limit {limit}"))
+                f"prompt length {len(prompt)} exceeds serving limit {limit}"
+                + (" (paged engines admit prompts up to the largest "
+                   "bucket)" if self._paged else "")))
             stream._q.put(None)
             return stream
         with self._admission_lock:
@@ -616,6 +711,16 @@ class GenerationEngine:
         }
         if self._prefix_idx is not None:
             out["prefix_cache"] = self._prefix_idx.stats()
+        if self._paged:
+            n_usable = self._alloc.n_blocks - 1
+            out["paged"] = {
+                "block_size": self._block_t,
+                "blocks": n_usable,
+                "free": self._alloc.free_blocks,
+                "utilization": round(1 - self._alloc.free_blocks
+                                     / max(1, n_usable), 3),
+                "evictions": self._paged_evictions,
+            }
         if self._n_adapters:
             out["lora"] = {"adapters": self._n_adapters,
                            "rank": int(self.params["layers"]
@@ -649,14 +754,29 @@ class GenerationEngine:
                 # — and, with a prefix pool, for ANY hit (prefill resumes
                 # mid-prompt through the chunk lattice), so they must be
                 # warm whenever the pool exists
-                chunked_reachable = (self.max_seq - 1 > C
-                                     or self._prefix_idx is not None)
+                chunked_reachable = (not self._paged
+                                     and (self.max_seq - 1 > C
+                                          or self._prefix_idx is not None))
                 for b in self.prompt_buckets:
                     toks = jnp.zeros((1, b), jnp.int32)
-                    _, _, self.cache = jax.block_until_ready(self._prefill_jit(
-                        self.cache, self.params, toks, jnp.int32(1),
-                        jnp.int32(free), jnp.float32(0.0), jnp.int32(0),
-                        self._key, self._adapter1(None)))
+                    if self._paged:
+                        # dummy KV lands in the trash block (blocks all
+                        # 0); the cursor restore below undoes lengths
+                        zeros = jnp.zeros((-(-b // self._block_t),),
+                                          jnp.int32)
+                        _, _, self.cache = jax.block_until_ready(
+                            self._prefill_jit(
+                                self.cache, self.params, toks, jnp.int32(1),
+                                zeros, jnp.int32(free), jnp.float32(0.0),
+                                jnp.int32(0), self._key,
+                                self._adapter1(None)))
+                    else:
+                        _, _, self.cache = jax.block_until_ready(
+                            self._prefill_jit(
+                                self.cache, self.params, toks, jnp.int32(1),
+                                jnp.int32(free), jnp.float32(0.0),
+                                jnp.int32(0), self._key,
+                                self._adapter1(None)))
                     if chunked_reachable:
                         # chunked-admission lattice: the final chunk
                         # compiles per bucket, mid chunks only at C
@@ -676,10 +796,24 @@ class GenerationEngine:
             elif self.logger is not None:
                 self.logger.debug({"event": "generator warmup skipped prefill",
                                    "reason": "no free slot"})
-            _, _, self.cache = jax.block_until_ready(self._step_jit(
-                self.cache, self.params, jnp.asarray(self._last_tokens),
-                jnp.zeros((self.n_slots,), bool), jnp.asarray(self._temps),
-                jnp.asarray(self._top_ks), self._key, self._adapters()))
+            if self._paged:
+                # ZEROED table, not the live one: an active slot whose
+                # cursor sits at an unallocated block boundary would have
+                # its clamped row redirect the dummy write INTO its last
+                # live block (offset 0 = position cursor-T); with zeros
+                # every garbage write lands in the trash block
+                _, _, self.cache = jax.block_until_ready(self._step_jit(
+                    self.cache, self.params, jnp.asarray(self._last_tokens),
+                    jnp.zeros((self.n_slots,), bool),
+                    jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                    self._key, jnp.zeros_like(jnp.asarray(self._table)),
+                    self._adapters()))
+            else:
+                _, _, self.cache = jax.block_until_ready(self._step_jit(
+                    self.cache, self.params, jnp.asarray(self._last_tokens),
+                    jnp.zeros((self.n_slots,), bool),
+                    jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                    self._key, self._adapters()))
             if self._spec_k:
                 # the verify program too — its first real tick would
                 # otherwise compile mid-serving under the device lock,
@@ -804,7 +938,18 @@ class GenerationEngine:
                 if req.stream.cancelled.is_set():
                     req.stream._q.put(None)
                     continue
-                self._start(idx, slot, req)
+                blocks = None
+                if self._paged:
+                    T = self._block_t
+                    blocks = self._alloc.alloc(-(-len(req.prompt) // T))
+                    if blocks is None:
+                        # transient pool pressure: requeue and let active
+                        # slots retire blocks. (FIFO order is not
+                        # preserved across the requeue — pool-pressure
+                        # reordering is documented engine behavior.)
+                        self._pending.put(req)
+                        return
+                self._start(idx, slot, req, blocks)
             finally:
                 self._admitting -= 1
 
@@ -862,6 +1007,79 @@ class GenerationEngine:
             jnp.int32(req.top_k), self._next_key(), self._adapter1(req))
         return int(tok), float(lp)
 
+    # -- paged-mode host side ------------------------------------------------
+    def _paged_admit_prefill(self, idx: int, req: _Request,
+                             blocks: list[int]) -> tuple[int, float]:
+        """Paged admission: ``blocks`` (allocated by _admit, ceil(L/T))
+        become the slot's blocks; the bucket-padded KV write targets
+        them plus trash-block entries for the padding tail."""
+        L = len(req.prompt)
+        T = self._block_t
+        self._slot_adapter[idx] = req.adapter
+        Sb = pad_bucket(L, self.prompt_buckets)
+        n_wr = -(-Sb // T)
+        write_blocks = blocks + [0] * (n_wr - len(blocks))
+        padded = np.zeros((1, Sb), np.int32)
+        padded[0, :L] = req.prompt
+        tok, lp, self.cache = self._prefill_jit(
+            self.cache, self.params, jnp.asarray(padded), jnp.int32(L),
+            jnp.asarray(write_blocks, jnp.int32), jnp.int32(idx),
+            jnp.float32(req.temperature), jnp.int32(req.top_k),
+            self._next_key(), self._adapter1(req))
+        self._slot_blocks[idx] = blocks
+        self._cursors[idx] = L
+        self._write_table_row(idx)
+        return int(tok), float(lp)
+
+    def _write_table_row(self, idx: int) -> None:
+        """Clamped table row: entries past the slot's live blocks repeat
+        the last one (the kernel's DMA-skip); empty slots stay on the
+        trash block. Slice-assigned — this runs on the GIL-held serving
+        loop."""
+        blocks = self._slot_blocks[idx]
+        if not blocks:
+            self._table[idx, :] = 0
+            return
+        n = min(len(blocks), self._mb)
+        self._table[idx, :n] = blocks[:n]
+        self._table[idx, n:] = blocks[n - 1]
+
+    def _ensure_blocks(self) -> None:
+        """Pre-dispatch invariant: every active slot owns blocks covering
+        its next ``decode_block`` positions. On pool exhaustion the slot
+        that cannot grow is retired early (its stream ends as if at
+        capacity) — freeing its blocks for the rest of the batch; the
+        eviction is logged and counted."""
+        K = self.decode_block
+        T = self._block_t
+        for idx, slot in enumerate(self._slots):
+            if not self._active[idx]:
+                continue
+            need = min((int(self._cursors[idx]) + K - 1) // T + 1, self._mb)
+            if len(self._slot_blocks[idx]) >= need:
+                continue  # row already written at admission/last growth
+            starved = False
+            while len(self._slot_blocks[idx]) < need:
+                got = self._alloc.alloc(1)
+                if got is None:
+                    starved = True
+                    break
+                self._slot_blocks[idx].extend(got)
+            if starved:
+                self._paged_evictions += 1
+                if self.logger is not None:
+                    self.logger.warn({
+                        "event": "paged pool exhausted: stream truncated",
+                        "slot": idx,
+                        "generated": slot.generated,
+                        "free_blocks": self._alloc.free_blocks})
+                if self.metrics is not None:
+                    self.metrics.increment_counter(
+                        "app_tpu_paged_evictions_total")
+                self._retire(idx, slot)
+                continue
+            self._write_table_row(idx)
+
     def _prefix_restore(self, idx: int, req: _Request, L: int,
                         C: int) -> int:
         """Consult the prefix pool; on a useful hit copy the stored row
@@ -910,13 +1128,28 @@ class GenerationEngine:
         self._pool = self._pool_store_jit(self._pool, self.cache,
                                           jnp.int32(row), jnp.int32(idx))
 
-    def _start(self, idx: int, slot: _Slot, req: _Request) -> None:
+    def _start(self, idx: int, slot: _Slot, req: _Request,
+               blocks: "list[int] | None" = None) -> None:
         t0 = time.monotonic()
         try:
-            first, first_lp = self._admit_prefill(idx, req)
+            if self._paged:
+                first, first_lp = self._paged_admit_prefill(idx, req, blocks)
+            else:
+                first, first_lp = self._admit_prefill(idx, req)
         except BaseException as e:  # noqa: BLE001 — the request is already
             # off the pending queue and owns no slot: fail ITS stream here,
             # then let _loop's handler deal with engine-level fallout.
+            if self._paged and blocks:
+                # the failed admission may have already installed the
+                # slot's blocks/table/cursor (_paged_admit_prefill writes
+                # them before the device error surfaces at int(tok)) —
+                # clear them BEFORE freeing, or the stale table row would
+                # direct this slot's frozen-cursor garbage writes into
+                # blocks re-issued to another live stream
+                self._slot_blocks[idx] = []
+                self._table[idx, :] = 0
+                self._cursors[idx] = 0
+                self._alloc.free(blocks)
             req.stream._q.put(GenerationError(f"prefill failed: {e!r}"))
             req.stream._q.put(None)
             raise
@@ -967,6 +1200,15 @@ class GenerationEngine:
         self._temps[idx] = 0.0
         self._top_ks[idx] = 0
         self._slot_adapter[idx] = 0
+        if self._paged:
+            # freed blocks may be re-issued immediately; the retired
+            # slot's frozen-cursor garbage writes go to the trash block
+            # because its table row zeroes BEFORE the next dispatch
+            if self._slot_blocks[idx]:
+                self._alloc.free(self._slot_blocks[idx])
+                self._slot_blocks[idx] = []
+            self._table[idx, :] = 0
+            self._cursors[idx] = 0
 
     def _loop(self) -> None:
         while not self._closed:
@@ -999,9 +1241,17 @@ class GenerationEngine:
                 # health reports it instead of serving a bricked cache.
                 try:
                     with self._device_lock:
-                        cache = llama.init_cache(self.cfg, self.n_slots,
-                                                 self.max_seq,
-                                                 dtype=self._kv_dtype)
+                        if self._paged:
+                            from ..models.paged_llama import init_paged_cache
+
+                            cache = init_paged_cache(
+                                self.cfg, self.n_slots,
+                                self._alloc.n_blocks, self._block_t,
+                                dtype=self._kv_dtype)
+                        else:
+                            cache = llama.init_cache(self.cfg, self.n_slots,
+                                                     self.max_seq,
+                                                     dtype=self._kv_dtype)
                         if self._cache_sh is not None:
                             cache = jax.device_put(cache, self._cache_sh)
                         self.cache = jax.block_until_ready(cache)
@@ -1099,10 +1349,22 @@ class GenerationEngine:
         buys K-fold fewer device roundtrips."""
         if not self._active.any():
             return
-        toks, lps, self.cache = self._step_jit(
-            self.cache, self.params, jnp.asarray(self._last_tokens),
-            jnp.asarray(self._active), jnp.asarray(self._temps),
-            jnp.asarray(self._top_ks), self._next_key(), self._adapters())
+        if self._paged:
+            self._ensure_blocks()  # may retire starving slots
+            if not self._active.any():
+                return
+            toks, lps, self.cache = self._step_jit(
+                self.cache, self.params, jnp.asarray(self._last_tokens),
+                jnp.asarray(self._active), jnp.asarray(self._temps),
+                jnp.asarray(self._top_ks), self._next_key(),
+                jnp.asarray(self._table), self._adapters())
+            self._cursors[self._active] += self.decode_block
+        else:
+            toks, lps, self.cache = self._step_jit(
+                self.cache, self.params, jnp.asarray(self._last_tokens),
+                jnp.asarray(self._active), jnp.asarray(self._temps),
+                jnp.asarray(self._top_ks), self._next_key(),
+                self._adapters())
         toks_np, lps_np = jax.device_get((toks, lps))  # [K, B] each
         if self.metrics is not None:
             self.metrics.set_gauge("app_tpu_batch_fill",
