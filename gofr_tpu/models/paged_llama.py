@@ -205,6 +205,37 @@ def write_prompt_blocks(cache: PagedKVCache, k_stack, v_stack,
     return cache._replace(k=k, v=v, k_scale=ks, v_scale=vs)
 
 
+def write_row_to_blocks(cache: PagedKVCache, row, blocks: jnp.ndarray,
+                        ) -> PagedKVCache:
+    """Copy a dense single-slot cache row (llama.KVCache with B=1,
+    [L, 1, Smax, KV, hd]) into pool blocks — the bridge long-prompt
+    admission uses: chunked prefill fills the dense SCRATCH row exactly
+    as the contiguous engine would, then this one dispatch lands it in
+    the pool. ``blocks`` [MB] int32: entries past the prompt's own
+    blocks point at the trash block, so positions beyond the prompt
+    land nowhere. Same-dtype copy (int8 + scales move verbatim)."""
+    T = cache.block_size
+    mb = blocks.shape[0]
+    k, v, ks, vs = cache.k, cache.v, cache.k_scale, cache.v_scale
+    quant = cache.quantized
+    for j in range(mb):
+        lo = j * T
+        k = jax.lax.dynamic_update_slice(
+            k, row.k[:, 0, lo:lo + T][:, None].astype(k.dtype),
+            (0, blocks[j], 0, 0, 0))
+        v = jax.lax.dynamic_update_slice(
+            v, row.v[:, 0, lo:lo + T][:, None].astype(v.dtype),
+            (0, blocks[j], 0, 0, 0))
+        if quant:
+            ks = jax.lax.dynamic_update_slice(
+                ks, row.k_scale[:, 0, lo:lo + T][:, None],
+                (0, blocks[j], 0, 0))
+            vs = jax.lax.dynamic_update_slice(
+                vs, row.v_scale[:, 0, lo:lo + T][:, None],
+                (0, blocks[j], 0, 0))
+    return cache._replace(k=k, v=v, k_scale=ks, v_scale=vs)
+
+
 class BlockAllocator:
     """Host-side free-list over pool blocks 1..N-1 (block 0 is the
     reserved trash block). Thread-compatible: the engine calls it only

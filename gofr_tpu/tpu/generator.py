@@ -401,6 +401,22 @@ class GenerationEngine:
             self._prefill_jit = jax.jit(self._paged_prefill_fn,
                                         donate_argnums=(0,))
             self._step_jit = jax.jit(self._paged_step_fn, donate_argnums=(0,))
+            if self.max_seq - 1 > self.prompt_buckets[-1]:
+                # Long-prompt admission: the chunk lattice runs against a
+                # dense single-slot SCRATCH row (identical programs to the
+                # contiguous engine's, B=1), then one dispatch lands the
+                # row in the pool (paged_llama.write_row_to_blocks). The
+                # scratch costs one slot-row of HBM (~67 MB at 8B/1024).
+                from ..models.paged_llama import write_row_to_blocks
+
+                self._scratch = llama.init_cache(cfg, 1, self.max_seq,
+                                                 dtype=kv_dtype)
+                self._chunk_mid_jit = jax.jit(self._chunk_mid,
+                                              donate_argnums=(0,))
+                self._chunk_final_jit = jax.jit(self._chunk_final,
+                                                donate_argnums=(0,))
+                self._row_to_blocks_jit = jax.jit(write_row_to_blocks,
+                                                  donate_argnums=(0,))
         else:
             self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(0,))
             self._step_jit = jax.jit(self._step_fn, donate_argnums=(0,))
@@ -669,19 +685,29 @@ class GenerationEngine:
             stream._q.put(None)
             return stream
         # Prompts longer than the largest bucket run through chunked
-        # prefill at admission (see _start); the only hard limit is cache
-        # capacity minus one position for the first generated token.
-        # Paged engines (v1) admit only bucket-lattice prompts — chunked
-        # prefill against the pool needs a paged chunk_attention.
-        limit = (self.prompt_buckets[-1] if self._paged
-                 else self.max_seq - 1)
+        # prefill at admission (see _start; paged engines chunk into a
+        # dense scratch row, then land it in the pool); the only hard
+        # limit is cache capacity minus one position for the first
+        # generated token.
+        limit = self.max_seq - 1
         if len(prompt) > limit:
             stream._q.put(GenerationError(
-                f"prompt length {len(prompt)} exceeds serving limit {limit}"
-                + (" (paged engines admit prompts up to the largest "
-                   "bucket)" if self._paged else "")))
+                f"prompt length {len(prompt)} exceeds serving limit {limit}"))
             stream._q.put(None)
             return stream
+        if self._paged:
+            # fail-fast when the POOL can never hold this prompt — a
+            # transient shortage requeues at admission, but a structural
+            # one would requeue forever (livelock, caller blocked)
+            need = -(-len(prompt) // self._block_t)
+            usable = self._alloc.n_blocks - 1
+            if need > usable:
+                stream._q.put(GenerationError(
+                    f"prompt needs {need} pool blocks but the pool has "
+                    f"{usable} (raise TPU_PAGED_BLOCKS or "
+                    "TPU_PAGED_BLOCK)"))
+                stream._q.put(None)
+                return stream
         with self._admission_lock:
             if self._closed:
                 raise GenerationError("generation engine is closed")
@@ -754,11 +780,22 @@ class GenerationEngine:
                 # — and, with a prefix pool, for ANY hit (prefill resumes
                 # mid-prompt through the chunk lattice), so they must be
                 # warm whenever the pool exists
+                # paged engines chunk into the scratch row; warm those
+                # programs against it below instead of the serving cache
+                paged_chunks = self._paged and hasattr(self, "_scratch")
                 chunked_reachable = (not self._paged
                                      and (self.max_seq - 1 > C
                                           or self._prefix_idx is not None))
                 for b in self.prompt_buckets:
                     toks = jnp.zeros((1, b), jnp.int32)
+                    if paged_chunks:
+                        _, _, self._scratch = jax.block_until_ready(
+                            self._chunk_final_jit(
+                                self._scratch, self.params, toks,
+                                jnp.int32(0), jnp.int32(0), jnp.int32(1),
+                                jnp.int32(0), jnp.float32(0.0),
+                                jnp.int32(0), self._key,
+                                self._adapter1(None)))
                     if self._paged:
                         # dummy KV lands in the trash block (blocks all
                         # 0); the cursor restore below undoes lengths
@@ -793,6 +830,18 @@ class GenerationEngine:
                         jnp.int32(free), jnp.int32(0), jnp.int32(0),
                         jnp.float32(0.0), jnp.int32(0), self._key,
                         self._adapter1(None)))
+                if paged_chunks:
+                    toks = jnp.zeros((1, C), jnp.int32)
+                    self._scratch = jax.block_until_ready(
+                        self._chunk_mid_jit(
+                            self._scratch, self.params, toks, jnp.int32(0),
+                            jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                            jnp.float32(0.0), jnp.int32(0), self._key,
+                            self._adapter1(None)))
+                    self.cache = jax.block_until_ready(
+                        self._row_to_blocks_jit(
+                            self.cache, self._scratch,
+                            jnp.zeros((self._mb,), jnp.int32)))
             elif self.logger is not None:
                 self.logger.debug({"event": "generator warmup skipped prefill",
                                    "reason": "no free slot"})
@@ -979,32 +1028,44 @@ class GenerationEngine:
                 jnp.int32(req.top_k), self._next_key(),
                 self._adapter1(req))
             return int(tok), float(lp)
+        return self._chunk_lattice("cache", idx, req, pos)
+
+    def _chunk_lattice(self, attr: str, slot: int, req: _Request,
+                       pos: int = 0) -> tuple[int, float]:
+        """Run the chunked-prefill lattice for ``req.prompt[pos:]``
+        against the cache at ``getattr(self, attr)`` ("cache" for the
+        contiguous engine, "_scratch" for paged long-prompt admission),
+        writing into batch row ``slot``. One decode block runs between
+        mid chunks so long admissions never stall active decode streams
+        (VERDICT r2 weak #5). Returns the final chunk's sampled
+        (token, logprob) — or (0, 0.0) when the request was cancelled
+        mid-lattice (the token is discarded anyway: _deliver retires
+        cancelled slots before use)."""
+        L = len(req.prompt)
+        C = self.prompt_buckets[-1]
         while L - pos > C:
             if req.stream.cancelled.is_set():
-                break
+                return 0, 0.0
             chunk = req.prompt[pos:pos + C]
-            self.cache = self._chunk_mid_jit(
-                self.cache, self.params, jnp.asarray(chunk[None, :]),
-                jnp.int32(pos), jnp.int32(idx), jnp.int32(0),
-                jnp.int32(0), jnp.float32(0.0), jnp.int32(0), self._key,
-                self._adapter1(req))
+            setattr(self, attr, self._chunk_mid_jit(
+                getattr(self, attr), self.params,
+                jnp.asarray(chunk[None, :]), jnp.int32(pos),
+                jnp.int32(slot), jnp.int32(0), jnp.int32(0),
+                jnp.float32(0.0), jnp.int32(0), self._key,
+                self._adapter1(req)))
             pos += C
-            # Long admissions must not stall active decode streams
-            # (VERDICT r2 weak #5): run one decode block between chunks
-            # so every live slot keeps producing while this prompt loads.
             self._decode_tick()
         if req.stream.cancelled.is_set():
-            # token is discarded anyway (_deliver retires cancelled slots
-            # before use) — skip the final-chunk dispatch entirely
             return 0, 0.0
         rem = L - pos
         Sb = pad_bucket(rem, self.prompt_buckets)
         final = req.prompt[L - Sb:]
-        tok, lp, self.cache = self._chunk_final_jit(
-            self.cache, self.params, jnp.asarray(final[None, :]),
-            jnp.int32(L - Sb), jnp.int32(idx), jnp.int32(L),
+        tok, lp, new_cache = self._chunk_final_jit(
+            getattr(self, attr), self.params, jnp.asarray(final[None, :]),
+            jnp.int32(L - Sb), jnp.int32(slot), jnp.int32(L),
             jnp.int32(Sb - 1), jnp.float32(req.temperature),
             jnp.int32(req.top_k), self._next_key(), self._adapter1(req))
+        setattr(self, attr, new_cache)
         return int(tok), float(lp)
 
     # -- paged-mode host side ------------------------------------------------
@@ -1012,24 +1073,44 @@ class GenerationEngine:
                              blocks: list[int]) -> tuple[int, float]:
         """Paged admission: ``blocks`` (allocated by _admit, ceil(L/T))
         become the slot's blocks; the bucket-padded KV write targets
-        them plus trash-block entries for the padding tail."""
+        them plus trash-block entries for the padding tail. Prompts past
+        the largest bucket chunk-prefill into the dense scratch row
+        (identical lattice to the contiguous engine, decode interleaved
+        between chunks), then one dispatch lands the row in the pool."""
         L = len(req.prompt)
         T = self._block_t
+        C = self.prompt_buckets[-1]
         self._slot_adapter[idx] = req.adapter
-        Sb = pad_bucket(L, self.prompt_buckets)
-        n_wr = -(-Sb // T)
-        write_blocks = blocks + [0] * (n_wr - len(blocks))
-        padded = np.zeros((1, Sb), np.int32)
-        padded[0, :L] = req.prompt
-        tok, lp, self.cache = self._prefill_jit(
-            self.cache, self.params, jnp.asarray(padded), jnp.int32(L),
-            jnp.asarray(write_blocks, jnp.int32), jnp.int32(idx),
-            jnp.float32(req.temperature), jnp.int32(req.top_k),
-            self._next_key(), self._adapter1(req))
+        # Register the blocks as the slot's FIRST — every exit path
+        # (cancel mid-lattice included) then frees them through the
+        # normal _retire, instead of leaking pool blocks the allocator
+        # handed _admit (_start's exception path clears this state
+        # itself before freeing).
         self._slot_blocks[idx] = blocks
         self._cursors[idx] = L
         self._write_table_row(idx)
-        return int(tok), float(lp)
+        if L <= C:
+            Sb = pad_bucket(L, self.prompt_buckets)
+            n_wr = -(-Sb // T)
+            write_blocks = blocks + [0] * (n_wr - len(blocks))
+            padded = np.zeros((1, Sb), np.int32)
+            padded[0, :L] = req.prompt
+            tok, lp, self.cache = self._prefill_jit(
+                self.cache, self.params, jnp.asarray(padded), jnp.int32(L),
+                jnp.asarray(write_blocks, jnp.int32), jnp.int32(idx),
+                jnp.float32(req.temperature), jnp.int32(req.top_k),
+                self._next_key(), self._adapter1(req))
+            return int(tok), float(lp)
+        tok, lp = self._chunk_lattice("_scratch", 0, req)
+        if req.stream.cancelled.is_set():
+            return tok, lp  # slot retires at _deliver; blocks free there
+        write_blocks = blocks + [0] * (self._mb - len(blocks))
+        self.cache = self._row_to_blocks_jit(
+            self.cache, self._scratch,
+            jnp.asarray(write_blocks, jnp.int32))
+        self.cache = self.cache._replace(
+            lengths=self.cache.lengths.at[idx].set(L))
+        return tok, lp
 
     def _write_table_row(self, idx: int) -> None:
         """Clamped table row: entries past the slot's live blocks repeat
@@ -1248,6 +1329,15 @@ class GenerationEngine:
                                 self.cfg, self.n_slots,
                                 self._alloc.n_blocks, self._block_t,
                                 dtype=self._kv_dtype)
+                            if hasattr(self, "_scratch"):
+                                # the chunk jits donate the scratch row
+                                # too — a failed chunk dispatch leaves it
+                                # consumed, bricking every later
+                                # long-prompt admission
+                                self._scratch = jax.block_until_ready(
+                                    llama.init_cache(
+                                        self.cfg, 1, self.max_seq,
+                                        dtype=self._kv_dtype))
                         else:
                             cache = llama.init_cache(self.cfg, self.n_slots,
                                                      self.max_seq,

@@ -270,8 +270,79 @@ def test_paged_engine_rejects_unsupported_combos(params):
                            prompt_buckets=(8, 16), paged_blocks=9,
                            paged_block_size=16)
     try:
-        s = eng.generate(list(range(1, 20)), max_new_tokens=2)
+        s = eng.generate(list(range(1, 65)), max_new_tokens=2)
         with pytest.raises(Exception, match="serving limit"):
+            s.tokens()
+    finally:
+        eng.close()
+
+
+def test_paged_long_prompt_chunked_admission_matches_contiguous(params):
+    """Prompts past the largest bucket chunk-prefill into the dense
+    scratch row and land in the pool via write_row_to_blocks — tokens
+    must match the contiguous engine's chunked path exactly, including
+    while another slot decodes (the interleaved-decode admission)."""
+    rng = np.random.default_rng(11)
+    long_p = rng.integers(1, TINY.vocab_size, 41).tolist()  # > bucket 16
+    short_p = rng.integers(1, TINY.vocab_size, 7).tolist()
+    dense = GenerationEngine(TINY, params, slots=2, max_seq=64,
+                             prompt_buckets=(8, 16), kv_dtype=jnp.int8)
+    try:
+        want_long = dense.generate(long_p, max_new_tokens=8).tokens()
+        want_short = dense.generate(short_p, max_new_tokens=12).tokens()
+    finally:
+        dense.close()
+    eng = GenerationEngine(TINY, params, slots=2, max_seq=64,
+                           prompt_buckets=(8, 16), kv_dtype=jnp.int8,
+                           paged_blocks=9, paged_block_size=16)
+    try:
+        eng.warmup()  # compiles the scratch chunk lattice too
+        s_short = eng.generate(short_p, max_new_tokens=12)
+        s_long = eng.generate(long_p, max_new_tokens=8)
+        assert s_long.tokens() == want_long
+        assert s_short.tokens() == want_short
+        assert eng.stats()["paged"]["free"] == 8
+    finally:
+        eng.close()
+
+
+def test_paged_cancel_mid_long_admission_frees_blocks(params):
+    """Cancelling a long prompt during chunked admission must return its
+    pool blocks (the blocks are registered to the slot BEFORE the
+    lattice runs, so the normal retire path frees them)."""
+    eng = GenerationEngine(TINY, params, slots=2, max_seq=64,
+                           prompt_buckets=(8, 16), paged_blocks=9,
+                           paged_block_size=16)
+    rng = np.random.default_rng(13)
+    try:
+        total = eng.stats()["paged"]["free"]
+        for _ in range(4):  # repeated cancels must not drain the pool
+            s = eng.generate(rng.integers(1, TINY.vocab_size, 41).tolist(),
+                             max_new_tokens=8)
+            s.cancel()
+            list(s)
+        deadline = 50
+        while eng.stats()["paged"]["free"] != total and deadline:
+            import time
+            time.sleep(0.1)
+            deadline -= 1
+        assert eng.stats()["paged"]["free"] == total
+        # and the engine still serves
+        got = eng.generate([1, 2, 3], max_new_tokens=3).tokens()
+        assert len(got) == 3
+    finally:
+        eng.close()
+
+
+def test_paged_structurally_oversized_prompt_fails_fast(params):
+    """A prompt needing more blocks than the pool HAS must error, not
+    requeue forever (the admission-livelock fix)."""
+    eng = GenerationEngine(TINY, params, slots=2, max_seq=64,
+                           prompt_buckets=(8, 16), paged_blocks=4,
+                           paged_block_size=16)  # 3 usable blocks
+    try:
+        s = eng.generate(list(range(1, 51)), max_new_tokens=2)  # needs 4
+        with pytest.raises(Exception, match="pool blocks"):
             s.tokens()
     finally:
         eng.close()
