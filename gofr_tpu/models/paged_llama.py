@@ -205,6 +205,46 @@ def write_prompt_blocks(cache: PagedKVCache, k_stack, v_stack,
     return cache._replace(k=k, v=v, k_scale=ks, v_scale=vs)
 
 
+def read_blocks_to_row(row, cache: PagedKVCache,
+                       blocks: jnp.ndarray):
+    """Inverse of write_row_to_blocks: gather pool blocks into a dense
+    single-slot scratch row [L, 1, Smax, KV, hd] — the restore half of
+    the paged prefix cache (shared blocks -> scratch, then chunked
+    prefill resumes from the match point against the dense row).
+    ``blocks`` [MB] int32: entries past the shared prefix may point
+    anywhere (typically the trash block); those positions are
+    overwritten by the resumed chunks or ignored past the prompt."""
+    T = cache.block_size
+    mb = blocks.shape[0]
+    k, v, ks, vs = row.k, row.v, row.k_scale, row.v_scale
+    quant = cache.quantized
+    for j in range(mb):
+        lo = j * T
+        span = min(T, k.shape[2] - lo)
+        if span <= 0:
+            break
+        blk_k = jax.lax.dynamic_slice(
+            cache.k, (0, blocks[j], 0, 0, 0),
+            (cache.k.shape[0], 1, span) + cache.k.shape[3:])
+        blk_v = jax.lax.dynamic_slice(
+            cache.v, (0, blocks[j], 0, 0, 0),
+            (cache.v.shape[0], 1, span) + cache.v.shape[3:])
+        k = jax.lax.dynamic_update_slice(k, blk_k.astype(k.dtype),
+                                         (0, 0, lo, 0, 0))
+        v = jax.lax.dynamic_update_slice(v, blk_v.astype(v.dtype),
+                                         (0, 0, lo, 0, 0))
+        if quant:
+            sk = jax.lax.dynamic_slice(
+                cache.k_scale, (0, blocks[j], 0, 0),
+                (cache.k_scale.shape[0], 1, span, cache.k_scale.shape[3]))
+            sv = jax.lax.dynamic_slice(
+                cache.v_scale, (0, blocks[j], 0, 0),
+                (cache.v_scale.shape[0], 1, span, cache.v_scale.shape[3]))
+            ks = jax.lax.dynamic_update_slice(ks, sk, (0, 0, lo, 0))
+            vs = jax.lax.dynamic_update_slice(vs, sv, (0, 0, lo, 0))
+    return row._replace(k=k, v=v, k_scale=ks, v_scale=vs)
+
+
 def write_row_to_blocks(cache: PagedKVCache, row, blocks: jnp.ndarray,
                         ) -> PagedKVCache:
     """Copy a dense single-slot cache row (llama.KVCache with B=1,
@@ -237,15 +277,21 @@ def write_row_to_blocks(cache: PagedKVCache, row, blocks: jnp.ndarray,
 
 
 class BlockAllocator:
-    """Host-side free-list over pool blocks 1..N-1 (block 0 is the
-    reserved trash block). Thread-compatible: the engine calls it only
+    """Host-side refcounted free-list over pool blocks 1..N-1 (block 0
+    is the reserved trash block). Refcounts exist for SHARED prefix
+    blocks: a stored prefix entry and every slot serving from it each
+    hold a reference; a block returns to the free list only when the
+    last holder drops it. Thread-compatible: the engine calls it only
     from the serving loop under its device lock."""
 
     def __init__(self, n_blocks: int):
         if n_blocks < 2:
             raise ValueError("paged pool needs >= 2 blocks "
                              "(block 0 is reserved)")
+        import numpy as np
+
         self._free = list(range(n_blocks - 1, 0, -1))
+        self._rc = np.zeros(n_blocks, np.int32)
         self.n_blocks = n_blocks
 
     @property
@@ -253,12 +299,154 @@ class BlockAllocator:
         return len(self._free)
 
     def alloc(self, n: int) -> list[int] | None:
-        """n block ids, or None (nothing allocated) if the pool can't
-        cover the request — the caller picks the eviction policy."""
+        """n block ids (each at refcount 1), or None (nothing allocated)
+        if the pool can't cover the request — the caller picks the
+        eviction policy."""
         if n > len(self._free):
             return None
         out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._rc[b] = 1
         return out
 
+    def ref(self, blocks) -> None:
+        """Additional holder for already-allocated blocks."""
+        for b in blocks:
+            assert self._rc[b] > 0, f"ref of unallocated block {b}"
+            self._rc[b] += 1
+
     def free(self, blocks) -> None:
-        self._free.extend(blocks)
+        """Drop one reference per block; blocks with no remaining holder
+        return to the free list."""
+        for b in blocks:
+            self._rc[b] -= 1
+            if self._rc[b] == 0:
+                self._free.append(b)
+            assert self._rc[b] >= 0, f"double free of block {b}"
+
+
+class SharedPrefixIndex:
+    """Zero-copy prefix reuse for the paged pool (the paged counterpart
+    of tpu/prefix_cache.PrefixIndex): entries record the FULL T-token
+    blocks of a stored prompt prefix and hold a reference on each — no
+    KV is ever copied to store. Full blocks are immutable once written
+    (decode only ever writes the block at a slot's cursor, which lies
+    past its prompt's full blocks), so a stored entry stays valid for
+    any continuation; a hit refs the shared blocks into the new slot's
+    table and prefill resumes at the match point. Matches clamp to
+    whole blocks and never consume the entire prompt (>= 1 token always
+    recomputes, mirroring the contiguous engine's contract). LRU
+    entries are evictable under pool pressure — eviction just drops the
+    entry's references. Thread-compatible: serving-loop only."""
+
+    def __init__(self, max_entries: int, alloc: BlockAllocator,
+                 block_size: int):
+        self.max_entries = int(max_entries)
+        self._alloc = alloc
+        self._t = int(block_size)
+        self._entries: list[dict] = []  # {key, blocks, adapter, used}
+        self._tick = 0
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def match(self, prompt, adapter: int = 0) -> tuple[list[int], int]:
+        """(shared_blocks, matched_tokens) — the longest stored LCP,
+        clamped to whole blocks and to len(prompt)-1. ([], 0) on miss.
+        PURE like PrefixIndex.match: accept()/reject() report back."""
+        import numpy as np
+
+        prompt = np.asarray(prompt, np.int32)
+        limit = (len(prompt) - 1) // self._t  # blocks fully reusable
+        best, best_blocks = 0, []
+        for e in self._entries:
+            if e["adapter"] != adapter:
+                continue
+            key = e["key"]
+            n = min(len(key), len(prompt))
+            neq = np.nonzero(key[:n] != prompt[:n])[0]
+            m = int(neq[0]) if len(neq) else n
+            nb = min(m // self._t, limit)
+            if nb * self._t > best:
+                best = nb * self._t
+                best_blocks = e["blocks"][:nb]
+        return (list(best_blocks), best) if best else ([], 0)
+
+    def accept(self, blocks: list[int]) -> None:
+        """A hit went live: count it, touch the owning entry's LRU."""
+        self.hits += 1
+        self._tick += 1
+        lead = blocks[0] if blocks else -1
+        for e in self._entries:
+            if e["blocks"] and e["blocks"][0] == lead:
+                e["used"] = self._tick
+
+    def reject(self) -> None:
+        self.misses += 1
+
+    def covered(self, prompt, adapter: int = 0) -> bool:
+        """True when some entry already stores >= this prompt's full
+        blocks with identical tokens — storing again would only
+        duplicate references."""
+        import numpy as np
+
+        prompt = np.asarray(prompt, np.int32)
+        n_full = len(prompt) // self._t
+        if n_full == 0:
+            return True  # nothing storable
+        head = prompt[:n_full * self._t]
+        for e in self._entries:
+            if e["adapter"] == adapter and len(e["key"]) >= len(head) \
+                    and np.array_equal(e["key"][:len(head)], head):
+                return True
+        return False
+
+    def store(self, prompt, blocks: list[int], adapter: int = 0) -> None:
+        """Record ``prompt``'s full blocks as an entry, holding one
+        reference on each (zero-copy: the blocks are the slot's own,
+        already written). Evicts LRU entries past capacity."""
+        import numpy as np
+
+        prompt = np.asarray(prompt, np.int32)
+        n_full = len(prompt) // self._t
+        if n_full == 0:
+            return
+        held = list(blocks[:n_full])
+        self._alloc.ref(held)
+        self._tick += 1
+        self._entries.append({"key": prompt[:n_full * self._t].copy(),
+                              "blocks": held, "adapter": int(adapter),
+                              "used": self._tick})
+        while len(self._entries) > self.max_entries:
+            self.evict_one()
+
+    def evict_one(self) -> bool:
+        """Drop the LRU entry's references (pool-pressure valve).
+        Returns False when there is nothing left to evict."""
+        if not self._entries:
+            return False
+        victim = min(range(len(self._entries)),
+                     key=lambda i: self._entries[i]["used"])
+        e = self._entries.pop(victim)
+        self._alloc.free(e["blocks"])
+        return True
+
+    def invalidate_adapter(self, adapter: int) -> int:
+        """Drop every entry stored under ``adapter`` (LoRA hot-swap:
+        stored KV flowed through the OLD wk/wv)."""
+        keep, dropped = [], 0
+        for e in self._entries:
+            if e["adapter"] == int(adapter):
+                self._alloc.free(e["blocks"])
+                dropped += 1
+            else:
+                keep.append(e)
+        self._entries = keep
+        return dropped
+
+    def stats(self) -> dict:
+        return {"slots": self.max_entries, "entries": len(self._entries),
+                "hits": self.hits, "misses": self.misses,
+                "blocks_held": sum(len(e["blocks"]) for e in self._entries)}

@@ -254,9 +254,9 @@ class GenerationEngine:
                 raise ValueError("paged_blocks requires a single-device "
                                  "engine (the kernel's block-table "
                                  "prefetch does not partition)")
-            if prefix_cache_slots or spec_decode_k:
+            if spec_decode_k:
                 raise ValueError("paged_blocks does not compose with "
-                                 "prefix_cache_slots/spec_decode_k yet")
+                                 "spec_decode_k yet")
             self._block_t = int(paged_block_size)
             self._mb = -(-self.max_seq // self._block_t)
             min_blocks = 2 + (self.prompt_buckets[-1] // self._block_t)
@@ -264,13 +264,27 @@ class GenerationEngine:
                 raise ValueError(f"paged_blocks={paged_blocks} too small: "
                                  f"need >= {min_blocks} (trash block + "
                                  "one prompt's worth)")
-            from ..models.paged_llama import BlockAllocator
+            from ..models.paged_llama import (BlockAllocator,
+                                              SharedPrefixIndex)
 
             self._alloc = BlockAllocator(paged_blocks)
             self._table = np.zeros((slots, self._mb), np.int32)
             self._slot_blocks: list[list[int]] = [[] for _ in range(slots)]
             self._cursors = np.zeros((slots,), np.int64)  # device cursor
             self._paged_evictions = 0
+            self._prefix_idx = None
+            if prefix_cache_slots > 0:
+                # ZERO-COPY prefix cache over the pool itself: entries
+                # hold refcounted references to a stored prompt's full
+                # blocks (no KV moves to store); a hit refs the shared
+                # blocks into the new slot's table and prefill resumes
+                # at the match point via the scratch row. Evictable
+                # under pool pressure.
+                self._prefix_idx = SharedPrefixIndex(prefix_cache_slots,
+                                                     self._alloc,
+                                                     self._block_t)
+                self._store_min = int(prefix_store_min
+                                      or self.prompt_buckets[-1])
         self.logger = logger
         self.metrics = metrics
         self.mesh = mesh
@@ -305,17 +319,20 @@ class GenerationEngine:
         # cache and the row copies run mask-and-reduce (_copy_row_masked)
         # instead of traced-index dynamic slices, which GSPMD could only
         # lower by replicating the cache; the jits are built after the
-        # mesh block below, where the shardings exist.
-        self._prefix_idx = None
+        # mesh block below, where the shardings exist. (Paged engines
+        # built their zero-copy SharedPrefixIndex above instead — no
+        # side pool, the entries reference pool blocks directly.)
         self._pool = None
-        if prefix_cache_slots > 0:
-            from .prefix_cache import PrefixIndex
+        if not self._paged:
+            self._prefix_idx = None
+            if prefix_cache_slots > 0:
+                from .prefix_cache import PrefixIndex
 
-            self._prefix_idx = PrefixIndex(prefix_cache_slots)
-            self._pool = llama.init_cache(cfg, prefix_cache_slots,
-                                          self.max_seq, dtype=kv_dtype)
-            self._store_min = int(prefix_store_min
-                                  or self.prompt_buckets[-1])
+                self._prefix_idx = PrefixIndex(prefix_cache_slots)
+                self._pool = llama.init_cache(cfg, prefix_cache_slots,
+                                              self.max_seq, dtype=kv_dtype)
+                self._store_min = int(prefix_store_min
+                                      or self.prompt_buckets[-1])
 
         # Prompt-lookup speculative decoding (greedy slots only): each
         # tick proposes K draft tokens per slot by matching the trailing
@@ -401,13 +418,16 @@ class GenerationEngine:
             self._prefill_jit = jax.jit(self._paged_prefill_fn,
                                         donate_argnums=(0,))
             self._step_jit = jax.jit(self._paged_step_fn, donate_argnums=(0,))
-            if self.max_seq - 1 > self.prompt_buckets[-1]:
-                # Long-prompt admission: the chunk lattice runs against a
-                # dense single-slot SCRATCH row (identical programs to the
-                # contiguous engine's, B=1), then one dispatch lands the
-                # row in the pool (paged_llama.write_row_to_blocks). The
-                # scratch costs one slot-row of HBM (~67 MB at 8B/1024).
-                from ..models.paged_llama import write_row_to_blocks
+            if (self.max_seq - 1 > self.prompt_buckets[-1]
+                    or self._prefix_idx is not None):
+                # Long-prompt admission AND prefix-hit resume both run
+                # the chunk lattice against a dense single-slot SCRATCH
+                # row (identical programs to the contiguous engine's,
+                # B=1), then one dispatch lands the row in the pool
+                # (paged_llama.write_row_to_blocks). The scratch costs
+                # one slot-row of HBM (~67 MB at 8B/1024).
+                from ..models.paged_llama import (read_blocks_to_row,
+                                                  write_row_to_blocks)
 
                 self._scratch = llama.init_cache(cfg, 1, self.max_seq,
                                                  dtype=kv_dtype)
@@ -416,6 +436,8 @@ class GenerationEngine:
                 self._chunk_final_jit = jax.jit(self._chunk_final,
                                                 donate_argnums=(0,))
                 self._row_to_blocks_jit = jax.jit(write_row_to_blocks,
+                                                  donate_argnums=(0,))
+                self._blocks_to_row_jit = jax.jit(read_blocks_to_row,
                                                   donate_argnums=(0,))
         else:
             self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(0,))
@@ -842,6 +864,11 @@ class GenerationEngine:
                         self._row_to_blocks_jit(
                             self.cache, self._scratch,
                             jnp.zeros((self._mb,), jnp.int32)))
+                    # prefix-hit restore program (trash-block gather)
+                    self._scratch = jax.block_until_ready(
+                        self._blocks_to_row_jit(
+                            self._scratch, self.cache,
+                            jnp.zeros((self._mb,), jnp.int32)))
             elif self.logger is not None:
                 self.logger.debug({"event": "generator warmup skipped prefill",
                                    "reason": "no free slot"})
@@ -990,14 +1017,51 @@ class GenerationEngine:
                 blocks = None
                 if self._paged:
                     T = self._block_t
-                    blocks = self._alloc.alloc(-(-len(req.prompt) // T))
-                    if blocks is None:
+                    shared, m = [], 0
+                    if self._prefix_idx is not None:
+                        shared, m = self._prefix_idx.match(
+                            np.asarray(req.prompt, np.int32), req.adapter)
+                        if m:
+                            # the resumed lattice's final chunk must be a
+                            # valid window: same reject-to-miss guard as
+                            # the contiguous _prefix_restore (a padded
+                            # bucket wider than the prompt would slice
+                            # off-lattice with a negative start)
+                            L = len(req.prompt)
+                            C = self.prompt_buckets[-1]
+                            rem = L - m
+                            while rem > C:
+                                rem -= C
+                            if L - pad_bucket(rem, self.prompt_buckets) < 0:
+                                shared, m = [], 0
+                        if shared:
+                            # take the slot's hold NOW: the evict-retry
+                            # below could otherwise free the matched
+                            # entry's blocks out from under us
+                            self._alloc.ref(shared)
+                    need = -(-len(req.prompt) // T) - len(shared)
+                    fresh = self._alloc.alloc(need)
+                    while fresh is None and self._prefix_idx is not None \
+                            and self._prefix_idx.evict_one():
+                        fresh = self._alloc.alloc(need)
+                    if fresh is None:
                         # transient pool pressure: requeue and let active
                         # slots retire blocks. (FIFO order is not
                         # preserved across the requeue — pool-pressure
                         # reordering is documented engine behavior.)
+                        if shared:
+                            self._alloc.free(shared)
                         self._pending.put(req)
                         return
+                    if self._prefix_idx is not None:
+                        if m:
+                            self._prefix_idx.accept(shared)
+                            if self.metrics is not None:
+                                self.metrics.increment_counter(
+                                    "app_tpu_prefix_cache_hits_total")
+                        else:
+                            self._prefix_idx.reject()
+                    blocks = (shared, m, fresh)
                 self._start(idx, slot, req, blocks)
             finally:
                 self._admitting -= 1
@@ -1070,16 +1134,21 @@ class GenerationEngine:
 
     # -- paged-mode host side ------------------------------------------------
     def _paged_admit_prefill(self, idx: int, req: _Request,
-                             blocks: list[int]) -> tuple[int, float]:
-        """Paged admission: ``blocks`` (allocated by _admit, ceil(L/T))
-        become the slot's blocks; the bucket-padded KV write targets
-        them plus trash-block entries for the padding tail. Prompts past
-        the largest bucket chunk-prefill into the dense scratch row
-        (identical lattice to the contiguous engine, decode interleaved
-        between chunks), then one dispatch lands the row in the pool."""
+                             shared: list[int], m: int,
+                             fresh: list[int]) -> tuple[int, float]:
+        """Paged admission. ``shared``/``m``: prefix-cache hit — m
+        tokens of KV already live in ``shared`` pool blocks (the slot
+        holds a reference, taken at _admit); ``fresh``: newly allocated
+        blocks for the rest. Bucket-lattice prompts without a hit go
+        through one padded prefill dispatch; everything else (long
+        prompts, any hit) resumes the chunk lattice on the dense
+        scratch row — for hits, the shared blocks gather into the
+        scratch first and only the FRESH region writes back, so shared
+        blocks are never rewritten."""
         L = len(req.prompt)
         T = self._block_t
         C = self.prompt_buckets[-1]
+        blocks = shared + fresh
         self._slot_adapter[idx] = req.adapter
         # Register the blocks as the slot's FIRST — every exit path
         # (cancel mid-lattice included) then frees them through the
@@ -1089,7 +1158,7 @@ class GenerationEngine:
         self._slot_blocks[idx] = blocks
         self._cursors[idx] = L
         self._write_table_row(idx)
-        if L <= C:
+        if m == 0 and L <= C:
             Sb = pad_bucket(L, self.prompt_buckets)
             n_wr = -(-Sb // T)
             write_blocks = blocks + [0] * (n_wr - len(blocks))
@@ -1101,10 +1170,19 @@ class GenerationEngine:
                 jnp.float32(req.temperature), jnp.int32(req.top_k),
                 self._next_key(), self._adapter1(req))
             return int(tok), float(lp)
-        tok, lp = self._chunk_lattice("_scratch", 0, req)
+        if m > 0:
+            # restore: shared blocks -> scratch positions [0, m)
+            read_blocks = shared + [0] * (self._mb - len(shared))
+            self._scratch = self._blocks_to_row_jit(
+                self._scratch, self.cache,
+                jnp.asarray(read_blocks, jnp.int32))
+        tok, lp = self._chunk_lattice("_scratch", 0, req, pos=m)
         if req.stream.cancelled.is_set():
             return tok, lp  # slot retires at _deliver; blocks free there
-        write_blocks = blocks + [0] * (self._mb - len(blocks))
+        # write back only the FRESH region: scratch rows for the shared
+        # blocks (identical data) route to the trash block
+        write_blocks = [0] * len(shared) + fresh \
+            + [0] * (self._mb - len(blocks))
         self.cache = self._row_to_blocks_jit(
             self.cache, self._scratch,
             jnp.asarray(write_blocks, jnp.int32))
@@ -1143,6 +1221,11 @@ class GenerationEngine:
             while len(self._slot_blocks[idx]) < need:
                 got = self._alloc.alloc(1)
                 if got is None:
+                    # prefix entries are the pressure valve: evict LRU
+                    # stored prefixes before truncating a live stream
+                    if self._prefix_idx is not None and \
+                            self._prefix_idx.evict_one():
+                        continue
                     starved = True
                     break
                 self._slot_blocks[idx].extend(got)
@@ -1205,16 +1288,27 @@ class GenerationEngine:
         if len(prompt) < self._store_min or \
                 self._prefix_idx.covered(prompt, req.adapter):
             return
+        if self._paged:
+            # zero-copy: reference the slot's full prompt blocks as a
+            # SharedPrefixIndex entry — they are immutable from here on
+            # (decode only writes the cursor's block). _start calls this
+            # AFTER the admit dispatch materialized, so a device-failed
+            # prefill can never store an entry over garbage KV.
+            self._prefix_idx.store(prompt, self._slot_blocks[idx],
+                                   req.adapter)
+            return
         row = self._prefix_idx.store_row(prompt, req.adapter)
         self._pool = self._pool_store_jit(self._pool, self.cache,
                                           jnp.int32(row), jnp.int32(idx))
 
     def _start(self, idx: int, slot: _Slot, req: _Request,
-               blocks: "list[int] | None" = None) -> None:
+               blocks: "tuple | None" = None) -> None:
         t0 = time.monotonic()
         try:
             if self._paged:
-                first, first_lp = self._paged_admit_prefill(idx, req, blocks)
+                shared, m, fresh = blocks
+                first, first_lp = self._paged_admit_prefill(
+                    idx, req, shared, m, fresh)
             else:
                 first, first_lp = self._admit_prefill(idx, req)
         except BaseException as e:  # noqa: BLE001 — the request is already
@@ -1226,11 +1320,14 @@ class GenerationEngine:
                 # them before the device error surfaces at int(tok)) —
                 # clear them BEFORE freeing, or the stale table row would
                 # direct this slot's frozen-cursor garbage writes into
-                # blocks re-issued to another live stream
+                # blocks re-issued to another live stream. The slot holds
+                # one reference on shared + fresh alike (taken in _admit
+                # / alloc); freeing drops exactly that hold.
+                shared, m, fresh = blocks
                 self._slot_blocks[idx] = []
                 self._table[idx, :] = 0
                 self._cursors[idx] = 0
-                self._alloc.free(blocks)
+                self._alloc.free(shared + fresh)
             req.stream._q.put(GenerationError(f"prefill failed: {e!r}"))
             req.stream._q.put(None)
             raise

@@ -34,18 +34,30 @@ def pytest_sessionfinish(session, exitstatus):
     import threading
     import time
 
-    deadline = time.monotonic() + 5.0
-    suspects = []
-    while time.monotonic() < deadline:
-        suspects = [
+    def suspects():
+        return [
             t for t in threading.enumerate()
             if t is not threading.main_thread() and t.is_alive()
             and (t.name.startswith(("cb-probe-", "gofr-", "jwks-refresh",
                                     "zipkin-exporter", "remote-log-level"))
                  or "probe" in t.name or "poller" in t.name)
         ]
-        if not suspects:
+
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if not suspects():
             return
         time.sleep(0.2)
-    names = sorted(t.name for t in suspects)
+    # A gofr-tpu-gen loop thread can legitimately outlive close()'s join:
+    # it may be BLOCKED inside a device dispatch (a chunk-program compile
+    # takes 30-60 s on the virtual CPU mesh) and exits as soon as the
+    # dispatch returns — that is winding-down, not a leak. Give only
+    # those threads a compile-sized drain before failing.
+    if all(t.name == "gofr-tpu-gen" for t in suspects()):
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if not suspects():
+                return
+            time.sleep(1.0)
+    names = sorted(t.name for t in suspects())
     raise RuntimeError(f"leaked framework threads after test session: {names}")

@@ -261,7 +261,7 @@ def test_paged_engine_rejects_unsupported_combos(params):
     with pytest.raises(ValueError, match="compose"):
         GenerationEngine(TINY, params, slots=2, max_seq=64,
                          prompt_buckets=(8,), paged_blocks=8,
-                         prefix_cache_slots=2)
+                         spec_decode_k=2)
     with pytest.raises(ValueError, match="too small"):
         GenerationEngine(TINY, params, slots=2, max_seq=64,
                          prompt_buckets=(16,), paged_blocks=2,
@@ -344,6 +344,136 @@ def test_paged_structurally_oversized_prompt_fails_fast(params):
         s = eng.generate(list(range(1, 51)), max_new_tokens=2)  # needs 4
         with pytest.raises(Exception, match="pool blocks"):
             s.tokens()
+    finally:
+        eng.close()
+
+
+def test_refcounted_allocator():
+    a = BlockAllocator(5)            # blocks 1..4 usable
+    x = a.alloc(2)
+    a.ref(x)                         # second holder (a prefix entry)
+    a.free(x)                        # first holder retires
+    assert a.free_blocks == 2        # still held by the entry
+    a.free(x)                        # entry evicted
+    assert a.free_blocks == 4
+
+
+def test_shared_prefix_index_zero_copy_semantics():
+    from gofr_tpu.models.paged_llama import SharedPrefixIndex
+
+    a = BlockAllocator(10)
+    idx = SharedPrefixIndex(2, a, block_size=4)
+    p1 = np.arange(1, 11, dtype=np.int32)          # 10 tokens = 2.5 blocks
+    b1 = a.alloc(3)
+    idx.store(p1, b1, adapter=0)                   # refs the 2 FULL blocks
+    a.free(b1)                                      # the slot retires
+    assert a.free_blocks == 10 - 1 - 2              # entry still holds 2
+    # exact-prefix continuation: both full blocks reusable
+    blocks, m = idx.match(np.concatenate([p1, [99, 98]]), 0)
+    assert m == 8 and blocks == b1[:2]
+    # partial overlap: only the first block's tokens agree
+    p2 = np.concatenate([p1[:6], [77, 77, 77, 77]]).astype(np.int32)
+    blocks, m = idx.match(p2, 0)
+    assert m == 4 and blocks == b1[:1]
+    # never consumes the whole prompt (>= 1 token recomputes)
+    blocks, m = idx.match(p1[:8], 0)
+    assert m == 4
+    # adapters never cross
+    assert idx.match(p1, adapter=1) == ([], 0)
+    # eviction returns the blocks
+    assert idx.evict_one()
+    assert a.free_blocks == 10 - 1
+
+
+@pytest.mark.parametrize("kv_dtype", [None, jnp.int8])
+def test_paged_prefix_hits_stream_exact_tokens(params, kv_dtype):
+    """The zero-copy prefix cache: a stored prompt's blocks are SHARED
+    into later slots (no KV copied to store) and hit streams equal the
+    prefix-less contiguous engine's exactly — incl. a partial-overlap
+    hit and an exact repeat."""
+    rng = np.random.default_rng(17)
+    prefix = rng.integers(1, TINY.vocab_size, 36).tolist()  # 2 full 16-blocks
+    cont = prefix + rng.integers(1, TINY.vocab_size, 6).tolist()
+    part = prefix[:20] + rng.integers(1, TINY.vocab_size, 8).tolist()
+    dense = GenerationEngine(TINY, params, slots=2, max_seq=64,
+                             prompt_buckets=(8, 16), kv_dtype=kv_dtype)
+    try:
+        oracle = {tuple(p): dense.generate(p, max_new_tokens=6).tokens()
+                  for p in (prefix, cont, part)}
+    finally:
+        dense.close()
+    eng = GenerationEngine(TINY, params, slots=2, max_seq=64,
+                           prompt_buckets=(8, 16), kv_dtype=kv_dtype,
+                           paged_blocks=13, paged_block_size=16,
+                           prefix_cache_slots=2, prefix_store_min=16)
+    try:
+        assert eng.generate(prefix, max_new_tokens=6).tokens() == \
+            oracle[tuple(prefix)]
+        st = eng.stats()["prefix_cache"]
+        assert st["entries"] == 1 and st["blocks_held"] == 2
+        for p in (cont, part, prefix):  # full hit, partial hit, repeat
+            assert eng.generate(p, max_new_tokens=6).tokens() == \
+                oracle[tuple(p)], f"prompt len {len(p)}"
+        assert eng.stats()["prefix_cache"]["hits"] >= 3
+        # all slots retired: only the entries hold blocks
+        free = eng.stats()["paged"]["free"]
+        held = eng.stats()["prefix_cache"]["blocks_held"]
+        assert free + held == eng.stats()["paged"]["blocks"]
+    finally:
+        eng.close()
+
+
+def test_paged_prefix_off_lattice_window_degrades_to_miss(params):
+    """A hit whose resumed final-chunk window would pad wider than the
+    prompt (negative start — off the compiled lattice) must downgrade to
+    a miss and still stream the exact reference tokens (the same
+    reject-to-miss guard the contiguous _prefix_restore has)."""
+    rng = np.random.default_rng(23)
+    base = rng.integers(1, TINY.vocab_size, 16).tolist()
+    short = base[:8] + rng.integers(1, TINY.vocab_size, 2).tolist()  # L=10
+    dense = GenerationEngine(TINY, params, slots=2, max_seq=64,
+                             prompt_buckets=(16,))
+    try:
+        want = dense.generate(short, max_new_tokens=6).tokens()
+    finally:
+        dense.close()
+    eng = GenerationEngine(TINY, params, slots=2, max_seq=64,
+                           prompt_buckets=(16,), paged_blocks=9,
+                           paged_block_size=8, prefix_cache_slots=2,
+                           prefix_store_min=16)
+    try:
+        eng.generate(base, max_new_tokens=2).tokens()   # stores 2 blocks
+        got = eng.generate(short, max_new_tokens=6).tokens()
+        assert got == want
+        # the 8-token match existed but the window was invalid: no hit
+        assert eng.stats()["prefix_cache"]["hits"] == 0
+    finally:
+        eng.close()
+
+
+def test_paged_prefix_entries_evict_under_pool_pressure(params):
+    """Stored entries are the pool's pressure valve: when a live stream
+    needs a block and none are free, LRU entries evict (no stream
+    truncation) and their blocks recycle."""
+    rng = np.random.default_rng(19)
+    p1 = rng.integers(1, TINY.vocab_size, 16).tolist()
+    p2 = rng.integers(1, TINY.vocab_size, 16).tolist()
+    eng = GenerationEngine(TINY, params, slots=1, max_seq=64,
+                           prompt_buckets=(8, 16), paged_blocks=5,
+                           paged_block_size=16, prefix_cache_slots=2,
+                           prefix_store_min=16)
+    try:
+        # p1 stores a 1-block entry and retires (entry keeps the block);
+        # p2's long decode then needs all 4 usable blocks — the entry
+        # must evict mid-decode, the stream must NOT truncate
+        eng.generate(p1, max_new_tokens=2).tokens()
+        assert eng.stats()["prefix_cache"]["entries"] == 1
+        got = eng.generate(p2, max_new_tokens=40).tokens()
+        assert len(got) == 40
+        st = eng.stats()
+        assert st["paged"]["evictions"] == 0          # no truncation
+        assert st["prefix_cache"]["entries"] <= 1     # p1's entry evicted
+        # (p2's own entry may have been stored after the eviction)
     finally:
         eng.close()
 
