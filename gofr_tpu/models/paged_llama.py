@@ -166,6 +166,84 @@ def _reference_attention(q, k_pool, v_pool, k_new, v_new, table, lengths,
                                      table, lengths, k_scale, v_scale)
 
 
+def paged_verify_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
+                      cache: PagedKVCache, table: jnp.ndarray,
+                      rope_tables=None, adapter=None
+                      ) -> tuple[jnp.ndarray, PagedKVCache]:
+    """Speculative-decoding verify pass over the paged pool — the exact
+    contract of llama.verify_step (logits [B, W, V]; lengths returned
+    UNCHANGED, acceptance is the caller's; W KV rows written at each
+    slot's cursor), with the pool addressed through ``table``.
+
+    Attention runs window_attention_appended over a dense GATHER of each
+    slot's blocks — one layer's dense view materializes transiently per
+    scan step (~270 MB at 8B/batch-128, reused across layers by XLA).
+    That costs more HBM traffic than the paged decode kernel, but verify
+    passes amortize the WEIGHT stream over up to W tokens, which is the
+    win speculative decoding exists for; a windowed scalar-prefetch
+    kernel can replace the gather later without touching this contract.
+
+    CAPACITY CONTRACT (same as verify_step): callers must only honor
+    acceptance for slots with lengths + W <= capacity; rows past
+    capacity route to the trash block, mirroring the contiguous
+    scatter's mode=\"drop\"."""
+    from ..ops.attention import window_attention_appended
+    from ..ops.paged_attention import gather_blocks
+
+    cfg = multi_request_serving_config(cfg)
+    B, W = tokens.shape
+    T = cache.block_size
+    mb = table.shape[1]
+    cos, sin = rope_tables or get_rope_tables(cfg, mb * T)
+    positions = cache.lengths[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+    lengths = cache.lengths
+
+    x = params["embedding"][tokens].astype(cfg.jdtype)  # [B, W, D]
+
+    def body(x, xs):
+        layer_w, k_layer, v_layer, ks_layer, vs_layer = xs
+        k_dense = gather_blocks(k_layer, table)
+        v_dense = gather_blocks(v_layer, table)
+        ks_dense = gather_blocks(ks_layer, table) if ks_layer is not None \
+            else None
+        vs_dense = gather_blocks(vs_layer, table) if vs_layer is not None \
+            else None
+
+        def attend(q, k_new, v_new):
+            return window_attention_appended(q, k_dense, v_dense, k_new,
+                                             v_new, lengths, ks_dense,
+                                             vs_dense)
+
+        x, kv, _ = _layer(x, layer_w, cfg, cos, sin, positions,
+                          kv_write=lambda k, v: (k, v), attend=attend,
+                          adapter=adapter)
+        return x, kv
+
+    x, (k_w, v_w) = jax.lax.scan(
+        body, x, (params["layers"], cache.k, cache.v,
+                  cache.k_scale, cache.v_scale))
+    # one scatter for all layers and window rows into pool coordinates
+    blk = jnp.take_along_axis(
+        table, jnp.minimum(positions // T, mb - 1), axis=1)   # [B, W]
+    blk = jnp.where(positions < mb * T, blk, 0)               # trash OOB
+    off = positions % T
+    if cache.quantized:
+        qk, sk = quantize_kv(k_w)
+        qv, sv = quantize_kv(v_w)
+        new = cache._replace(
+            k=cache.k.at[:, blk, off].set(qk),
+            v=cache.v.at[:, blk, off].set(qv),
+            k_scale=cache.k_scale.at[:, blk, off].set(sk),
+            v_scale=cache.v_scale.at[:, blk, off].set(sv),
+            lengths=lengths)
+    else:
+        new = cache._replace(
+            k=cache.k.at[:, blk, off].set(k_w.astype(cache.k.dtype)),
+            v=cache.v.at[:, blk, off].set(v_w.astype(cache.v.dtype)),
+            lengths=lengths)
+    return _logits(params, cfg, x), new
+
+
 def write_prompt_blocks(cache: PagedKVCache, k_stack, v_stack,
                         blocks: jnp.ndarray, length) -> PagedKVCache:
     """Write one admitted prompt's KV stacks [L, 1, S, KV, hd] into its

@@ -44,10 +44,10 @@ Config keys (reference config style, pkg/gofr/config/config.go:3):
                       share fixed-size blocks via a block table, so HBM
                       sizes to expected LIVE tokens and decode batch
                       scales past what [slots, max_seq] rows fit
-                      (models/paged_llama.py; single-device, no spec
-                      decode yet; long prompts chunk via a dense
-                      scratch row; with TPU_PREFIX_CACHE the prefix
-                      cache becomes zero-copy block sharing)
+                      (models/paged_llama.py; single-device; long
+                      prompts chunk via a dense scratch row; composes
+                      with TPU_SPEC_DECODE, and with TPU_PREFIX_CACHE
+                      the prefix cache becomes zero-copy block sharing)
   TPU_PAGED_BLOCK     block size in tokens (default 128)
   TPU_LORA_ADAPTERS   multi-LoRA serving: adapter slots (default 0 =
                       off; slot 0 is the base no-op). Per-request

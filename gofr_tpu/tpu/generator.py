@@ -254,9 +254,6 @@ class GenerationEngine:
                 raise ValueError("paged_blocks requires a single-device "
                                  "engine (the kernel's block-table "
                                  "prefetch does not partition)")
-            if spec_decode_k:
-                raise ValueError("paged_blocks does not compose with "
-                                 "spec_decode_k yet")
             self._block_t = int(paged_block_size)
             self._mb = -(-self.max_seq // self._block_t)
             min_blocks = 2 + (self.prompt_buckets[-1] // self._block_t)
@@ -418,6 +415,9 @@ class GenerationEngine:
             self._prefill_jit = jax.jit(self._paged_prefill_fn,
                                         donate_argnums=(0,))
             self._step_jit = jax.jit(self._paged_step_fn, donate_argnums=(0,))
+            if self._spec_k:
+                self._verify_jit = jax.jit(self._paged_verify_fn,
+                                           donate_argnums=(0,))
             if (self.max_seq - 1 > self.prompt_buckets[-1]
                     or self._prefix_idx is not None):
                 # Long-prompt admission AND prefix-hit resume both run
@@ -588,6 +588,25 @@ class GenerationEngine:
         last = jnp.take(logits[0], length - 1, axis=0)
         tok, lp = self._sample(last[None, :], temp[None], key, top_k[None])
         return tok[0], lp[0], cache
+
+    def _paged_verify_fn(self, cache, params, window, active, key, table,
+                         adapter=None):
+        """_verify_fn over the paged pool (models.paged_llama.
+        paged_verify_step): same greedy/accept/emit semantics, window KV
+        routed through the block table."""
+        from ..models import paged_llama
+
+        logits, stepped = paged_llama.paged_verify_step(
+            params, self.cfg, window, cache, table,
+            rope_tables=self.rope_tables, adapter=adapter)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        lps = jnp.take_along_axis(logp, greedy[..., None], axis=-1)[..., 0]
+        agree = (greedy[:, :-1] == window[:, 1:]).astype(jnp.int32)
+        accept = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)
+        emit = jnp.where(active, accept + 1, 0)
+        lengths = stepped.lengths + emit
+        return greedy, lps, emit, stepped._replace(lengths=lengths)
 
     def _paged_step_fn(self, cache, params, last_tokens, active, temps,
                        top_ks, key, table, adapter=None):
@@ -895,13 +914,21 @@ class GenerationEngine:
                 # otherwise compile mid-serving under the device lock,
                 # freezing every live stream. All-inactive dispatch:
                 # emit 0, cursors frozen, garbage KV lands beyond
-                # cursors like the step warmup's.
+                # cursors (paged: in the trash block via a zeroed table)
+                # like the step warmup's.
                 window = jnp.zeros((self.n_slots, self._spec_k + 1),
                                    jnp.int32)
-                _, _, _, cache_w = self._verify_jit(
-                    self.cache, self.params, window,
-                    jnp.zeros((self.n_slots,), bool), self._key,
-                    self._adapters())
+                if self._paged:
+                    _, _, _, cache_w = self._verify_jit(
+                        self.cache, self.params, window,
+                        jnp.zeros((self.n_slots,), bool), self._key,
+                        jnp.zeros_like(jnp.asarray(self._table)),
+                        self._adapters())
+                else:
+                    _, _, _, cache_w = self._verify_jit(
+                        self.cache, self.params, window,
+                        jnp.zeros((self.n_slots,), bool), self._key,
+                        self._adapters())
                 self.cache = jax.block_until_ready(cache_w)
             # restore cursors dirtied by the dummy dispatches
             self.cache = self.cache._replace(lengths=jnp.asarray(cursors))
@@ -1021,19 +1048,9 @@ class GenerationEngine:
                     if self._prefix_idx is not None:
                         shared, m = self._prefix_idx.match(
                             np.asarray(req.prompt, np.int32), req.adapter)
-                        if m:
-                            # the resumed lattice's final chunk must be a
-                            # valid window: same reject-to-miss guard as
-                            # the contiguous _prefix_restore (a padded
-                            # bucket wider than the prompt would slice
-                            # off-lattice with a negative start)
-                            L = len(req.prompt)
-                            C = self.prompt_buckets[-1]
-                            rem = L - m
-                            while rem > C:
-                                rem -= C
-                            if L - pad_bucket(rem, self.prompt_buckets) < 0:
-                                shared, m = [], 0
+                        if m and not self._lattice_resume_valid(
+                                len(req.prompt), m):
+                            shared, m = [], 0  # off-lattice: full recompute
                         if shared:
                             # take the slot's hold NOW: the evict-retry
                             # below could otherwise free the matched
@@ -1093,6 +1110,18 @@ class GenerationEngine:
                 self._adapter1(req))
             return int(tok), float(lp)
         return self._chunk_lattice("cache", idx, req, pos)
+
+    def _lattice_resume_valid(self, L: int, m: int) -> bool:
+        """Can the chunk lattice resume at position ``m`` of an L-token
+        prompt? The final chunk's bucket must not pad wider than the
+        prompt (a negative window start would slice off the compiled
+        lattice) — the shared reject-to-miss guard for prefix hits on
+        both engine kinds."""
+        C = self.prompt_buckets[-1]
+        rem = L - m
+        while rem > C:
+            rem -= C
+        return L - pad_bucket(rem, self.prompt_buckets) >= 0
 
     def _chunk_lattice(self, attr: str, slot: int, req: _Request,
                        pos: int = 0) -> tuple[int, float]:
@@ -1154,10 +1183,16 @@ class GenerationEngine:
         # (cancel mid-lattice included) then frees them through the
         # normal _retire, instead of leaking pool blocks the allocator
         # handed _admit (_start's exception path clears this state
-        # itself before freeing).
+        # itself before freeing). The TABLE row, however, stays zeroed
+        # (trash-routed) until admission completes: the device cursor is
+        # still the slot's STALE previous length, and the decode ticks
+        # interleaved into the chunk lattice write garbage KV for
+        # inactive slots at that cursor — through an installed row that
+        # garbage would land inside the new blocks (for a prefix hit,
+        # inside SHARED blocks, permanently corrupting every other
+        # holder; the write-back only repairs the fresh region).
         self._slot_blocks[idx] = blocks
         self._cursors[idx] = L
-        self._write_table_row(idx)
         if m == 0 and L <= C:
             Sb = pad_bucket(L, self.prompt_buckets)
             n_wr = -(-Sb // T)
@@ -1169,6 +1204,7 @@ class GenerationEngine:
                 jnp.asarray(write_blocks, jnp.int32), jnp.int32(idx),
                 jnp.float32(req.temperature), jnp.int32(req.top_k),
                 self._next_key(), self._adapter1(req))
+            self._write_table_row(idx)
             return int(tok), float(lp)
         if m > 0:
             # restore: shared blocks -> scratch positions [0, m)
@@ -1188,6 +1224,7 @@ class GenerationEngine:
             jnp.asarray(write_blocks, jnp.int32))
         self.cache = self.cache._replace(
             lengths=self.cache.lengths.at[idx].set(L))
+        self._write_table_row(idx)
         return tok, lp
 
     def _write_table_row(self, idx: int) -> None:
@@ -1203,13 +1240,14 @@ class GenerationEngine:
         self._table[idx, :n] = blocks[:n]
         self._table[idx, n:] = blocks[n - 1]
 
-    def _ensure_blocks(self) -> None:
+    def _ensure_blocks(self, horizon: int | None = None) -> None:
         """Pre-dispatch invariant: every active slot owns blocks covering
-        its next ``decode_block`` positions. On pool exhaustion the slot
-        that cannot grow is retired early (its stream ends as if at
-        capacity) — freeing its blocks for the rest of the batch; the
-        eviction is logged and counted."""
-        K = self.decode_block
+        its next ``horizon`` positions (default: one decode block; verify
+        ticks pass their window width). On pool exhaustion the slot that
+        cannot grow is retired early (its stream ends as if at capacity)
+        — freeing its blocks for the rest of the batch; the eviction is
+        logged and counted."""
+        K = horizon or self.decode_block
         T = self._block_t
         for idx, slot in enumerate(self._slots):
             if not self._active[idx]:
@@ -1258,15 +1296,12 @@ class GenerationEngine:
         prompt = np.asarray(req.prompt, np.int32)
         row, m = self._prefix_idx.match(prompt, req.adapter)
         m_eff = min(int(m), L - 1)
-        rem = L - m_eff
-        while rem > C:
-            rem -= C
         if (row < 0
                 # matched less than the smallest bucket: the copy would
                 # not remove a single dispatch's worth of work
                 or m_eff < self.prompt_buckets[0]
                 # the final chunk needs [L - Sb, L) to be a valid window
-                or L - pad_bucket(rem, self.prompt_buckets) < 0):
+                or not self._lattice_resume_valid(L, m_eff)):
             self._prefix_idx.reject()
             return 0
         self.cache = self._pool_load_jit(self.cache, self._pool,
@@ -1512,12 +1547,26 @@ class GenerationEngine:
         for idx, d in drafts.items():
             if d is not None:
                 window[idx, 1:] = d
-        toks, lps, emit, self.cache = self._verify_jit(
-            self.cache, self.params, jnp.asarray(window),
-            jnp.asarray(self._active), self._next_key(), self._adapters())
+        if self._paged:
+            self._ensure_blocks(W)  # window rows span up to W positions
+            if not self._active.any():
+                return
+            toks, lps, emit, self.cache = self._verify_jit(
+                self.cache, self.params, jnp.asarray(window),
+                jnp.asarray(self._active), self._next_key(),
+                jnp.asarray(self._table), self._adapters())
+        else:
+            toks, lps, emit, self.cache = self._verify_jit(
+                self.cache, self.params, jnp.asarray(window),
+                jnp.asarray(self._active), self._next_key(),
+                self._adapters())
         toks_np, lps_np, emit_np = jax.device_get((toks, lps, emit))
         self._spec_windows += int(self._active.sum())
         self._spec_emitted += int(emit_np.sum())
+        if self._paged:
+            # device cursors advanced by emit (accepted tokens only)
+            for idx in range(self.n_slots):
+                self._cursors[idx] += int(emit_np[idx])
         for idx, slot in enumerate(self._slots):
             if not self._active[idx]:
                 continue

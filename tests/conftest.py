@@ -24,6 +24,28 @@ assert jax.devices()[0].platform == "cpu", "tests must run on the CPU backend"
 assert len(jax.devices()) == 8, "tests expect a virtual 8-device CPU mesh"
 
 
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _release_compiled_executables_between_modules():
+    """Cap the process's memory-map count. Every compiled XLA executable
+    holds mmap'd code; the suite compiles thousands of programs and the
+    map count grows ~1.5k/min toward vm.max_map_count (65530 here) —
+    past it, mmap fails inside the compiler and the process SEGFAULTS
+    (observed twice at ~90% of the full suite, always inside
+    backend_compile_and_load, never reproducible solo). Dropping the
+    jit caches at module boundaries frees executables whose owners
+    (closed engines, module-scoped models) are gone; the cost is
+    cross-module recompiles, which are rare since shapes differ per
+    module anyway."""
+    yield
+    import gc
+
+    jax.clear_caches()
+    gc.collect()
+
+
 def pytest_sessionfinish(session, exitstatus):
     """Fail loudly on leaked worker threads (VERDICT r3 weak #6: a
     circuit breaker outlived its server and health-probed a dead port

@@ -258,10 +258,6 @@ def test_paged_engine_rejects_unsupported_combos(params):
         GenerationEngine(TINY, parallel.shard_params(params, mesh),
                          slots=2, max_seq=64, prompt_buckets=(8,),
                          mesh=mesh, paged_blocks=8)
-    with pytest.raises(ValueError, match="compose"):
-        GenerationEngine(TINY, params, slots=2, max_seq=64,
-                         prompt_buckets=(8,), paged_blocks=8,
-                         spec_decode_k=2)
     with pytest.raises(ValueError, match="too small"):
         GenerationEngine(TINY, params, slots=2, max_seq=64,
                          prompt_buckets=(16,), paged_blocks=2,
@@ -451,6 +447,45 @@ def test_paged_prefix_off_lattice_window_degrades_to_miss(params):
         eng.close()
 
 
+def test_paged_prefix_hit_with_interleaved_decode_never_corrupts_shared(
+        params):
+    """A prefix hit whose remainder needs MID chunks interleaves decode
+    ticks into its admission; the admitted slot's stale device cursor
+    must not let those ticks scatter garbage into SHARED blocks (the
+    write-back only repairs the fresh region). After the storm, a THIRD
+    request hitting the same shared blocks must still stream the exact
+    reference tokens."""
+    rng = np.random.default_rng(29)
+    prefix = rng.integers(1, TINY.vocab_size, 33).tolist()   # 2 full blocks
+    long_hit = prefix + rng.integers(1, TINY.vocab_size, 20).tolist()  # 53
+    dense = GenerationEngine(TINY, params, slots=2, max_seq=64,
+                             prompt_buckets=(8, 16))
+    try:
+        want_long = dense.generate(long_hit, max_new_tokens=4).tokens()
+        want_pfx = dense.generate(prefix, max_new_tokens=4).tokens()
+    finally:
+        dense.close()
+    eng = GenerationEngine(TINY, params, slots=2, max_seq=64,
+                           prompt_buckets=(8, 16), paged_blocks=11,
+                           paged_block_size=16, prefix_cache_slots=2,
+                           prefix_store_min=16)
+    try:
+        # seed the entry, then keep slot 0 decoding while the hit admits
+        assert eng.generate(prefix, max_new_tokens=4).tokens() == want_pfx
+        busy = eng.generate(rng.integers(1, TINY.vocab_size, 5).tolist(),
+                            max_new_tokens=48)
+        got = eng.generate(long_hit, max_new_tokens=4).tokens()
+        assert got == want_long
+        assert eng.stats()["prefix_cache"]["hits"] >= 1
+        busy.cancel()
+        list(busy)
+        # the shared blocks survived the interleaved garbage writes
+        again = eng.generate(prefix, max_new_tokens=4).tokens()
+        assert again == want_pfx
+    finally:
+        eng.close()
+
+
 def test_paged_prefix_entries_evict_under_pool_pressure(params):
     """Stored entries are the pool's pressure valve: when a live stream
     needs a block and none are free, LRU entries evict (no stream
@@ -474,6 +509,33 @@ def test_paged_prefix_entries_evict_under_pool_pressure(params):
         assert st["paged"]["evictions"] == 0          # no truncation
         assert st["prefix_cache"]["entries"] <= 1     # p1's entry evicted
         # (p2's own entry may have been stored after the eviction)
+    finally:
+        eng.close()
+
+
+@pytest.mark.parametrize("kv_dtype", [None, jnp.int8])
+def test_paged_spec_decode_matches_plain_engine(params, kv_dtype):
+    """Speculative decoding over the paged pool: repetitive greedy
+    streams equal the plain (contiguous, spec-less) engine's token for
+    token, the verify pass actually runs, and window writes cross block
+    boundaries without corruption."""
+    rep = [7, 9, 7, 9, 7, 9, 7, 9, 7, 9]
+    dense = GenerationEngine(TINY, params, slots=2, max_seq=64,
+                             prompt_buckets=(8, 16), kv_dtype=kv_dtype)
+    try:
+        want = dense.generate(rep, max_new_tokens=30).tokens()
+    finally:
+        dense.close()
+    eng = GenerationEngine(TINY, params, slots=2, max_seq=64,
+                           prompt_buckets=(8, 16), kv_dtype=kv_dtype,
+                           paged_blocks=9, paged_block_size=16,
+                           spec_decode_k=3)
+    try:
+        got = eng.generate(rep, max_new_tokens=30).tokens()
+        assert got == want
+        st = eng.stats()["spec_decode"]
+        assert st["emitted"] >= st["windows"] > 0
+        assert eng.stats()["paged"]["free"] == 8  # retired -> freed
     finally:
         eng.close()
 
