@@ -68,10 +68,30 @@ def init_backend(retries: int = 4, backoff_s: float = 20.0):
 
     def watchdog():
         if not done.wait(budget):
-            emit({"metric": "llama3_8b_int8_decode_tok_s_chip",
-                  "value": 0.0, "unit": "tok/s", "vs_baseline": 0.0,
-                  "error": f"backend init hung > {budget:.0f}s "
-                           "(tunnel outage; no grant acquired)"})
+            payload = {"metric": "llama3_8b_int8_decode_tok_s_chip",
+                       "value": 0.0, "unit": "tok/s", "vs_baseline": 0.0,
+                       "error": f"backend init hung > {budget:.0f}s "
+                                "(tunnel outage; no grant acquired)"}
+            # tools/bench_retry.sh re-attempts across the whole round; if
+            # an attempt landed a clean run RECENTLY (within 24h — a
+            # stale file from an earlier round must not be passed off as
+            # this round's measurement), point the reader at that
+            # artifact (the headline stays 0 — this run measured nothing)
+            try:
+                path = os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), "BENCH_CANDIDATE.json")
+                with open(path) as f:
+                    cand = json.load(f)
+                age_s = time.time() - os.path.getmtime(path)
+                if age_s < 24 * 3600:
+                    payload["candidate_artifact"] = (
+                        "BENCH_CANDIDATE.json: a clean run captured at "
+                        f"{cand.get('captured_at')} ({age_s / 3600:.1f}h "
+                        f"ago) measured {cand.get('value')} "
+                        f"{cand.get('unit')}")
+            except Exception:
+                pass
+            emit(payload)
             os._exit(0)
 
     threading.Thread(target=watchdog, daemon=True).start()

@@ -158,8 +158,11 @@ def paged_decode_attention(q, k_pool, v_pool, k_new, v_new, table, lengths,
 
 def gather_blocks(pool, table):
     """Dense per-slot view of a paged buffer: [N, T, ...] gathered by
-    table [B, MB] -> [B, MB*T, ...]. Materializes the full dense cache —
-    the REFERENCE/fallback path only (tests, CPU); the kernel never does
+    table [B, MB] -> [B, MB*T, ...]. Materializes the full dense cache.
+    Used by the reference/fallback attention (tests, CPU) AND by the
+    speculative-decoding verify pass (paged_llama.paged_verify_step),
+    which trades a transient per-layer dense view for the weight-stream
+    amortization a verify window buys; the DECODE kernel never does
     this."""
     g = pool[table]                       # [B, MB, T, ...]
     return g.reshape((g.shape[0], g.shape[1] * g.shape[2]) + g.shape[3:])
