@@ -82,8 +82,13 @@ def init_backend(retries: int = 4, backoff_s: float = 20.0):
                     os.path.abspath(__file__)), "BENCH_CANDIDATE.json")
                 with open(path) as f:
                     cand = json.load(f)
-                age_s = time.time() - os.path.getmtime(path)
-                if age_s < 24 * 3600:
+                # freshness from the artifact's OWN timestamp (file
+                # mtime is rewritten by checkouts/copies)
+                cap = time.strptime(cand["captured_at"],
+                                    "%Y-%m-%dT%H:%M:%SZ")
+                import calendar
+                age_s = time.time() - calendar.timegm(cap)
+                if 0 <= age_s < 24 * 3600:
                     payload["candidate_artifact"] = (
                         "BENCH_CANDIDATE.json: a clean run captured at "
                         f"{cand.get('captured_at')} ({age_s / 3600:.1f}h "
@@ -525,12 +530,16 @@ def bench_ttft(cfg, *, slots: int, probe_lens=(128, 256, 512),
 
 
 def bench_engine(cfg, *, slots: int = 48, new_tokens: int = 96,
-                 max_seq: int = 256) -> dict:
+                 max_seq: int = 256, paged_blocks: int = 0) -> dict:
     """Throughput through the FULL serving stack — engine loop,
     admission, fused decode blocks, host delivery — not just raw steps:
     fill every slot with a stream, wall-clock all tokens out. The gap to
     the raw fused-step number is the serving loop's overhead (GIL,
-    delivery, admission checks); it should be small."""
+    delivery, admission checks); it should be small.
+
+    ``paged_blocks > 0`` runs the same workload over the paged engine —
+    the serving-stack sibling of bench_paged_decode's raw-step number,
+    at slot counts the contiguous cache cannot hold."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -540,7 +549,7 @@ def bench_engine(cfg, *, slots: int = 48, new_tokens: int = 96,
     params = int8_random_params(cfg, jax.random.PRNGKey(0))
     engine = GenerationEngine(cfg, params, slots=slots, max_seq=max_seq,
                               prompt_buckets=(32,), kv_dtype=jnp.int8,
-                              decode_block=8)
+                              decode_block=8, paged_blocks=paged_blocks)
     rng = np.random.default_rng(2)
     try:
         engine.warmup()
@@ -735,6 +744,18 @@ def main() -> None:
             break
     if "paged_tok_s" in payload:
         payload.pop("paged_error", None)
+        # full serving stack over the paged pool at 128 slots (the
+        # engine-level sibling of the raw sweep above). Pool sizing: a
+        # stream's cursor peaks at 16+96=112 < 128, so one block per
+        # slot; + trash + slack ≈ 1.5 GB of pool HBM
+        try:
+            pe = bench_engine(cfg, slots=128, paged_blocks=140)
+            payload["paged_engine_tok_s"] = round(pe["tok_s"], 1)
+        except Exception as e:
+            log(f"  paged engine bench failed: "
+                f"{type(e).__name__}: {str(e)[:200]}")
+            payload["paged_engine_error"] = \
+                f"{type(e).__name__}: {str(e)[:160]}"
     emit(payload)
 
 
