@@ -175,20 +175,18 @@ def paged_verify_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     UNCHANGED, acceptance is the caller's; W KV rows written at each
     slot's cursor), with the pool addressed through ``table``.
 
-    Attention runs window_attention_appended over a dense GATHER of each
-    slot's blocks — one layer's dense view materializes transiently per
-    scan step (~270 MB at 8B/batch-128, reused across layers by XLA).
-    That costs more HBM traffic than the paged decode kernel, but verify
-    passes amortize the WEIGHT stream over up to W tokens, which is the
-    win speculative decoding exists for; a windowed scalar-prefetch
-    kernel can replace the gather later without touching this contract.
+    Attention runs the paged WINDOW kernel (ops.paged_attention.
+    paged_window_auto): the cache side streams each slot's live blocks
+    exactly once through the same scalar-prefetch kernel as decode, and
+    the W x W in-window part folds in exactly — off-TPU the auto gate
+    falls back to window_attention_appended over a dense gather of the
+    table.
 
     CAPACITY CONTRACT (same as verify_step): callers must only honor
     acceptance for slots with lengths + W <= capacity; rows past
     capacity route to the trash block, mirroring the contiguous
     scatter's mode=\"drop\"."""
-    from ..ops.attention import window_attention_appended
-    from ..ops.paged_attention import gather_blocks
+    from ..ops.paged_attention import paged_window_auto
 
     cfg = multi_request_serving_config(cfg)
     B, W = tokens.shape
@@ -202,17 +200,10 @@ def paged_verify_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
 
     def body(x, xs):
         layer_w, k_layer, v_layer, ks_layer, vs_layer = xs
-        k_dense = gather_blocks(k_layer, table)
-        v_dense = gather_blocks(v_layer, table)
-        ks_dense = gather_blocks(ks_layer, table) if ks_layer is not None \
-            else None
-        vs_dense = gather_blocks(vs_layer, table) if vs_layer is not None \
-            else None
 
         def attend(q, k_new, v_new):
-            return window_attention_appended(q, k_dense, v_dense, k_new,
-                                             v_new, lengths, ks_dense,
-                                             vs_dense)
+            return paged_window_auto(q, k_layer, v_layer, k_new, v_new,
+                                     table, lengths, ks_layer, vs_layer)
 
         x, kv, _ = _layer(x, layer_w, cfg, cos, sin, positions,
                           kv_write=lambda k, v: (k, v), attend=attend,

@@ -41,7 +41,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .attention import decode_attention_appended
+from .attention import NEG_INF, decode_attention_appended
 from .flash_decode import _LANES, _decode_kernel
 
 
@@ -158,12 +158,10 @@ def paged_decode_attention(q, k_pool, v_pool, k_new, v_new, table, lengths,
 
 def gather_blocks(pool, table):
     """Dense per-slot view of a paged buffer: [N, T, ...] gathered by
-    table [B, MB] -> [B, MB*T, ...]. Materializes the full dense cache.
-    Used by the reference/fallback attention (tests, CPU) AND by the
-    speculative-decoding verify pass (paged_llama.paged_verify_step),
-    which trades a transient per-layer dense view for the weight-stream
-    amortization a verify window buys; the DECODE kernel never does
-    this."""
+    table [B, MB] -> [B, MB*T, ...]. Materializes the full dense cache —
+    the reference/FALLBACK path only (numerics oracles, CPU backends);
+    on TPU both the decode and the verify-window kernels stream blocks
+    directly and never gather."""
     g = pool[table]                       # [B, MB, T, ...]
     return g.reshape((g.shape[0], g.shape[1] * g.shape[2]) + g.shape[3:])
 
@@ -178,6 +176,81 @@ def paged_attention_reference(q, k_pool, v_pool, k_new, v_new, table,
     vs = gather_blocks(v_scale, table) if v_scale is not None else None
     return decode_attention_appended(q, k_dense, v_dense, k_new, v_new,
                                      lengths, ks, vs)
+
+
+def paged_window_attention(q, k_pool, v_pool, k_new, v_new, table,
+                           lengths, k_scale=None, v_scale=None, *,
+                           interpret: bool = False) -> jnp.ndarray:
+    """ops.attention.window_attention_appended over the paged pool —
+    the speculative-decoding verify pass WITHOUT the dense gather: the
+    cache side streams through the same scalar-prefetch kernel as
+    decode (every (w, h) query row attends positions < lengths[b], so
+    the W*H rows flatten kv-major and ride the block-diagonal matmul
+    unchanged), and the W x W in-window causal part folds in afterwards
+    with the exact flash combination.
+
+    q: [B, W, H, D]; k_pool/v_pool: [N, T, KV, D]; k_new/v_new:
+    [B, W, KV, D] (bf16, the window's fresh KV — not yet in the pool);
+    table [B, MB] clamped block ids; lengths [B] EXCLUDING the window.
+    Returns [B, W, H, D] in q.dtype."""
+    b, w, h, d = q.shape
+    n_kv = k_pool.shape[2]
+    g = h // n_kv
+    # rows kv-major so _paged_decode_cache's [n_kv, g'] reshape holds
+    # with g' = W*G: [B, W, KV, G, D] -> [B, KV, W, G, D] -> [B, H', D]
+    q_rows = q.reshape(b, w, n_kv, g, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(b, n_kv * w * g, d)
+    acc, m, l = _paged_decode_cache(q_rows, k_pool, v_pool, table,
+                                    lengths, k_scale, v_scale,
+                                    interpret=interpret)
+    # back to [B, W, H(=KV*G), ...]
+    def unrows(x):
+        x = x.reshape((b, n_kv, w, g) + x.shape[2:])
+        return jnp.swapaxes(x, 1, 2).reshape((b, w, h) + x.shape[4:])
+
+    acc = unrows(acc)                                   # [B, W, H, D]
+    m = unrows(m[..., 0])                               # [B, W, H]
+    l = unrows(l[..., 0])
+
+    # in-window causal scores: query row w attends window positions <= w
+    qg = (q * (d ** -0.5)).reshape(b, w, n_kv, g, d)
+    s_s = jnp.einsum("bwkgd,btkd->bwkgt", qg,
+                     k_new.astype(qg.dtype),
+                     preferred_element_type=jnp.float32)  # [B,W,KV,G,Wt]
+    s_s = s_s.reshape(b, w, h, w)
+    causal = jnp.tril(jnp.ones((w, w), bool))             # [W, Wt]
+    s_s = jnp.where(causal[None, :, None, :], s_s, NEG_INF)
+    s_max = jnp.max(s_s, axis=-1)                         # [B, W, H]
+    m_t = jnp.maximum(m, s_max)
+    p = jnp.where(causal[None, :, None, :],
+                  jnp.exp(s_s - m_t[..., None]), 0.0)     # [B, W, H, Wt]
+    alpha = jnp.exp(m - m_t)                              # [B, W, H]
+    l_t = l * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bwkgt,btkd->bwkgd",
+                    p.reshape(b, w, n_kv, g, w).astype(v_new.dtype),
+                    v_new).reshape(b, w, h, d)
+    out = (acc * alpha[..., None] + pv) / l_t[..., None]
+    return out.astype(q.dtype)
+
+
+def paged_window_auto(q, k_pool, v_pool, k_new, v_new, table, lengths,
+                      k_scale=None, v_scale=None, *,
+                      interpret: bool = False) -> jnp.ndarray:
+    """Window kernel when backend+shapes allow, dense-gather reference
+    otherwise (window_attention_appended over gather_blocks views)."""
+    from .attention import window_attention_appended
+
+    b, w, h, d = q.shape
+    probe = jax.ShapeDtypeStruct((b, 1, h * w, d), q.dtype)
+    if interpret or _kernel_ok(probe, k_pool):
+        return paged_window_attention(q, k_pool, v_pool, k_new, v_new,
+                                      table, lengths, k_scale, v_scale,
+                                      interpret=interpret)
+    ks = gather_blocks(k_scale, table) if k_scale is not None else None
+    vs = gather_blocks(v_scale, table) if v_scale is not None else None
+    return window_attention_appended(q, gather_blocks(k_pool, table),
+                                     gather_blocks(v_pool, table),
+                                     k_new, v_new, lengths, ks, vs)
 
 
 def _kernel_ok(q, k_pool) -> bool:
