@@ -83,6 +83,24 @@ def init_paged_cache(cfg: ModelConfig, slots: int, n_blocks: int,
     )
 
 
+
+def _pool_coords(table: jnp.ndarray, positions: jnp.ndarray, T: int,
+                 ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(block_ids, offsets) for writing at ``positions`` ([B] or [B, W])
+    through a clamped ``table`` [B, MB]. Past-capacity positions route
+    to the trash block — the paged mirror of the contiguous scatter's
+    mode="drop" (without it the offset would wrap into the slot's own
+    live last block)."""
+    mb = table.shape[1]
+    idx = jnp.minimum(positions // T, mb - 1)
+    blk = jnp.take_along_axis(table, idx if idx.ndim == 2 else idx[:, None],
+                              axis=1)
+    if positions.ndim == 1:
+        blk = blk[:, 0]
+    blk = jnp.where(positions < mb * T, blk, 0)
+    return blk, positions % T
+
+
 def paged_decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                       cache: PagedKVCache, table: jnp.ndarray,
                       rope_tables=None, flash: bool = True,
@@ -129,15 +147,8 @@ def paged_decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     x, (k_toks, v_toks) = jax.lax.scan(
         body, x, (params["layers"], cache.k, cache.v,
                   cache.k_scale, cache.v_scale))
-    # one scatter for all layers into each slot's current block: pool
-    # coords (block, offset) = (table[b, len // T], len % T)
-    blk = jnp.take_along_axis(
-        table, jnp.minimum(lengths // T, mb - 1)[:, None], axis=1)[:, 0]
-    # past-capacity cursors write to the trash block — the paged mirror
-    # of the contiguous scatter's mode="drop" (without this the offset
-    # would wrap into the slot's own live last block)
-    blk = jnp.where(lengths < mb * T, blk, 0)
-    off = lengths % T
+    # one scatter for all layers into each slot's current block
+    blk, off = _pool_coords(table, lengths, T)
     k_tok, v_tok = k_toks[:, :, 0], v_toks[:, :, 0]      # [L, B, KV, hd]
     if cache.quantized:
         qk, sk = quantize_kv(k_tok)
@@ -214,10 +225,7 @@ def paged_verify_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
         body, x, (params["layers"], cache.k, cache.v,
                   cache.k_scale, cache.v_scale))
     # one scatter for all layers and window rows into pool coordinates
-    blk = jnp.take_along_axis(
-        table, jnp.minimum(positions // T, mb - 1), axis=1)   # [B, W]
-    blk = jnp.where(positions < mb * T, blk, 0)               # trash OOB
-    off = positions % T
+    blk, off = _pool_coords(table, positions, T)
     if cache.quantized:
         qk, sk = quantize_kv(k_w)
         qv, sv = quantize_kv(v_w)
@@ -243,35 +251,16 @@ def write_prompt_blocks(cache: PagedKVCache, k_stack, v_stack,
     length: rows in [length, S) are bucket padding — they land in the
     slot's own blocks past its cursor, invisible behind ``lengths`` and
     overwritten as decode advances (the same contract as the contiguous
-    cache's write_kv)."""
-    T = cache.block_size
-    S = k_stack.shape[2]
-    n_wr = (S + T - 1) // T
-    k, v, ks, vs = cache.k, cache.v, cache.k_scale, cache.v_scale
-    quant = cache.quantized
-    if quant:
-        qk_all, sk_all = quantize_kv(k_stack)
-        qv_all, sv_all = quantize_kv(v_stack)
-    for j in range(n_wr):
-        lo, hi = j * T, min((j + 1) * T, S)
-        bj = blocks[j]
-        if quant:
-            k = jax.lax.dynamic_update_slice(
-                k, qk_all[:, 0, lo:hi][:, None], (0, bj, 0, 0, 0))
-            v = jax.lax.dynamic_update_slice(
-                v, qv_all[:, 0, lo:hi][:, None], (0, bj, 0, 0, 0))
-            ks = jax.lax.dynamic_update_slice(
-                ks, sk_all[:, 0, lo:hi][:, None], (0, bj, 0, 0))
-            vs = jax.lax.dynamic_update_slice(
-                vs, sv_all[:, 0, lo:hi][:, None], (0, bj, 0, 0))
-        else:
-            k = jax.lax.dynamic_update_slice(
-                k, k_stack[:, 0, lo:hi][:, None].astype(k.dtype),
-                (0, bj, 0, 0, 0))
-            v = jax.lax.dynamic_update_slice(
-                v, v_stack[:, 0, lo:hi][:, None].astype(v.dtype),
-                (0, bj, 0, 0, 0))
-    return cache._replace(k=k, v=v, k_scale=ks, v_scale=vs)
+    cache's write_kv). Quantize-on-write, then one shared block-copy
+    loop (write_row_to_blocks) moves the rows."""
+    if cache.quantized:
+        qk, sk = quantize_kv(k_stack)
+        qv, sv = quantize_kv(v_stack)
+        row = llama.KVCache(k=qk, v=qv, lengths=None, k_scale=sk,
+                            v_scale=sv)
+    else:
+        row = llama.KVCache(k=k_stack, v=v_stack, lengths=None)
+    return write_row_to_blocks(cache, row, blocks)
 
 
 def read_blocks_to_row(row, cache: PagedKVCache,
@@ -317,10 +306,11 @@ def read_blocks_to_row(row, cache: PagedKVCache,
 def write_row_to_blocks(cache: PagedKVCache, row, blocks: jnp.ndarray,
                         ) -> PagedKVCache:
     """Copy a dense single-slot cache row (llama.KVCache with B=1,
-    [L, 1, Smax, KV, hd]) into pool blocks — the bridge long-prompt
-    admission uses: chunked prefill fills the dense SCRATCH row exactly
-    as the contiguous engine would, then this one dispatch lands it in
-    the pool. ``blocks`` [MB] int32: entries past the prompt's own
+    [L, 1, S, KV, hd]; S may be shorter than MB*T — slices clamp) into
+    pool blocks. The shared block-copy loop under BOTH admission paths:
+    write_prompt_blocks quantizes a prefill's stacks into a row and
+    delegates here; long-prompt admission lands the chunked SCRATCH row
+    directly. ``blocks`` [n] int32: entries past the prompt's own
     blocks point at the trash block, so positions beyond the prompt
     land nowhere. Same-dtype copy (int8 + scales move verbatim)."""
     T = cache.block_size

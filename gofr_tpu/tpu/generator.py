@@ -1043,45 +1043,55 @@ class GenerationEngine:
                     continue
                 blocks = None
                 if self._paged:
-                    T = self._block_t
-                    shared, m = [], 0
-                    if self._prefix_idx is not None:
-                        shared, m = self._prefix_idx.match(
-                            np.asarray(req.prompt, np.int32), req.adapter)
-                        if m and not self._lattice_resume_valid(
-                                len(req.prompt), m):
-                            shared, m = [], 0  # off-lattice: full recompute
-                        if shared:
-                            # take the slot's hold NOW: the evict-retry
-                            # below could otherwise free the matched
-                            # entry's blocks out from under us
-                            self._alloc.ref(shared)
-                    need = -(-len(req.prompt) // T) - len(shared)
-                    fresh = self._alloc.alloc(need)
-                    while fresh is None and self._prefix_idx is not None \
-                            and self._prefix_idx.evict_one():
-                        fresh = self._alloc.alloc(need)
-                    if fresh is None:
+                    blocks = self._paged_admission_blocks(req)
+                    if blocks is None:
                         # transient pool pressure: requeue and let active
                         # slots retire blocks. (FIFO order is not
                         # preserved across the requeue — pool-pressure
                         # reordering is documented engine behavior.)
-                        if shared:
-                            self._alloc.free(shared)
                         self._pending.put(req)
                         return
-                    if self._prefix_idx is not None:
-                        if m:
-                            self._prefix_idx.accept(shared)
-                            if self.metrics is not None:
-                                self.metrics.increment_counter(
-                                    "app_tpu_prefix_cache_hits_total")
-                        else:
-                            self._prefix_idx.reject()
-                    blocks = (shared, m, fresh)
                 self._start(idx, slot, req, blocks)
             finally:
                 self._admitting -= 1
+
+    def _paged_admission_blocks(self, req: _Request
+                                ) -> "tuple[list, int, list] | None":
+        """Blocks for one paged admission: consult the prefix index,
+        take the slot's hold on any shared blocks, allocate the fresh
+        remainder (evicting LRU prefix entries under pressure). Returns
+        (shared, matched_tokens, fresh) with one reference per block
+        held for the slot — or None (nothing held) when the pool cannot
+        cover the request right now."""
+        shared, m = [], 0
+        if self._prefix_idx is not None:
+            shared, m = self._prefix_idx.match(
+                np.asarray(req.prompt, np.int32), req.adapter)
+            if m and not self._lattice_resume_valid(len(req.prompt), m):
+                shared, m = [], 0  # off-lattice window: full recompute
+            if shared:
+                # take the slot's hold NOW: the evict-retry below could
+                # otherwise free the matched entry's blocks out from
+                # under us
+                self._alloc.ref(shared)
+        need = -(-len(req.prompt) // self._block_t) - len(shared)
+        fresh = self._alloc.alloc(need)
+        while fresh is None and self._prefix_idx is not None \
+                and self._prefix_idx.evict_one():
+            fresh = self._alloc.alloc(need)
+        if fresh is None:
+            if shared:
+                self._alloc.free(shared)
+            return None
+        if self._prefix_idx is not None:
+            if m:
+                self._prefix_idx.accept(shared)
+                if self.metrics is not None:
+                    self.metrics.increment_counter(
+                        "app_tpu_prefix_cache_hits_total")
+            else:
+                self._prefix_idx.reject()
+        return shared, m, fresh
 
     def _admit_prefill(self, idx: int, req: _Request) -> tuple[int, float]:
         """Run the request's prompt through prefill into slot ``idx`` and

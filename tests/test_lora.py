@@ -3,6 +3,8 @@ stream. The oracle needs no external reference — a gathered adapter
 must produce EXACTLY what the same adapter merged into dense weights
 (W + A@B) produces, and adapter 0 (B=0) must be the base model."""
 
+import zlib
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -23,10 +25,8 @@ def lora_params():
     # give adapters 1 and 2 real (nonzero) B matrices
     for name in llama.LORA_TARGETS:
         b = layers[f"lora_b_{name}"]
-        # zlib.crc32, NOT hash(): str hashes are salted per process
-        # (PYTHONHASHSEED), which would make the test weights — and any
-        # near-tie argmax failure — unreproducible across runs
-        import zlib
+        # crc32, NOT hash(): str hashes are salted per process
+        # (PYTHONHASHSEED) — weights must be reproducible across runs
         fill = jax.random.normal(
             jax.random.PRNGKey(zlib.crc32(name.encode()) % 1000),
                                  b.shape[:1] + b.shape[2:]) * 0.05

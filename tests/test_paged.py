@@ -5,6 +5,8 @@ llama.decode_step does from an identically-seeded contiguous cache.
 Hardware existence is proven by bench.py's paged section, never here
 (the r2 flash-kernel lesson)."""
 
+import zlib
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -576,7 +578,7 @@ def test_paged_multi_lora_streams_match_merged_reference():
               **llama.init_lora(TINY, 2, 4, jax.random.PRNGKey(2))}
     for name in llama.LORA_TARGETS:
         b = layers[f"lora_b_{name}"]
-        import zlib  # salted hash() would make weights unreproducible
+        # crc32, not salted hash(): weights must be reproducible
         fill = jax.random.normal(
             jax.random.PRNGKey(zlib.crc32(name.encode()) % 997),
                                  b.shape[:1] + b.shape[2:]) * 0.05
