@@ -19,6 +19,63 @@ from gofr_tpu.tpu import GenerationEngine
 TINY = LLAMA_CONFIGS["tiny"]
 
 
+
+def _storm(eng, prompts, oracle, n, *, threads=4, iters=8, cancel_p=0.2,
+           prefix_ok=False, timeout=300):
+    """Shared concurrent-client harness for every soak: each client
+    generates random prompts from the set, cancels some mid-stream, and
+    checks completed streams against the idle-engine ``oracle``
+    (``prefix_ok``: truncation under pool pressure may shorten a stream
+    but never change delivered tokens). Exceptions inside clients are
+    captured as failures, never swallowed. Returns (errors, completed)
+    and asserts liveness."""
+    errors: list[str] = []
+    done = [0]
+    lock = threading.Lock()
+
+    def client(seed: int):
+        r = np.random.default_rng(seed)
+        for i in range(iters):
+            p = prompts[int(r.integers(0, len(prompts)))]
+            try:
+                s = eng.generate(p, max_new_tokens=n)
+                if r.random() < cancel_p:
+                    it = iter(s)
+                    try:
+                        next(it)
+                    except StopIteration:
+                        pass
+                    s.cancel()
+                    for _ in it:
+                        pass
+                    continue
+                got = s.tokens()
+            except Exception as e:  # noqa: BLE001 — a dead client must
+                # FAIL the test, not silently shrink its coverage
+                with lock:
+                    errors.append(f"seed {seed} iter {i}: {e!r}")
+                continue
+            want = oracle[tuple(p)]
+            ok = got == want[:len(got)] if prefix_ok else got == want
+            if not ok:
+                with lock:
+                    errors.append(f"seed {seed} iter {i}: {got[:8]} != "
+                                  f"{want[:8]}")
+            with lock:
+                done[0] += 1
+
+    ts = [threading.Thread(target=client, args=(s,))
+          for s in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=timeout)
+    assert not any(t.is_alive() for t in ts), "soak deadlocked"
+    assert not errors, errors[:5]
+    assert done[0] > 0
+    return errors, done[0]
+
+
 def test_soak_concurrent_generate_cancel_and_prefix_reuse():
     params = llama.init(TINY, jax.random.PRNGKey(1))
     eng = GenerationEngine(TINY, params, slots=4, max_seq=64,
@@ -37,43 +94,8 @@ def test_soak_concurrent_generate_cancel_and_prefix_reuse():
     try:
         oracle = {tuple(p): eng.generate(p, max_new_tokens=6).tokens()
                   for p in prompts}
-        errors: list[str] = []
-        done = [0]
-        lock = threading.Lock()
-
-        def client(seed: int):
-            r = np.random.default_rng(seed)
-            for i in range(12):
-                p = prompts[int(r.integers(0, len(prompts)))]
-                s = eng.generate(p, max_new_tokens=6)
-                if r.random() < 0.25:  # cancel mid-stream
-                    it = iter(s)
-                    try:
-                        next(it)
-                    except StopIteration:
-                        pass
-                    s.cancel()
-                    for _ in it:
-                        pass
-                    continue
-                got = s.tokens()
-                if got != oracle[tuple(p)]:
-                    with lock:
-                        errors.append(
-                            f"seed {seed} iter {i}: {got} != "
-                            f"{oracle[tuple(p)]}")
-                with lock:
-                    done[0] += 1
-
-        threads = [threading.Thread(target=client, args=(s,))
-                   for s in range(6)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=300)
-        assert not any(t.is_alive() for t in threads), "soak deadlocked"
-        assert not errors, errors[:5]
-        assert done[0] > 0
+        _storm(eng, prompts, oracle, 6, threads=6, iters=12,
+               cancel_p=0.25)
         # storm over: all slots retired, engine still serves
         st = eng.stats()
         assert st["active"] == 0 and st["queued"] == 0
@@ -103,48 +125,54 @@ def test_soak_paged_engine_under_block_churn():
     try:
         oracle = {tuple(p): eng.generate(p, max_new_tokens=24).tokens()
                   for p in prompts}
-        errors: list[str] = []
-        done = [0]
-        lock = threading.Lock()
-
-        def client(seed: int):
-            r = np.random.default_rng(seed)
-            for i in range(10):
-                p = prompts[int(r.integers(0, len(prompts)))]
-                s = eng.generate(p, max_new_tokens=24)
-                if r.random() < 0.2:
-                    it = iter(s)
-                    try:
-                        next(it)
-                    except StopIteration:
-                        pass
-                    s.cancel()
-                    for _ in it:
-                        pass
-                    continue
-                got = s.tokens()
-                want = oracle[tuple(p)]
-                if got != want[:len(got)]:
-                    with lock:
-                        errors.append(f"seed {seed} iter {i}: {got[:8]} "
-                                      f"diverges from {want[:8]}")
-                with lock:
-                    done[0] += 1
-
-        threads = [threading.Thread(target=client, args=(s,))
-                   for s in range(5)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=300)
-        assert not any(t.is_alive() for t in threads), "paged soak deadlocked"
-        assert not errors, errors[:5]
-        assert done[0] > 0
+        _storm(eng, prompts, oracle, 24, threads=5, iters=10,
+               prefix_ok=True)
         st = eng.stats()
         assert st["active"] == 0 and st["queued"] == 0
         assert st["paged"]["free"] == st["paged"]["blocks"]  # no leaks
         p = prompts[0]
         assert eng.generate(p, max_new_tokens=24).tokens() == \
+            oracle[tuple(p)]
+    finally:
+        eng.close()
+
+
+def test_soak_paged_all_features_composed():
+    """Everything on at once over one paged engine: zero-copy prefix
+    sharing, speculative decoding, long-prompt scratch admission, and
+    mid-stream cancels from concurrent clients. Invariants: liveness,
+    delivered streams are prefixes of the idle-engine oracle, the
+    refcounted pool balances exactly (free + entry-held == usable), and
+    the engine still serves after the storm."""
+    params = llama.init(TINY, jax.random.PRNGKey(1))
+    eng = GenerationEngine(TINY, params, slots=4, max_seq=64,
+                           prompt_buckets=(8, 16), decode_block=2,
+                           kv_dtype=jnp.int8,
+                           paged_blocks=17, paged_block_size=16,
+                           prefix_cache_slots=2, prefix_store_min=16,
+                           spec_decode_k=2)
+    rng = np.random.default_rng(2)
+    shared = rng.integers(1, TINY.vocab_size, 18).tolist()
+    prompts = [shared + rng.integers(1, TINY.vocab_size, 3).tolist()
+               for _ in range(2)]
+    prompts += [[5, 9] * 6,                                    # spec hits
+                rng.integers(1, TINY.vocab_size, 40).tolist(),  # scratch
+                rng.integers(1, TINY.vocab_size, 4).tolist()]
+    try:
+        oracle = {tuple(p): eng.generate(p, max_new_tokens=10).tokens()
+                  for p in prompts}
+        _storm(eng, prompts, oracle, 10, threads=4, iters=8,
+               prefix_ok=True)
+        st = eng.stats()
+        assert st["active"] == 0 and st["queued"] == 0
+        held = st["prefix_cache"]["blocks_held"]
+        assert st["paged"]["free"] + held == st["paged"]["blocks"]
+        # the COMPOSED features must actually have fired, or this is
+        # just a churn soak wearing a fancy docstring
+        assert st["prefix_cache"]["hits"] > 0
+        assert st["spec_decode"]["windows"] > 0
+        p = prompts[0]
+        assert eng.generate(p, max_new_tokens=10).tokens() == \
             oracle[tuple(p)]
     finally:
         eng.close()
