@@ -26,6 +26,7 @@ import functools
 import json
 import os
 import statistics
+import subprocess
 import sys
 import time
 
@@ -662,14 +663,48 @@ def bench_prefix(cfg, *, prefix_len: int = 896, tail_len: int = 64,
         engine.close()
 
 
-def main() -> None:
-    metric = "llama3_8b_int8_decode_tok_s_chip"
+def main_cpu() -> None:
+    """Structural smoke on the host backend (local dev / --cpu).
+    Runs in the parent process — host RAM has no HBM-lifecycle problem."""
+    import jax
+
+    if "--cpu" in sys.argv[1:] or os.environ.get("GOFR_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from gofr_tpu.models.common import LLAMA_CONFIGS
+
+    cfg = LLAMA_CONFIGS["tiny"].with_(dtype="bfloat16")
+    payload = {"metric": "llama_tiny_cpu_decode_tok_s", "value": 0.0,
+               "unit": "tok/s", "vs_baseline": 0.0}
+    try:
+        res = bench_decode(cfg, batch=8, cache_len=128, steps=32,
+                           decode_block=4)
+        payload["value"] = round(res["tok_s"], 1)
+        ttft = bench_ttft(cfg, slots=4, probe_lens=(16, 32), max_seq=128)
+        payload["ttft_p50_ms"] = round(ttft["p50_ms"], 1)
+        if "grpc_p50_ms" in ttft:
+            payload["ttft_grpc_p50_ms"] = round(ttft["grpc_p50_ms"], 1)
+    except Exception as e:  # keep whatever was measured before the error
+        payload["error"] = f"{type(e).__name__}: {str(e)[:200]}"
+    emit(payload)
+
+
+def run_section(args) -> None:
+    """Child-process entry: run ONE section against a fresh backend and
+    print its result dict as the last stdout line. Each section owning a
+    whole process is the HBM-lifecycle fix for the r4 cascade: the first
+    full hardware run OOMed every section after TTFT because 8.6 GB of
+    section state (params + compiled-program constants + engine caches)
+    survives a section's Python scope in backend/cache layers that
+    engine.close() cannot reach. Process exit is the one release point
+    XLA guarantees; it also contains a section segfault/OOM so later
+    sections still run, and re-init costs only ~0.2 s + a few seconds of
+    compile per section."""
     try:
         devices = init_backend()
     except Exception as e:
-        emit({"metric": metric, "value": 0.0, "unit": "tok/s",
-              "vs_baseline": 0.0,
-              "error": f"backend init failed: {type(e).__name__}: {str(e)[:300]}"})
+        emit({"error":
+              f"backend init failed: {type(e).__name__}: {str(e)[:300]}"})
         return
 
     import jax
@@ -677,40 +712,108 @@ def main() -> None:
     from gofr_tpu.models.common import LLAMA_CONFIGS
 
     platform = devices[0].platform
-    log(f"bench: platform={platform} devices={jax.device_count()}")
-
-    if platform == "cpu":
-        cfg = LLAMA_CONFIGS["tiny"].with_(dtype="bfloat16")
-        payload = {"metric": "llama_tiny_cpu_decode_tok_s", "value": 0.0,
-                   "unit": "tok/s", "vs_baseline": 0.0}
-        try:
-            res = bench_decode(cfg, batch=8, cache_len=128, steps=32,
-                               decode_block=4)
-            payload["value"] = round(res["tok_s"], 1)
-            ttft = bench_ttft(cfg, slots=4, probe_lens=(16, 32), max_seq=128)
-            payload["ttft_p50_ms"] = round(ttft["p50_ms"], 1)
-            if "grpc_p50_ms" in ttft:
-                payload["ttft_grpc_p50_ms"] = round(ttft["grpc_p50_ms"], 1)
-        except Exception as e:  # keep whatever was measured before the error
-            payload["error"] = f"{type(e).__name__}: {str(e)[:200]}"
-        emit(payload)
+    if args.section == "probe":
+        emit({"platform": platform, "devices": jax.device_count()})
         return
-
-    try:
-        floor_ms = bench_dispatch_floor()
-        log(f"  dispatch floor: {floor_ms:.2f} ms")
-    except Exception as e:
-        floor_ms = None
-        log(f"  dispatch floor probe failed: {type(e).__name__}: {str(e)[:120]}")
-
     cfg = LLAMA_CONFIGS["llama3-8b"]
     try:
-        res = bench_decode_best(cfg, (96, 80, 64, 48, 32, 24, 16, 8),
-                                cache_len=1024)
+        if args.section == "headline":
+            out = {}
+            try:
+                out["floor_ms"] = round(bench_dispatch_floor(), 2)
+                log(f"  dispatch floor: {out['floor_ms']:.2f} ms")
+            except Exception as e:
+                log(f"  dispatch floor probe failed: "
+                    f"{type(e).__name__}: {str(e)[:120]}")
+            out.update(bench_decode_best(
+                cfg, (96, 80, 64, 48, 32, 24, 16, 8), cache_len=1024))
+            try:
+                out["flash_smoke"] = flash_smoke()
+            except Exception as e:
+                log(f"  flash smoke FAILED: {type(e).__name__}: {str(e)[:200]}")
+                out["flash_smoke"] = \
+                    f"FAILED: {type(e).__name__}: {str(e)[:200]}"
+            emit(out)
+        elif args.section == "ttft":
+            emit(bench_ttft(cfg, slots=args.slots))
+        elif args.section == "prefix":
+            emit(bench_prefix(cfg))
+        elif args.section == "engine":
+            emit(bench_engine(cfg))
+        elif args.section == "spec":
+            emit(bench_spec_decode(cfg))
+        elif args.section == "paged":
+            emit(bench_paged_decode(cfg, batch=args.paged_batch,
+                                    live_len=448))
+        elif args.section == "paged_engine":
+            # full serving stack over the paged pool at 128 slots. Pool
+            # sizing: a stream's cursor peaks at 16+96=112 < 128, so one
+            # block per slot; + trash + slack ≈ 1.5 GB of pool HBM
+            emit(bench_engine(cfg, slots=128, paged_blocks=140))
+        else:
+            emit({"error": f"unknown section {args.section!r}"})
     except Exception as e:
+        emit({"error": f"{type(e).__name__}: {str(e)[:300]}",
+              "oom": _is_oom(e)})
+
+
+def run_child(section: str, *extra: str, timeout: float) -> dict:
+    """Run one section in a subprocess; return its result dict.
+
+    stderr is inherited (live diagnostics); stdout is captured and the
+    last JSON line is the result. The parent never initializes JAX on
+    the TPU path — the axon chip grant is exclusive, so a client held by
+    the parent would starve every child."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--section", section,
+           *extra]
+    if "--cpu" in sys.argv[1:]:
+        cmd.append("--cpu")
+    try:
+        p = subprocess.run(cmd, stdout=subprocess.PIPE, text=True,
+                           timeout=timeout)
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"")
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        log(f"  section {section} killed after {timeout:.0f}s")
+        # a killed holder can wedge the tunnel for a bit — let it settle
+        time.sleep(20)
+        return {"error": f"section timed out after {timeout:.0f}s",
+                "stdout_tail": out[-200:]}
+    for line in reversed(p.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return {"error": f"section {section} produced no JSON "
+                     f"(rc={p.returncode}, stdout tail: {p.stdout[-200:]!r})"}
+
+
+def _init_lost(res: dict) -> bool:
+    return "error" in res and "backend init" in res["error"]
+
+
+def main() -> None:
+    metric = "llama3_8b_int8_decode_tok_s_chip"
+    init_budget = float(os.environ.get("GOFR_BENCH_INIT_BUDGET_S", "600"))
+
+    probe = run_child("probe", timeout=init_budget + 120)
+    if "error" in probe:
+        emit({"metric": metric, "value": 0.0, "unit": "tok/s",
+              "vs_baseline": 0.0, "error": probe["error"]})
+        return
+    log(f"bench: platform={probe['platform']} devices={probe['devices']}")
+    if probe["platform"] == "cpu":
+        main_cpu()  # in-process: host RAM has no HBM-lifecycle problem
+        return
+
+    res = run_child("headline", timeout=init_budget + 1200)
+    if "error" in res or not res.get("tok_s"):
         emit({"metric": metric, "value": 0.0, "unit": "tok/s",
               "vs_baseline": 0.0,
-              "error": f"decode bench failed: {type(e).__name__}: {str(e)[:300]}"})
+              "error": res.get("error", "decode produced no throughput")})
         return
     tok_s, used = res["tok_s"], res.get("batch")
     payload = {
@@ -720,8 +823,8 @@ def main() -> None:
         "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),
         "batch": used,
     }
-    if floor_ms is not None:
-        payload["dispatch_floor_ms"] = round(floor_ms, 2)
+    if "floor_ms" in res:
+        payload["dispatch_floor_ms"] = res["floor_ms"]
     if "fused_step_ms" in res:
         payload["fused_step_ms"] = round(res["fused_step_ms"], 2)
         payload["dispatch_step_ms"] = round(res["dispatch_step_ms"], 2)
@@ -733,86 +836,101 @@ def main() -> None:
     for k in ("flash_decode_tok_s", "flash_decode_step_ms"):
         if k in res:
             payload[k] = round(res[k], 2)
-    if "flash_decode_error" in res:
-        payload["flash_decode_error"] = res["flash_decode_error"]
-    try:
-        payload["flash_smoke"] = flash_smoke()
-    except Exception as e:
-        log(f"  flash smoke FAILED: {type(e).__name__}: {str(e)[:200]}")
-        payload["flash_smoke"] = f"FAILED: {type(e).__name__}: {str(e)[:200]}"
+    for k in ("flash_decode_error", "flash_smoke"):
+        if k in res:
+            payload[k] = res[k]
     # snapshot: if a runner kills the remaining (slower) sections, the
     # stream still ends with a parsable headline line; the complete
     # payload re-emits at the end and supersedes this one.
     emit({**payload, "partial": "ttft/prefix/engine sections pending"})
-    try:
-        ttft = bench_ttft(cfg, slots=min(used or 8, 32))
+
+    aborted = False
+
+    def section(name: str, *extra: str, timeout: float = 900.0) -> dict:
+        """One child, with abort-on-tunnel-loss: once a section reports
+        the backend unreachable, later sections would each burn the full
+        init budget discovering the same outage."""
+        nonlocal aborted
+        if aborted:
+            return {"error": "skipped: backend lost in an earlier section"}
+        r = run_child(name, *extra, timeout=init_budget + timeout)
+        if _init_lost(r):
+            aborted = True
+            payload["aborted_after"] = name
+        return r
+
+    ttft = section("ttft", "--slots", str(min(used or 8, 32)))
+    if "error" in ttft:
+        payload["ttft_error"] = ttft["error"]
+    else:
         payload["ttft_p50_ms"] = round(ttft["p50_ms"], 1)
         if "grpc_p50_ms" in ttft:
             payload["ttft_grpc_p50_ms"] = round(ttft["grpc_p50_ms"], 1)
         if "grpc_error" in ttft:
             payload["ttft_grpc_error"] = ttft["grpc_error"]
         payload["ttft_target_ms"] = TARGET_TTFT_MS
-    except Exception as e:  # TTFT is secondary: report, don't lose decode
-        log(f"  ttft bench failed: {type(e).__name__}: {str(e)[:200]}")
-        payload["ttft_error"] = f"{type(e).__name__}: {str(e)[:200]}"
-    try:
-        pfx = bench_prefix(cfg)
+    pfx = section("prefix")
+    if "error" in pfx:
+        payload["prefix_error"] = pfx["error"]
+    else:
         payload["prefix_miss_ttft_ms"] = round(pfx["miss_ms"], 1)
         payload["prefix_hit_ttft_ms"] = round(pfx["hit_ms"], 1)
-    except Exception as e:
-        log(f"  prefix bench failed: {type(e).__name__}: {str(e)[:200]}")
-        payload["prefix_error"] = f"{type(e).__name__}: {str(e)[:200]}"
-    try:
-        engine_res = bench_engine(cfg)
-        payload["engine_tok_s"] = round(engine_res["tok_s"], 1)
-    except Exception as e:
-        log(f"  engine bench failed: {type(e).__name__}: {str(e)[:200]}")
-        payload["engine_error"] = f"{type(e).__name__}: {str(e)[:200]}"
-    try:
-        spec = bench_spec_decode(cfg)
+    eng = section("engine")
+    if "error" in eng:
+        payload["engine_error"] = eng["error"]
+    else:
+        payload["engine_tok_s"] = round(eng["tok_s"], 1)
+    spec = section("spec")
+    if "error" in spec:
+        payload["spec_error"] = spec["error"]
+    else:
         payload["spec_tok_s"] = round(spec["tok_s"], 1)
         payload["spec_tokens_per_window"] = round(
             spec["tokens_per_window"], 2)
-    except Exception as e:
-        log(f"  spec bench failed: {type(e).__name__}: {str(e)[:200]}")
-        payload["spec_error"] = f"{type(e).__name__}: {str(e)[:160]}"
     # paged-pool sweep point: batch 128 (contiguous rows OOM past ~96);
     # shrinks like bench_decode_best if even the pool can't fit
     for paged_batch in (128, 112, 96):
-        try:
-            paged = bench_paged_decode(cfg, batch=paged_batch, live_len=448)
+        paged = section("paged", "--paged-batch", str(paged_batch))
+        if "error" not in paged:
             payload["paged_tok_s"] = round(paged["tok_s"], 1)
             payload["paged_step_ms"] = round(paged["step_ms"], 2)
             payload["paged_batch"] = paged_batch
+            payload.pop("paged_error", None)
             break
-        except Exception as e:
-            if _is_oom(e):
-                log(f"  paged batch={paged_batch} OOM, shrinking")
-                payload["paged_error"] = "OOM at every paged batch (128..96)"
-                continue  # overwritten by a success or smaller batch's error
-            log(f"  paged bench failed: {type(e).__name__}: {str(e)[:200]}")
-            payload["paged_error"] = f"{type(e).__name__}: {str(e)[:200]}"
-            break
+        if paged.get("oom"):
+            log(f"  paged batch={paged_batch} OOM, shrinking")
+            payload["paged_error"] = "OOM at every paged batch (128..96)"
+            continue  # overwritten by a success or smaller batch's error
+        payload["paged_error"] = paged["error"]
+        break
     if "paged_tok_s" in payload:
-        payload.pop("paged_error", None)
-        # full serving stack over the paged pool at 128 slots (the
-        # engine-level sibling of the raw sweep above). Pool sizing: a
-        # stream's cursor peaks at 16+96=112 < 128, so one block per
-        # slot; + trash + slack ≈ 1.5 GB of pool HBM
-        try:
-            pe = bench_engine(cfg, slots=128, paged_blocks=140)
+        pe = section("paged_engine")
+        if "error" in pe:
+            payload["paged_engine_error"] = pe["error"]
+        else:
             payload["paged_engine_tok_s"] = round(pe["tok_s"], 1)
-        except Exception as e:
-            log(f"  paged engine bench failed: "
-                f"{type(e).__name__}: {str(e)[:200]}")
-            payload["paged_engine_error"] = \
-                f"{type(e).__name__}: {str(e)[:160]}"
     emit(payload)
+
+
+def _parse_args():
+    import argparse
+
+    ap = argparse.ArgumentParser(add_help=False)
+    ap.add_argument("--section", default=None)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--paged-batch", type=int, default=128)
+    ap.add_argument("--cpu", action="store_true")
+    args, _ = ap.parse_known_args()
+    return args
 
 
 if __name__ == "__main__":
     try:
-        main()
+        _args = _parse_args()
+        if _args.section:
+            run_section(_args)
+        else:
+            main()
     except BaseException as e:  # absolute last resort — never exit non-zero
         if isinstance(e, (KeyboardInterrupt, SystemExit)):
             raise
