@@ -109,6 +109,12 @@ class GenStream:
         self.cancelled = threading.Event()
         self.prompt_len = 0
         self.logprobs = logprobs  # items are (token, logprob) tuples
+        # TTFT decomposition (time.monotonic seconds): "submit" set by
+        # generate(), "admit" when the serving loop pops the request,
+        # "prefill_done" when the first token hits this queue. Lets a
+        # client attribute its observed TTFT to admission wait vs
+        # prefill vs delivery wake-up (tools/ttft_probe.py).
+        self.trace: dict[str, float] = {}
 
     def __iter__(self) -> "Iterator[int] | Iterator[tuple[int, float]]":
         while True:
@@ -720,6 +726,7 @@ class GenerationEngine:
                 f"{self._n_adapters} LoRA adapter slots)")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         stream = GenStream(next(_REQ_IDS), self, logprobs=logprobs)
+        stream.trace["submit"] = time.monotonic()
         stream.prompt_len = len(prompt)
         if len(prompt) == 0:
             stream._q.put(GenerationError("empty prompt"))
@@ -1349,6 +1356,7 @@ class GenerationEngine:
     def _start(self, idx: int, slot: _Slot, req: _Request,
                blocks: "tuple | None" = None) -> None:
         t0 = time.monotonic()
+        req.stream.trace["admit"] = t0
         try:
             if self._paged:
                 shared, m, fresh = blocks
@@ -1376,6 +1384,7 @@ class GenerationEngine:
             req.stream._q.put(GenerationError(f"prefill failed: {e!r}"))
             req.stream._q.put(None)
             raise
+        req.stream.trace["prefill_done"] = time.monotonic()
         self._prefix_store(idx, req)
         if self._spec_k:
             self._hist_set(idx, req.prompt)
@@ -1402,6 +1411,10 @@ class GenerationEngine:
         if req.stream.cancelled.is_set():
             self._retire(idx, slot)
             return
+        if slot.generated == 0:  # first token: prefill_done -> first_put
+            # is the prefix-store cost (a device row copy when an entry
+            # is stored) — attributed separately from delivery wake-up
+            req.stream.trace["first_put"] = time.monotonic()
         req.stream._q.put((token, lp) if req.logprobs else token)
         slot.generated += 1
         slot.remaining -= 1
