@@ -63,6 +63,15 @@ def init_backend(retries: int = 4, backoff_s: float = 20.0):
 
     if "--cpu" in sys.argv[1:] or os.environ.get("GOFR_BENCH_CPU"):
         jax.config.update("jax_platforms", "cpu")
+    try:
+        # persistent compile cache: each section child re-traces the same
+        # programs; without this every child pays full XLA compiles
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                         "/tmp/gofr_jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass  # older jax / backend without executable serialization
 
     done = threading.Event()
     budget = float(os.environ.get("GOFR_BENCH_INIT_BUDGET_S", "600"))
