@@ -1,9 +1,13 @@
 #!/bin/bash
-# Retry `python bench.py` until one clean (error-free, value>0) line lands,
-# then save it to BENCH_CANDIDATE.json with a timestamp. Rationale: the
-# axon tunnel outages (r03) are multi-hour but intermittent — measuring
-# once at round end loses the round; retrying across the whole round
-# captures numbers whenever a grant appears (VERDICT r3 "Next round" #1).
+# Retry `python bench.py` until a COMPLETE clean line lands (headline +
+# ttft + engine + prefix + spec + paged sections all measured), saving
+# the best line seen so far to BENCH_CANDIDATE.json along the way.
+# Rationale: the axon tunnel outages (r03/r04) are multi-hour but
+# intermittent — measuring once at round end loses the round; retrying
+# across the whole round captures numbers whenever a grant appears
+# (VERDICT r3 "Next round" #1). A partially-errored run (e.g. the
+# tunnel died mid-sections) still overwrites an older, thinner
+# candidate, but the loop keeps going for the full set.
 #
 # Usage: nohup tools/bench_retry.sh > /tmp/bench_retry.log 2>&1 &
 cd "$(dirname "$0")/.."
@@ -11,26 +15,53 @@ ATTEMPT=0
 while true; do
   ATTEMPT=$((ATTEMPT + 1))
   echo "=== attempt $ATTEMPT at $(date -u +%FT%TZ) ===" >&2
-  OUT=$(GOFR_BENCH_INIT_BUDGET_S=480 timeout 3600 python bench.py 2>/tmp/bench_attempt.stderr)
+  OUT=$(GOFR_BENCH_INIT_BUDGET_S=480 timeout 7200 python bench.py 2>/tmp/bench_attempt.stderr)
   LINE=$(echo "$OUT" | tail -1)
   echo "$LINE" >&2
-  if echo "$LINE" | python -c '
+  STATUS=$(echo "$LINE" | python - <<'EOF'
 import json, sys
-d = json.loads(sys.stdin.readline())
-ok = "error" not in d and d.get("value", 0) > 0 and "partial" not in d
-sys.exit(0 if ok else 1)
-' 2>/dev/null; then
+try:
+    d = json.loads(sys.stdin.readline())
+except Exception:
+    print("junk"); raise SystemExit
+if "error" in d or d.get("value", 0) <= 0 or "partial" in d:
+    print("bad"); raise SystemExit
+want = ("ttft_p50_ms", "ttft_grpc_p50_ms", "engine_tok_s",
+        "prefix_hit_ttft_ms", "spec_tok_s", "paged_tok_s")
+print("complete" if all(k in d for k in want) else "usable")
+EOF
+)
+  if [ "$STATUS" = "complete" ] || [ "$STATUS" = "usable" ]; then
     python - "$LINE" <<'EOF'
 import json, sys, time
 d = json.loads(sys.argv[1])
 d["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-with open("BENCH_CANDIDATE.json", "w") as f:
-    json.dump(d, f, indent=2)
+# keep the richer artifact: never clobber a complete candidate with a
+# thinner one unless the old one has gone stale (>24h)
+try:
+    old = json.load(open("BENCH_CANDIDATE.json"))
+    cap = time.strptime(old.get("captured_at", "1970-01-01T00:00:00Z"),
+                        "%Y-%m-%dT%H:%M:%SZ")
+    import calendar
+    fresh = time.time() - calendar.timegm(cap) < 24 * 3600
+    if fresh and len([k for k in old if k.endswith("_ms") or
+                      k.endswith("_tok_s") or k == "value"]) > \
+            len([k for k in d if k.endswith("_ms") or
+                 k.endswith("_tok_s") or k == "value"]):
+        print("kept richer existing candidate")
+        raise SystemExit
+except FileNotFoundError:
+    pass
+json.dump(d, open("BENCH_CANDIDATE.json", "w"), indent=2)
 print("saved BENCH_CANDIDATE.json")
 EOF
-    echo "=== SUCCESS at $(date -u +%FT%TZ) after $ATTEMPT attempts ===" >&2
-    exit 0
+    if [ "$STATUS" = "complete" ]; then
+      echo "=== COMPLETE at $(date -u +%FT%TZ) after $ATTEMPT attempts ===" >&2
+      exit 0
+    fi
+    echo "usable but incomplete - retrying for the full set" >&2
+  else
+    tail -5 /tmp/bench_attempt.stderr >&2
   fi
-  tail -5 /tmp/bench_attempt.stderr >&2
   sleep 180
 done
