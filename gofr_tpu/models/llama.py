@@ -490,7 +490,8 @@ def write_kv(cache: KVCache, k_stack, v_stack, index5, lengths) -> KVCache:
 
 def prefill_kv(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                lengths: jnp.ndarray | None = None, rope_max: int | None = None,
-               rope_tables=None, flash: bool = False, adapter=None):
+               rope_tables=None, flash: bool = False, adapter=None,
+               logit_pos: jnp.ndarray | None = None):
     """Causal forward returning the raw KV stacks instead of a filled cache.
 
     The continuous-batching serving engine prefills ONE sequence at a time
@@ -499,18 +500,30 @@ def prefill_kv(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     ``dynamic_update_slice`` into that slot without allocating a throwaway
     full-capacity cache per admission.
 
-    Returns (logits [B, S, V] f32, k_stack, v_stack, lengths [B]).
+    ``logit_pos`` [B]: serving only samples ONE position per prompt —
+    passing it gathers the hidden state there BEFORE lm_head, so the
+    [S, V] logits (0.5 TFLOP + a quarter-GB f32 write at S=512,
+    V=128k) shrink to [1, V]. The gather must precede the projection:
+    the sample position is a traced scalar, so gathering after would
+    still compute every row.
+
+    Returns (logits [B, S, V] f32 — or [B, 1, V] with ``logit_pos`` —
+    k_stack, v_stack, lengths [B]).
     """
     x, (k_stack, v_stack), lengths, _ = _causal_scan(
         params, cfg, tokens, lengths, rope_max or tokens.shape[1],
         rope_tables, constrain=None, collect_kv=True, flash=flash,
         adapter=adapter)
+    if logit_pos is not None:
+        x = jnp.take_along_axis(x, logit_pos[:, None, None]
+                                .astype(jnp.int32), axis=1)  # [B, 1, D]
     return _logits(params, cfg, x), k_stack, v_stack, lengths
 
 
 def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                   cache: KVCache, start, rope_tables=None,
-                  compute_logits: bool = True, adapter=None):
+                  compute_logits: bool = True, adapter=None,
+                  logit_pos: jnp.ndarray | None = None):
     """Process a chunk of C prompt tokens at positions [start, start+C)
     against the growing cache — the long-prompt path (chunked prefill):
     prompts of any length up to cache capacity run as a sequence of
@@ -551,8 +564,12 @@ def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                   cache.k_scale, cache.v_scale))
     cache = write_kv(cache, k_chunk, v_chunk, (0, 0, start, 0, 0),
                      cache.lengths)
-    logits = _logits(params, cfg, x) if compute_logits else None
-    return logits, cache
+    if not compute_logits:
+        return None, cache
+    if logit_pos is not None:  # sample-one-position path: see prefill_kv
+        x = jnp.take_along_axis(x, logit_pos[:, None, None]
+                                .astype(jnp.int32), axis=1)  # [B, 1, D]
+    return _logits(params, cfg, x), cache
 
 
 def verify_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,

@@ -501,10 +501,11 @@ class GenerationEngine:
         logits, k, v, _ = llama.prefill_kv(
             params, self.cfg, tokens, jnp.asarray([length]),
             rope_max=self.max_seq, rope_tables=self.rope_tables,
-            flash=self.mesh is None, adapter=adapter)
+            flash=self.mesh is None, adapter=adapter,
+            logit_pos=jnp.asarray([length - 1]))
         lengths = cache.lengths.at[slot].set(length)
         cache = llama.write_kv(cache, k, v, (0, slot, 0, 0, 0), lengths)
-        last = jnp.take(logits[0], length - 1, axis=0)  # [V] at the true end
+        last = logits[0, 0]  # [V] at the true prompt end (logit_pos)
         tok, lp = self._sample(last[None, :], temp[None], key, top_k[None])
         return tok[0], lp[0], cache
 
@@ -530,7 +531,8 @@ class GenerationEngine:
         logits, small = llama.prefill_chunk(
             params, self.cfg, tokens, small, start,
             rope_tables=self.rope_tables, compute_logits=sample,
-            adapter=adapter)
+            adapter=adapter,
+            logit_pos=jnp.asarray(pos_in_chunk)[None] if sample else None)
         k_new = jax.lax.dynamic_update_slice(cache.k, small.k, (0, slot, 0, 0, 0))
         v_new = jax.lax.dynamic_update_slice(cache.v, small.v, (0, slot, 0, 0, 0))
         ks, vs = cache.k_scale, cache.v_scale
@@ -547,7 +549,7 @@ class GenerationEngine:
             lengths = cache.lengths.at[slot].set(Smax)
             return llama.KVCache(k_new, v_new, lengths, ks, vs)
         lengths = cache.lengths.at[slot].set(total_len)
-        last = jnp.take(logits[0], pos_in_chunk, axis=0)
+        last = logits[0, 0]  # [V] at pos_in_chunk (logit_pos)
         tok, lp = self._sample(last[None, :], temp[None], key, top_k[None])
         return tok[0], lp[0], llama.KVCache(k_new, v_new, lengths, ks, vs)
 
@@ -588,10 +590,11 @@ class GenerationEngine:
         logits, k, v, _ = llama.prefill_kv(
             params, self.cfg, tokens, jnp.asarray([length]),
             rope_max=self.max_seq, rope_tables=self.rope_tables,
-            flash=True, adapter=adapter)
+            flash=True, adapter=adapter,
+            logit_pos=jnp.asarray([length - 1]))
         cache = paged_llama.write_prompt_blocks(cache, k, v, blocks, length)
         cache = cache._replace(lengths=cache.lengths.at[slot].set(length))
-        last = jnp.take(logits[0], length - 1, axis=0)
+        last = logits[0, 0]  # [V] at the true prompt end (logit_pos)
         tok, lp = self._sample(last[None, :], temp[None], key, top_k[None])
         return tok[0], lp[0], cache
 

@@ -42,6 +42,36 @@ def test_llama_decode_matches_prefill():
     assert list(cache.lengths) == [8, 8]
 
 
+def test_prefill_kv_logit_pos_matches_full():
+    """The sample-one-position serving path (logit_pos gathers the hidden
+    state BEFORE lm_head) must equal gathering the full [B, S, V] logits
+    at the same positions — for prefill_kv and prefill_chunk alike."""
+    params = llama.init(TINY, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 6), 0,
+                                TINY.vocab_size)
+    lengths = jnp.array([6, 4], jnp.int32)
+    full, k_full, v_full, _ = llama.prefill_kv(params, TINY, tokens, lengths)
+    pos = lengths - 1
+    sel, k_sel, v_sel, _ = llama.prefill_kv(params, TINY, tokens, lengths,
+                                            logit_pos=pos)
+    assert sel.shape == (2, 1, TINY.vocab_size)
+    want = jnp.take_along_axis(full, pos[:, None, None], axis=1)
+    np.testing.assert_allclose(np.asarray(sel), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(k_full), np.asarray(k_sel))
+    np.testing.assert_array_equal(np.asarray(v_full), np.asarray(v_sel))
+
+    cache = llama.init_cache(TINY, batch=2, max_seq=16)
+    cache = cache._replace(lengths=jnp.array([6, 6], jnp.int32))
+    cfull, _ = llama.prefill_chunk(params, TINY, tokens, cache, 0)
+    csel, _ = llama.prefill_chunk(params, TINY, tokens, cache, 0,
+                                  logit_pos=pos)
+    np.testing.assert_allclose(
+        np.asarray(csel),
+        np.asarray(jnp.take_along_axis(cfull, pos[:, None, None], axis=1)),
+        rtol=1e-5, atol=1e-5)
+
+
 def test_llama_prefill_respects_padding():
     """Padding tokens after the true length must not change earlier logits."""
     params = llama.init(TINY, jax.random.PRNGKey(0))
