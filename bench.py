@@ -911,9 +911,11 @@ def main() -> None:
         payload["spec_tok_s"] = round(spec["tok_s"], 1)
         payload["spec_tokens_per_window"] = round(
             spec["tokens_per_window"], 2)
-    # paged-pool sweep point: batch 128 (contiguous rows OOM past ~96);
-    # shrinks like bench_decode_best if even the pool can't fit
-    for paged_batch in (128, 112, 96):
+    # paged-pool sweep: contiguous rows OOM past ~96; the pool admits
+    # 128 (~5.5 GB at 512 live tokens/slot next to the 8.6 GB weight
+    # stream) and 160 (~6.9 GB) is worth an attempt now that each try
+    # runs in a fresh process. Shrinks like bench_decode_best.
+    for paged_batch in (160, 144, 128, 112, 96):
         paged = section("paged", "--paged-batch", str(paged_batch))
         if "error" not in paged:
             payload["paged_tok_s"] = round(paged["tok_s"], 1)
@@ -923,7 +925,7 @@ def main() -> None:
             break
         if paged.get("oom"):
             log(f"  paged batch={paged_batch} OOM, shrinking")
-            payload["paged_error"] = "OOM at every paged batch (128..96)"
+            payload["paged_error"] = "OOM at every paged batch (160..96)"
             continue  # overwritten by a success or smaller batch's error
         payload["paged_error"] = paged["error"]
         break
