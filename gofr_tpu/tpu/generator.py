@@ -1457,8 +1457,17 @@ class GenerationEngine:
                         self._iteration()
                     if self._admit_window > 0 and self._active.any():
                         # yield the GIL to request-submitter threads
-                        # parked during the device block (see __init__)
-                        time.sleep(self._admit_window)
+                        # parked during the device block (see __init__).
+                        # Event-wait instead of a plain sleep: a request
+                        # enqueued during the window wakes the loop NOW,
+                        # so the very next _admit sees it — a fixed sleep
+                        # made late-arriving (transport-hop) submitters
+                        # miss the admission point by a hair and pay a
+                        # whole extra decode block of TTFT. Clearing
+                        # first is safe: _admit reads the queue directly,
+                        # the event only gates the idle branch below.
+                        self._work.clear()
+                        self._work.wait(self._admit_window)
                 else:
                     self._work.wait(timeout=0.05)
                     self._work.clear()
