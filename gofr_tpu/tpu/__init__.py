@@ -26,10 +26,10 @@ Config keys (reference config style, pkg/gofr/config/config.go:3):
                       the stream sees K tokens per roundtrip; raise on
                       high-latency links, lower toward 1 for tightest
                       per-token latency)
-  TPU_ADMIT_WINDOW_MS post-block GIL-yield window in ms (default 2 —
-                      lets request-submitter threads parked on the GIL
-                      during a device block enqueue before the next
-                      block's admission check; 0 disables)
+  TPU_ADMIT_WINDOW_MS in-flight admission poll cadence in ms (default
+                      2 — decode blocks dispatch async and new requests
+                      are admitted while one runs, their prefill
+                      queueing behind it on the device stream)
   TPU_PREFIX_CACHE    prefix-KV pool rows (default 0 = off): stored
                       prompt prefixes restore as one HBM row copy
                       instead of prefill compute (tpu/prefix_cache.py)

@@ -27,6 +27,7 @@ stream; one background thread owns the device loop.
 
 from __future__ import annotations
 
+import functools
 import itertools
 import os
 import queue
@@ -155,6 +156,17 @@ class _Request:
         self.enqueued_at = time.monotonic()
 
 
+class _Inflight:
+    """A dispatched-but-unreaped device tick. ``arrays``: the dispatch's
+    output futures (readiness probe); ``reap``: fetch results and
+    deliver tokens — must run under the engine's device lock."""
+    __slots__ = ("arrays", "reap")
+
+    def __init__(self, arrays, reap):
+        self.arrays = arrays
+        self.reap = reap
+
+
 class _Slot:
     __slots__ = ("request", "remaining", "generated")
 
@@ -226,16 +238,13 @@ class GenerationEngine:
         # dispatch/tunnel latency K-fold. Cost: a finished stream wastes at
         # most K-1 slot-steps, and admission waits at most one block.
         self.decode_block = max(1, int(decode_block))
-        # Post-block GIL-yield window (seconds). On backends whose
-        # blocking device calls hold the GIL (the tunneled axon platform
-        # does), a submitter thread that received a request mid-block —
-        # the gRPC connection thread, an HTTP handler — is still parked
-        # on the GIL when the block ends, loses the race to the next
-        # _admit by microseconds, and eats one extra decode block of
-        # TTFT (~measured +134 ms at K=4). Sleeping a moment after each
-        # block hands the GIL to parked submitters so their requests
-        # make the very next admission check. Costs window/K per token
-        # (<1% at defaults); 0 disables.
+        # In-flight admission poll cadence (seconds). While a decode
+        # block runs on device, the serving loop waits on the submit
+        # event in slices of this length and admits new arrivals
+        # immediately (their prefill queues behind the block on the
+        # device stream) — see _admit_inflight. Historically this was a
+        # post-block GIL-yield sleep ("admit window"); the env knob
+        # TPU_ADMIT_WINDOW_MS keeps the name. 0 falls back to 1 ms.
         self._admit_window = max(0.0, float(admit_window_ms)) / 1e3
         # flash-decode kernel (ops.flash_decode): single-device only
         # (pallas is opaque to GSPMD) and opt-in while hardware timings
@@ -371,8 +380,6 @@ class GenerationEngine:
         self._admitting = 0
         self.total_tokens = 0
         self.total_requests = 0
-
-        import functools
 
         self._chunk_mid = functools.partial(self._chunk_fn, sample=False)
         self._chunk_final = functools.partial(self._chunk_fn, sample=True)
@@ -973,7 +980,7 @@ class GenerationEngine:
                 # weights — restoring it after the swap would serve
                 # wrong attention keys (same hazard as cross-adapter
                 # reuse). Invalidating inside the device lock, AFTER the
-                # swap, serializes against _iteration's match/store: no
+                # swap, serializes against the loop's match/store: no
                 # old-weight entry can be stored after we invalidate,
                 # and PrefixIndex is only ever mutated under this lock.
                 self._prefix_idx.invalidate_adapter(idx)
@@ -1167,7 +1174,9 @@ class GenerationEngine:
                 jnp.float32(0.0), jnp.int32(0), self._key,
                 self._adapter1(req)))
             pos += C
-            self._decode_tick()
+            inflight = self._decode_tick()  # synchronous: the lattice
+            if inflight is not None:        # already runs under the
+                inflight.reap()             # device lock
         if req.stream.cancelled.is_set():
             return 0, 0.0
         rem = L - pos
@@ -1454,20 +1463,15 @@ class GenerationEngine:
             try:
                 if self._active.any() or not self._pending.empty():
                     with self._device_lock:
-                        self._iteration()
-                    if self._admit_window > 0 and self._active.any():
-                        # yield the GIL to request-submitter threads
-                        # parked during the device block (see __init__).
-                        # Event-wait instead of a plain sleep: a request
-                        # enqueued during the window wakes the loop NOW,
-                        # so the very next _admit sees it — a fixed sleep
-                        # made late-arriving (transport-hop) submitters
-                        # miss the admission point by a hair and pay a
-                        # whole extra decode block of TTFT. Clearing
-                        # first is safe: _admit reads the queue directly,
-                        # the event only gates the idle branch below.
-                        self._work.clear()
-                        self._work.wait(self._admit_window)
+                        self._admit()
+                        inflight = self._tick()
+                    if inflight is not None:
+                        # serve admissions WHILE the block runs on
+                        # device, then fetch its results — see
+                        # _admit_inflight for why this is the TTFT fix
+                        self._admit_inflight(inflight)
+                        with self._device_lock:
+                            inflight.reap()
                 else:
                     self._work.wait(timeout=0.05)
                     self._work.clear()
@@ -1534,14 +1538,43 @@ class GenerationEngine:
                         req.stream._q.put(None)
                     return
 
-    def _iteration(self) -> None:
-        self._admit()
-        self._tick()
+    def _admit_inflight(self, inflight: _Inflight) -> None:
+        """Admit new arrivals while a dispatched tick executes on device.
 
-    def _tick(self) -> None:
-        """One serving tick: a speculative verify pass when the engine
-        can use one (spec enabled, every active slot greedy and clear of
-        capacity, at least one slot has a draft), else a decode block."""
+        Dispatches are async: until the tick's outputs are ready, the
+        old loop sat in device_get — which on the tunneled backend holds
+        the GIL, parking every submitter thread, and serialized
+        (delivery + admission + prefill dispatch) AFTER the block, so a
+        request arriving mid-block paid up to a whole extra block of
+        TTFT (the r3 gRPC gap). Here the loop thread instead waits on
+        the submit event and runs admissions NOW: the new request's
+        prefill queues on the device stream right behind the in-flight
+        block, making its first token cost (remaining block + prefill)
+        — the hardware floor. Readiness is polled via jax.Array
+        .is_ready(); if the probe is unsupported the reap just blocks
+        like the old loop. The deadline bounds the poll so a wedged
+        device surfaces its error through the blocking reap rather than
+        a silent spin."""
+        deadline = time.monotonic() + 60.0
+        poll = self._admit_window or 1e-3
+        while not self._closed and time.monotonic() < deadline:
+            try:
+                if all(a.is_ready() for a in inflight.arrays):
+                    return
+            except Exception:  # no readiness probe on this backend
+                return
+            if not self._pending.empty():
+                with self._device_lock:
+                    self._admit()
+                continue
+            self._work.clear()
+            self._work.wait(poll)
+
+    def _tick(self) -> "_Inflight | None":
+        """Dispatch one serving tick: a speculative verify pass when the
+        engine can use one (spec enabled, every active slot greedy and
+        clear of capacity, at least one slot has a draft), else a decode
+        block. Returns the in-flight handle (reap delivers) or None."""
         if self._spec_k and self._spec_eligible():
             drafts = {idx: self._draft(idx)
                       for idx in range(self.n_slots) if self._active[idx]}
@@ -1552,9 +1585,8 @@ class GenerationEngine:
             # K-times-slower cadence. Verify only when at least half the
             # active slots would actually speculate.
             if drafted > 0 and 2 * drafted >= len(drafts):
-                self._verify_tick(drafts)
-                return
-        self._decode_tick()
+                return self._verify_tick(drafts)
+        return self._decode_tick()
 
     def _spec_eligible(self) -> bool:
         W = self._spec_k + 1
@@ -1571,11 +1603,12 @@ class GenerationEngine:
             saw_active = True
         return saw_active
 
-    def _verify_tick(self, drafts: dict) -> None:
-        """One verify dispatch: window = [last_token, K drafts] per slot
-        (zero drafts for slots with no lookup match — they still emit
-        their 1 guaranteed token). Delivery mirrors _decode_tick: emitted
-        tokens stream in order, retirement mid-window discards the rest."""
+    def _verify_tick(self, drafts: dict) -> "_Inflight | None":
+        """Dispatch one verify pass: window = [last_token, K drafts] per
+        slot (zero drafts for slots with no lookup match — they still
+        emit their 1 guaranteed token). The reap mirrors _decode_tick's:
+        emitted tokens stream in order, retirement mid-window discards
+        the rest."""
         W = self._spec_k + 1
         window = np.zeros((self.n_slots, W), np.int32)
         window[:, 0] = self._last_tokens
@@ -1585,7 +1618,7 @@ class GenerationEngine:
         if self._paged:
             self._ensure_blocks(W)  # window rows span up to W positions
             if not self._active.any():
-                return
+                return None
             toks, lps, emit, self.cache = self._verify_jit(
                 self.cache, self.params, jnp.asarray(window),
                 jnp.asarray(self._active), self._next_key(),
@@ -1595,15 +1628,27 @@ class GenerationEngine:
                 self.cache, self.params, jnp.asarray(window),
                 jnp.asarray(self._active), self._next_key(),
                 self._adapters())
+        # Dispatch-time snapshots: in-flight admissions mutate _active /
+        # slot.request before the reap runs, and this window's tokens
+        # belong to the slots AS DISPATCHED — a slot that retired and
+        # was re-admitted mid-flight must not receive them.
+        snap_active = self._active.copy()
+        snap_reqs = [s.request for s in self._slots]
+        return _Inflight((toks, lps, emit), functools.partial(
+            self._verify_reap, toks, lps, emit, snap_active, snap_reqs))
+
+    def _verify_reap(self, toks, lps, emit, snap_active, snap_reqs) -> None:
         toks_np, lps_np, emit_np = jax.device_get((toks, lps, emit))
-        self._spec_windows += int(self._active.sum())
+        self._spec_windows += int(snap_active.sum())
         self._spec_emitted += int(emit_np.sum())
         if self._paged:
-            # device cursors advanced by emit (accepted tokens only)
+            # device cursors advanced by emit (accepted tokens only;
+            # zero for slots outside the dispatch mask, so in-flight
+            # admissions — cursor set by their own prefill — are safe)
             for idx in range(self.n_slots):
                 self._cursors[idx] += int(emit_np[idx])
         for idx, slot in enumerate(self._slots):
-            if not self._active[idx]:
+            if not snap_active[idx] or slot.request is not snap_reqs[idx]:
                 continue
             for k in range(int(emit_np[idx])):
                 if not self._active[idx]:
@@ -1613,17 +1658,18 @@ class GenerationEngine:
                 self._hist_append(idx, t)
                 self._deliver(idx, slot, t, float(lps_np[idx, k]))
 
-    def _decode_tick(self) -> None:
-        """One fused decode block: dispatch, fetch [K, B] tokens, deliver
-        in step order. A slot that finishes (EOS/budget/capacity) at step
-        k has its later tokens discarded on the host — bounded waste that
-        buys K-fold fewer device roundtrips."""
+    def _decode_tick(self) -> "_Inflight | None":
+        """Dispatch one fused decode block; the reap fetches [K, B]
+        tokens and delivers in step order. A slot that finishes
+        (EOS/budget/capacity) at step k has its later tokens discarded
+        on the host — bounded waste that buys K-fold fewer device
+        roundtrips."""
         if not self._active.any():
-            return
+            return None
         if self._paged:
             self._ensure_blocks()  # may retire starving slots
             if not self._active.any():
-                return
+                return None
             toks, lps, self.cache = self._step_jit(
                 self.cache, self.params, jnp.asarray(self._last_tokens),
                 jnp.asarray(self._active), jnp.asarray(self._temps),
@@ -1636,6 +1682,14 @@ class GenerationEngine:
                 jnp.asarray(self._active), jnp.asarray(self._temps),
                 jnp.asarray(self._top_ks), self._next_key(),
                 self._adapters())
+        # snapshots: see _verify_tick — this block's tokens belong to
+        # the slots as dispatched, not as mutated by in-flight admissions
+        snap_active = self._active.copy()
+        snap_reqs = [s.request for s in self._slots]
+        return _Inflight((toks, lps), functools.partial(
+            self._decode_reap, toks, lps, snap_active, snap_reqs))
+
+    def _decode_reap(self, toks, lps, snap_active, snap_reqs) -> None:
         toks_np, lps_np = jax.device_get((toks, lps))  # [K, B] each
         if self.metrics is not None:
             self.metrics.set_gauge("app_tpu_batch_fill",
@@ -1643,7 +1697,8 @@ class GenerationEngine:
                                    program="generate")
         for k in range(toks_np.shape[0]):
             for idx, slot in enumerate(self._slots):
-                if not self._active[idx]:
+                if not snap_active[idx] or not self._active[idx] \
+                        or slot.request is not snap_reqs[idx]:
                     continue
                 self._last_tokens[idx] = toks_np[k, idx]
                 if self._spec_k:
