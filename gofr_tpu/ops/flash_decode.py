@@ -149,6 +149,17 @@ def _flash_decode_cache(q, k_cache, v_cache, lengths, k_scale, v_scale,
     q_bd = jnp.swapaxes(q_bd, 1, 2).reshape(b, h, n_kv * d)
     grid = (b, smax // block_s)
 
+    def clamp(si, lens, bi):
+        # v3: clamp past-the-end s-blocks to the slot's LAST live block.
+        # Grid steps whose index map repeats the previous step's indices
+        # skip their DMA (the same trick ops.paged_attention uses via
+        # clamped table rows), so per-slot HBM traffic tracks the LIVE
+        # length instead of Smax — the jnp path always streams the full
+        # padded cache. The compute guard stays keyed on the TRUE si,
+        # so revisited tiles are never folded in twice.
+        last = jax.lax.max((lens[bi] + block_s - 1) // block_s - 1, 0)
+        return jax.lax.min(si, last)
+
     kernel = functools.partial(_decode_kernel, block_s=block_s,
                                n_kv=n_kv, quant=quant)
     acc, m, l = pl.pallas_call(
@@ -159,13 +170,17 @@ def _flash_decode_cache(q, k_cache, v_cache, lengths, k_scale, v_scale,
             in_specs=[
                 pl.BlockSpec((1, h, n_kv * d), lambda bi, si, lens: (bi, 0, 0)),
                 pl.BlockSpec((1, block_s, n_kv, d),
-                             lambda bi, si, lens: (bi, si, 0, 0)),
+                             lambda bi, si, lens: (bi, clamp(si, lens, bi),
+                                                   0, 0)),
                 pl.BlockSpec((1, block_s, n_kv, d),
-                             lambda bi, si, lens: (bi, si, 0, 0)),
+                             lambda bi, si, lens: (bi, clamp(si, lens, bi),
+                                                   0, 0)),
                 pl.BlockSpec((1, n_kv, block_s),
-                             lambda bi, si, lens: (bi, 0, si)),
+                             lambda bi, si, lens: (bi, 0,
+                                                   clamp(si, lens, bi))),
                 pl.BlockSpec((1, n_kv, block_s),
-                             lambda bi, si, lens: (bi, 0, si)),
+                             lambda bi, si, lens: (bi, 0,
+                                                   clamp(si, lens, bi))),
             ],
             out_specs=[
                 pl.BlockSpec((1, h, n_kv * d), lambda bi, si, lens: (bi, 0, 0)),
@@ -235,10 +250,16 @@ def _kernel_ok(q, k_cache, block_s: int) -> bool:
 
 def decode_attention_auto(q, k_cache, v_cache, k_new, v_new, lengths,
                           k_scale=None, v_scale=None, *,
-                          block_s: int = 128,
+                          block_s: int | None = None,
                           interpret: bool = False) -> jnp.ndarray:
     """Flash-decode kernel when backend+shapes allow, jnp reference
-    otherwise. Same contract as decode_attention_appended."""
+    otherwise. Same contract as decode_attention_appended.
+    ``block_s`` defaults from GOFR_FLASH_BLOCK_S (128): larger blocks
+    amortize per-grid-step overhead, at (block_s/S)-granular DMA skip."""
+    if block_s is None:
+        import os
+
+        block_s = int(os.environ.get("GOFR_FLASH_BLOCK_S", "128"))
     if interpret or _kernel_ok(q, k_cache, block_s):
         return flash_decode_appended(q, k_cache, v_cache, k_new, v_new,
                                      lengths, k_scale, v_scale,
