@@ -948,6 +948,7 @@ def main() -> None:
                 f"paged pool, batch={payload['paged_batch']} "
                 f"(contiguous best: {payload['value']} @ batch={used})")
             payload["value"] = payload["paged_tok_s"]
+            payload["batch"] = payload["paged_batch"]  # keep the pair
             payload["vs_baseline"] = round(
                 payload["value"] / BASELINE_TOK_S, 3)
     emit(payload)
