@@ -383,6 +383,11 @@ class BlockAllocator:
                 self._free.append(b)
             assert self._rc[b] >= 0, f"double free of block {b}"
 
+    def sole_holder(self, blocks) -> bool:
+        """True when the caller's reference is the only one on every
+        block — freeing would return them all to the free list."""
+        return all(self._rc[b] == 1 for b in blocks)
+
 
 class SharedPrefixIndex:
     """Zero-copy prefix reuse for the paged pool (the paged counterpart
@@ -482,15 +487,40 @@ class SharedPrefixIndex:
             self.evict_one()
 
     def evict_one(self) -> bool:
-        """Drop the LRU entry's references (pool-pressure valve).
-        Returns False when there is nothing left to evict."""
+        """Drop one entry's references (pool-pressure valve). Returns
+        False when there is nothing left to evict.
+
+        Prefers the LRU entry among those whose blocks will ACTUALLY
+        return to the free list (no live slot still holds them) —
+        evicting a share-held entry reclaims zero blocks, and a
+        transient shortage would otherwise flush the whole index,
+        including productive future-hit entries, without recovering any
+        memory. Share-held entries are evicted only when nothing
+        reclaimable remains (their references still unpin the blocks
+        once the sharing slots retire, so the caller's retry loop stays
+        finite)."""
         if not self._entries:
             return False
-        victim = min(range(len(self._entries)),
-                     key=lambda i: self._entries[i]["used"])
+        order = sorted(range(len(self._entries)),
+                       key=lambda i: self._entries[i]["used"])
+        victim = next(
+            (i for i in order
+             if self._alloc.sole_holder(self._entries[i]["blocks"])),
+            order[0])
         e = self._entries.pop(victim)
         self._alloc.free(e["blocks"])
         return True
+
+    def clear(self) -> int:
+        """Drop every entry, releasing its block references. Engine
+        recovery calls this after reallocating the pool: stored entries
+        would otherwise keep pointing into the NEW (zeroed) pool and
+        silently serve all-zero KV on their next hit."""
+        n = len(self._entries)
+        for e in self._entries:
+            self._alloc.free(e["blocks"])
+        self._entries = []
+        return n
 
     def invalidate_adapter(self, adapter: int) -> int:
         """Drop every entry stored under ``adapter`` (LoRA hot-swap:

@@ -259,7 +259,12 @@ def decode_attention_auto(q, k_cache, v_cache, k_new, v_new, lengths,
     if block_s is None:
         import os
 
-        block_s = int(os.environ.get("GOFR_FLASH_BLOCK_S", "128"))
+        try:
+            block_s = int(os.environ.get("GOFR_FLASH_BLOCK_S", "128"))
+        except ValueError:
+            block_s = 128
+        if block_s <= 0:  # 0 would ZeroDivide inside _kernel_ok's gate
+            block_s = 128
     if interpret or _kernel_ok(q, k_cache, block_s):
         return flash_decode_appended(q, k_cache, v_cache, k_new, v_new,
                                      lengths, k_scale, v_scale,

@@ -1040,7 +1040,15 @@ class GenerationEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    def _admit(self) -> None:
+    def _admit(self, defer_lattice: bool = False) -> int:
+        """Admit pending requests into free slots; returns the number
+        started. ``defer_lattice``: in-flight admission (see
+        _admit_inflight) must NOT start a chunk-lattice admission — the
+        lattice interleaves its own decode blocks, which would
+        double-decode every active slot from the un-reaped outer
+        block's stale _last_tokens — so lattice-path requests stay
+        queued until the outer reap and the next synchronous pass."""
+        started = 0
         for idx, slot in enumerate(self._slots):
             if not slot.free:
                 continue
@@ -1051,10 +1059,18 @@ class GenerationEngine:
             # stream. Only this thread mutates the counter.
             self._admitting += 1
             try:
+                if defer_lattice:
+                    # peek is safe: this thread is the only consumer
+                    try:
+                        head = self._pending.queue[0]
+                    except IndexError:
+                        return started
+                    if self._needs_lattice(head):
+                        return started
                 try:
                     req = self._pending.get_nowait()
                 except queue.Empty:
-                    return
+                    return started
                 if req.stream.cancelled.is_set():
                     req.stream._q.put(None)
                     continue
@@ -1067,10 +1083,28 @@ class GenerationEngine:
                         # preserved across the requeue — pool-pressure
                         # reordering is documented engine behavior.)
                         self._pending.put(req)
-                        return
+                        return started
                 self._start(idx, slot, req, blocks)
+                started += 1
             finally:
                 self._admitting -= 1
+        return started
+
+    def _needs_lattice(self, req: _Request) -> bool:
+        """Would admitting ``req`` run the chunk-prefill lattice?
+        True for prompts past the largest bucket, and for paged prefix
+        hits (a hit resumes the lattice from the match point).
+        SharedPrefixIndex.match is pure — hit/miss accounting happens
+        in accept()/reject() at real admission — so peeking here costs
+        one LCP scan and perturbs nothing."""
+        L = len(req.prompt)
+        if L > self.prompt_buckets[-1]:
+            return True
+        if self._paged and self._prefix_idx is not None:
+            _, m = self._prefix_idx.match(
+                np.asarray(req.prompt, np.int32), req.adapter)
+            return bool(m) and self._lattice_resume_valid(L, m)
+        return False
 
     def _paged_admission_blocks(self, req: _Request
                                 ) -> "tuple[list, int, list] | None":
@@ -1500,6 +1534,11 @@ class GenerationEngine:
                                 self.cfg, self.n_slots,
                                 self._alloc.n_blocks, self._block_t,
                                 dtype=self._kv_dtype)
+                            if self._prefix_idx is not None:
+                                # stored entries reference blocks of the
+                                # OLD pool; through the fresh one they
+                                # would restore all-zero KV on a hit
+                                self._prefix_idx.clear()
                             if hasattr(self, "_scratch"):
                                 # the chunk jits donate the scratch row
                                 # too — a failed chunk dispatch leaves it
@@ -1563,10 +1602,17 @@ class GenerationEngine:
                     return
             except Exception:  # no readiness probe on this backend
                 return
+            started = 0
             if not self._pending.empty():
                 with self._device_lock:
-                    self._admit()
-                continue
+                    started = self._admit(defer_lattice=True)
+            if started:
+                continue  # more may be queued behind the ones admitted
+            # nothing admitted (queue empty, no free slot, pool
+            # pressure, or a lattice request deferred to the reap):
+            # WAIT — looping straight back would busy-spin on the GIL
+            # and the device lock for the whole block, starving the
+            # very submitter/consumer threads this loop exists to serve
             self._work.clear()
             self._work.wait(poll)
 

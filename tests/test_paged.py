@@ -410,6 +410,49 @@ def test_shared_prefix_index_zero_copy_semantics():
     assert a.free_blocks == 10 - 1
 
 
+def test_prefix_eviction_prefers_reclaimable_entries():
+    """Pool-pressure eviction must pick an entry whose blocks ACTUALLY
+    free (no live slot sharing them) over the LRU one — evicting a
+    share-held entry reclaims nothing and would flush the index for no
+    memory. clear() (engine recovery) drops everything."""
+    from gofr_tpu.models.paged_llama import SharedPrefixIndex
+
+    a = BlockAllocator(10)
+    idx = SharedPrefixIndex(4, a, block_size=4)
+    old = np.arange(1, 10, dtype=np.int32)          # 2 full blocks
+    b_old = a.alloc(3)
+    idx.store(old, b_old, adapter=0)                # LRU-oldest entry
+    # a live slot still shares the old entry's full blocks
+    slot_hold = b_old[:2]
+    a.ref(slot_hold)
+    a.free(b_old)                                    # storing slot retires
+    new = np.arange(50, 59, dtype=np.int32)
+    b_new = a.alloc(3)
+    idx.store(new, b_new, adapter=0)                # newer, sole-held
+    a.free(b_new)
+    free_before = a.free_blocks
+    assert idx.evict_one()
+    # the NEWER (reclaimable) entry went, and its 2 full blocks freed
+    assert a.free_blocks == free_before + 2
+    blocks, m = idx.match(np.concatenate([old, [99]]), 0)
+    assert m == 8, "the share-held LRU entry must survive"
+    idx.reject()
+    # nothing reclaimable left: the share-held entry is still evictable
+    # (finite retry loops), it just frees no blocks yet
+    free_before = a.free_blocks
+    assert idx.evict_one()
+    assert a.free_blocks == free_before
+    assert not idx.evict_one()
+    a.free(slot_hold)                                # slot retires later
+    assert a.free_blocks == 9                        # everything back
+
+    b = a.alloc(2)
+    idx.store(np.arange(1, 10, dtype=np.int32), b, adapter=0)
+    a.free(b)
+    assert idx.clear() == 1
+    assert a.free_blocks == 9
+
+
 @pytest.mark.parametrize("kv_dtype", [None, jnp.int8])
 def test_paged_prefix_hits_stream_exact_tokens(params, kv_dtype):
     """The zero-copy prefix cache: a stored prompt's blocks are SHARED
