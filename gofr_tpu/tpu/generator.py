@@ -560,22 +560,21 @@ class GenerationEngine:
         tok, lp = self._sample(last[None, :], temp[None], key, top_k[None])
         return tok[0], lp[0], llama.KVCache(k_new, v_new, lengths, ks, vs)
 
-    def _step_fn(self, cache, params, last_tokens, active, temps, top_ks,
-                 key, adapter=None):
+    def _fused_decode_scan(self, cache, last_tokens, active, temps,
+                           top_ks, key, step_model):
         """K fused decode steps over all slots (K = decode_block); one
         dispatch returns [K, B] tokens. Each step feeds its sampled token
         to the next on device — the host is off the per-token critical
         path entirely. Inactive cursors stay frozen every step (their
         garbage KV scatter lands at the frozen position, which admission
-        either overwrites or — for parked slots — drops)."""
+        either overwrites or — for parked slots — drops).
+        ``step_model(tokens, cache) -> (logits, stepped)`` is the only
+        thing that differs between the contiguous and paged engines."""
         keys = jax.random.split(key, self.decode_block)
 
         def body(carry, step_key):
             tokens, cache = carry
-            logits, stepped = llama.decode_step(
-                params, self.cfg, tokens, cache,
-                rope_tables=self.rope_tables, flash=self._flash_decode,
-                adapter=adapter)
+            logits, stepped = step_model(tokens, cache)
             lengths = jnp.where(active, stepped.lengths, cache.lengths)
             stepped = stepped._replace(lengths=lengths)
             toks, lps = self._sample(logits, temps, step_key, top_ks)
@@ -585,6 +584,31 @@ class GenerationEngine:
         (_, cache), (toks, lps) = jax.lax.scan(body, (last_tokens, cache),
                                                keys)
         return toks, lps, cache
+
+    def _verify_epilogue(self, logits, window, active, stepped):
+        """Shared verify-pass tail: greedy tokens + their logprobs, the
+        longest agreeing draft run per slot (accept), emit counts (the
+        +1 is the pass's guaranteed token; inactive slots emit 0), and
+        cursors advanced by exactly what the caller may deliver."""
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, W]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        lps = jnp.take_along_axis(logp, greedy[..., None], axis=-1)[..., 0]
+        agree = (greedy[:, :-1] == window[:, 1:]).astype(jnp.int32)
+        accept = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)     # [B]
+        emit = jnp.where(active, accept + 1, 0)
+        lengths = stepped.lengths + emit
+        return greedy, lps, emit, stepped._replace(lengths=lengths)
+
+    def _step_fn(self, cache, params, last_tokens, active, temps, top_ks,
+                 key, adapter=None):
+        def step_model(tokens, cache):
+            return llama.decode_step(
+                params, self.cfg, tokens, cache,
+                rope_tables=self.rope_tables, flash=self._flash_decode,
+                adapter=adapter)
+
+        return self._fused_decode_scan(cache, last_tokens, active, temps,
+                                       top_ks, key, step_model)
 
     def _paged_prefill_fn(self, cache, params, tokens, length, blocks,
                           slot, temp, top_k, key, adapter=None):
@@ -615,38 +639,22 @@ class GenerationEngine:
         logits, stepped = paged_llama.paged_verify_step(
             params, self.cfg, window, cache, table,
             rope_tables=self.rope_tables, adapter=adapter)
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        lps = jnp.take_along_axis(logp, greedy[..., None], axis=-1)[..., 0]
-        agree = (greedy[:, :-1] == window[:, 1:]).astype(jnp.int32)
-        accept = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)
-        emit = jnp.where(active, accept + 1, 0)
-        lengths = stepped.lengths + emit
-        return greedy, lps, emit, stepped._replace(lengths=lengths)
+        return self._verify_epilogue(logits, window, active, stepped)
 
     def _paged_step_fn(self, cache, params, last_tokens, active, temps,
                        top_ks, key, table, adapter=None):
-        """K fused paged decode steps — _step_fn over the block pool.
-        ``table`` [B, MB] is host-owned and constant through the block
-        (the host pre-allocates blocks covering K tokens per slot)."""
+        """_step_fn over the block pool. ``table`` [B, MB] is host-owned
+        and constant through the block (the host pre-allocates blocks
+        covering K tokens per slot)."""
         from ..models import paged_llama
 
-        keys = jax.random.split(key, self.decode_block)
-
-        def body(carry, step_key):
-            tokens, cache = carry
-            logits, stepped = paged_llama.paged_decode_step(
+        def step_model(tokens, cache):
+            return paged_llama.paged_decode_step(
                 params, self.cfg, tokens, cache, table,
                 rope_tables=self.rope_tables, adapter=adapter)
-            lengths = jnp.where(active, stepped.lengths, cache.lengths)
-            stepped = stepped._replace(lengths=lengths)
-            toks, lps = self._sample(logits, temps, step_key, top_ks)
-            toks = jnp.where(active, toks, tokens)
-            return (toks, stepped), (toks, lps)
 
-        (_, cache), (toks, lps) = jax.lax.scan(body, (last_tokens, cache),
-                                               keys)
-        return toks, lps, cache
+        return self._fused_decode_scan(cache, last_tokens, active, temps,
+                                       top_ks, key, step_model)
 
     def _verify_fn(self, cache, params, window, active, key, adapter=None):
         """One speculative verify pass. ``window`` [B, W]: col 0 = each
@@ -660,14 +668,7 @@ class GenerationEngine:
                                             cache,
                                             rope_tables=self.rope_tables,
                                             adapter=adapter)
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, W]
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        lps = jnp.take_along_axis(logp, greedy[..., None], axis=-1)[..., 0]
-        agree = (greedy[:, :-1] == window[:, 1:]).astype(jnp.int32)
-        accept = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)     # [B]
-        emit = jnp.where(active, accept + 1, 0)
-        lengths = stepped.lengths + emit
-        return greedy, lps, emit, stepped._replace(lengths=lengths)
+        return self._verify_epilogue(logits, window, active, stepped)
 
     def _hist_set(self, idx: int, tokens) -> None:
         n = min(len(tokens), self._hist_buf.shape[1])
