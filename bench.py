@@ -748,7 +748,7 @@ def run_section(args) -> None:
                 log(f"  dispatch floor probe failed: "
                     f"{type(e).__name__}: {str(e)[:120]}")
             out.update(bench_decode_best(
-                cfg, (96, 80, 64, 48, 32, 24, 16, 8), cache_len=1024))
+                cfg, (112, 96, 80, 64, 48, 32, 24, 16, 8), cache_len=1024))
             try:
                 out["flash_smoke"] = flash_smoke()
             except Exception as e:
