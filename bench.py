@@ -477,6 +477,12 @@ def bench_ttft(cfg, *, slots: int, probe_lens=(128, 256, 512),
         for plen in probe_lens:
             for _ in range(probes_per_len):
                 prompt = rng.integers(1, cfg.vocab_size, plen).tolist()
+                # decorrelate from the decode-block cycle: a serial probe
+                # otherwise submits right after a reap (its previous
+                # drain completes at a block boundary) and always eats a
+                # near-full block of admission wait — real arrivals are
+                # uniform over the cycle, and p50 should measure that
+                time.sleep(rng.uniform(0.0, 0.15))
                 t0 = time.perf_counter()
                 stream = engine.generate(prompt, max_new_tokens=2)
                 it = iter(stream)
@@ -525,6 +531,7 @@ def bench_ttft(cfg, *, slots: int, probe_lens=(128, 256, 512),
                 for plen in probe_lens:
                     for _ in range(probes_per_len):
                         prompt = rng.integers(1, cfg.vocab_size, plen).tolist()
+                        time.sleep(rng.uniform(0.0, 0.15))  # see above
                         t0 = time.perf_counter()
                         it = channel.server_stream(
                             "/llm.Generation/Generate",

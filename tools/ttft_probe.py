@@ -105,6 +105,9 @@ def main() -> None:
 
         def probe_engine(plen: int) -> dict:
             prompt = rng.integers(1, cfg.vocab_size, plen).tolist()
+            # decorrelate from the block cycle (serial probes otherwise
+            # phase-lock their submit to a reap boundary)
+            time.sleep(rng.uniform(0.0, 0.15))
             t0 = time.monotonic()
             s = engine.generate(prompt, max_new_tokens=2)
             it = iter(s)
@@ -168,6 +171,7 @@ def main() -> None:
                     for _ in range(args.probes):
                         prompt = rng.integers(
                             1, cfg.vocab_size, plen).tolist()
+                        time.sleep(rng.uniform(0.0, 0.15))  # see above
                         t0 = time.monotonic()
                         it = channel.server_stream(
                             "/llm.Generation/Generate",
