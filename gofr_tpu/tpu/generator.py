@@ -1688,22 +1688,24 @@ class GenerationEngine:
         toks_np, lps_np, emit_np = jax.device_get((toks, lps, emit))
         self._spec_windows += int(snap_active.sum())
         self._spec_emitted += int(emit_np.sum())
+        emit_l = emit_np.tolist()
         if self._paged:
             # device cursors advanced by emit (accepted tokens only;
             # zero for slots outside the dispatch mask, so in-flight
             # admissions — cursor set by their own prefill — are safe)
             for idx in range(self.n_slots):
-                self._cursors[idx] += int(emit_np[idx])
+                self._cursors[idx] += emit_l[idx]
+        toks_l, lps_l = toks_np.tolist(), lps_np.tolist()
         for idx, slot in enumerate(self._slots):
             if not snap_active[idx] or slot.request is not snap_reqs[idx]:
                 continue
-            for k in range(int(emit_np[idx])):
+            for k in range(emit_l[idx]):
                 if not self._active[idx]:
                     break  # retired mid-window (EOS/budget/cancel)
-                t = int(toks_np[idx, k])
+                t = toks_l[idx][k]
                 self._last_tokens[idx] = t
                 self._hist_append(idx, t)
-                self._deliver(idx, slot, t, float(lps_np[idx, k]))
+                self._deliver(idx, slot, t, lps_l[idx][k])
 
     def _decode_tick(self) -> "_Inflight | None":
         """Dispatch one fused decode block; the reap fetches [K, B]
@@ -1742,13 +1744,16 @@ class GenerationEngine:
             self.metrics.set_gauge("app_tpu_batch_fill",
                                    float(self._active.sum()) / self.n_slots,
                                    program="generate")
-        for k in range(toks_np.shape[0]):
+        # bulk-convert once: per-element int()/float() on numpy scalars
+        # costs real milliseconds per reap at high slot counts
+        toks_l, lps_l = toks_np.tolist(), lps_np.tolist()
+        for k in range(len(toks_l)):
+            trow, lrow = toks_l[k], lps_l[k]
             for idx, slot in enumerate(self._slots):
                 if not snap_active[idx] or not self._active[idx] \
                         or slot.request is not snap_reqs[idx]:
                     continue
-                self._last_tokens[idx] = toks_np[k, idx]
+                self._last_tokens[idx] = trow[idx]
                 if self._spec_k:
-                    self._hist_append(idx, int(toks_np[k, idx]))
-                self._deliver(idx, slot, int(toks_np[k, idx]),
-                              float(lps_np[k, idx]))
+                    self._hist_append(idx, trow[idx])
+                self._deliver(idx, slot, trow[idx], lrow[idx])
