@@ -444,7 +444,7 @@ def flash_smoke() -> str:
 
 def bench_ttft(cfg, *, slots: int, probe_lens=(128, 256, 512),
                probes_per_len: int = 5, max_seq: int = 1024,
-               grpc: bool = True) -> dict:
+               grpc: bool = True, paged_blocks: int = 0) -> dict:
     """p50 TTFT (ms), prompt-submit -> first token, while other slots are
     decoding — the latency a streaming client sees. Measured at BOTH
     levels the north star cares about: through the engine's admission
@@ -461,7 +461,8 @@ def bench_ttft(cfg, *, slots: int, probe_lens=(128, 256, 512),
     params = int8_random_params(cfg, jax.random.PRNGKey(0))
     engine = GenerationEngine(cfg, params, slots=slots, max_seq=max_seq,
                               prompt_buckets=tuple(probe_lens),
-                              kv_dtype=jnp.int8)
+                              kv_dtype=jnp.int8,
+                              paged_blocks=paged_blocks)
     rng = np.random.default_rng(0)
     srv = channel = None
     try:
@@ -765,6 +766,13 @@ def run_section(args) -> None:
             emit(out)
         elif args.section == "ttft":
             emit(bench_ttft(cfg, slots=args.slots))
+        elif args.section == "ttft_paged":
+            # the paged pool is the headline serving config — TTFT must
+            # hold there too. Engine-level only (the transport hop is
+            # already measured on the contiguous engine). Pool: 30
+            # background slots × 8 blocks at capacity + probes + slack.
+            emit(bench_ttft(cfg, slots=args.slots, grpc=False,
+                            paged_blocks=290))
         elif args.section == "prefix":
             emit(bench_prefix(cfg))
         elif args.section == "engine":
@@ -904,6 +912,11 @@ def main() -> None:
         if "grpc_error" in ttft:
             payload["ttft_grpc_error"] = ttft["grpc_error"]
         payload["ttft_target_ms"] = TARGET_TTFT_MS
+    tp = section("ttft_paged", "--slots", str(min(used or 8, 32)))
+    if "error" in tp:
+        payload["ttft_paged_error"] = tp["error"]
+    else:
+        payload["ttft_paged_p50_ms"] = round(tp["p50_ms"], 1)
     pfx = section("prefix")
     if "error" in pfx:
         payload["prefix_error"] = pfx["error"]
