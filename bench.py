@@ -783,10 +783,12 @@ def run_section(args) -> None:
             emit(bench_paged_decode(cfg, batch=args.paged_batch,
                                     live_len=448))
         elif args.section == "paged_engine":
-            # full serving stack over the paged pool at 128 slots. Pool
-            # sizing: a stream's cursor peaks at 16+96=112 < 128, so one
-            # block per slot; + trash + slack ≈ 1.5 GB of pool HBM
-            emit(bench_engine(cfg, slots=128, paged_blocks=140))
+            # full serving stack over the paged pool at the slot count
+            # the raw sweep proved (--slots). Pool sizing: a stream's
+            # cursor peaks at 16+96=112 < 128, so one block per slot;
+            # + trash + slack
+            emit(bench_engine(cfg, slots=args.slots,
+                              paged_blocks=args.slots + 15))
         else:
             emit({"error": f"unknown section {args.section!r}"})
     except Exception as e:
@@ -954,7 +956,7 @@ def main() -> None:
         payload["paged_error"] = paged["error"]
         break
     if "paged_tok_s" in payload:
-        pe = section("paged_engine")
+        pe = section("paged_engine", "--slots", str(payload["paged_batch"]))
         if "error" in pe:
             payload["paged_engine_error"] = pe["error"]
         else:
