@@ -219,6 +219,38 @@ def _streams(engine, prompts, n):
     return [s.tokens() for s in streams]
 
 
+def test_needs_lattice_peek(params):
+    """The in-flight admission peek must flag exactly the requests that
+    would run the chunk lattice: prompts past the largest bucket, and
+    paged prefix HITS (which resume the lattice) — misses and short
+    prompts stay admittable mid-flight."""
+    from gofr_tpu.tpu.generator import _Request, GenStream
+
+    def req(eng, prompt):
+        return _Request(GenStream(0, eng),
+                        np.asarray(prompt, np.int32), 4, 0.0, 0, None)
+
+    rng = np.random.default_rng(11)
+    prefix = rng.integers(1, TINY.vocab_size, 36).tolist()
+    eng = GenerationEngine(TINY, params, slots=2, max_seq=64,
+                           prompt_buckets=(8, 16),
+                           paged_blocks=13, paged_block_size=16,
+                           prefix_cache_slots=2, prefix_store_min=16)
+    try:
+        gen = eng
+        short = rng.integers(1, TINY.vocab_size, 6).tolist()
+        assert not gen._needs_lattice(req(eng, short))
+        assert gen._needs_lattice(req(eng, rng.integers(
+            1, TINY.vocab_size, 20).tolist()))  # > largest bucket
+        # a stored prefix turns a continuation into a lattice resume
+        assert not gen._needs_lattice(req(eng, prefix[:12] + [7, 7]))
+        eng.generate(prefix, max_new_tokens=2).tokens()
+        hits = req(eng, prefix + [5, 6])
+        assert gen._needs_lattice(hits)
+    finally:
+        eng.close()
+
+
 @pytest.mark.parametrize("kv_dtype", [None, jnp.int8])
 def test_paged_engine_matches_contiguous_engine(params, kv_dtype):
     """The paged engine streams the exact tokens the contiguous engine
