@@ -322,6 +322,9 @@ class GenerationEngine:
         self._temps = np.zeros((slots,), np.float32)
         self._top_ks = np.zeros((slots,), np.int32)
         self._key = jax.random.PRNGKey(seed)
+        # device mirrors of host-owned dispatch arrays (see _dev)
+        self._mirror: dict[str, Any] = {}
+        self._dirty: set[str] = set()
 
         # Prefix KV cache (tpu/prefix_cache.py): a P-row pool of stored
         # prompt-prefix KV. A hit replaces MXU prefill work for the
@@ -396,17 +399,21 @@ class GenerationEngine:
             self._cache_sh = cache_sh
             self.cache = jax.device_put(self.cache, cache_sh)
             rep = replicated(mesh)
-            # outputs: (token, logprob, cache) for prefill/final-chunk,
-            # (tokens, logprobs, cache) for the fused step
+            # outputs: (token, logprob, next_key, cache) for prefill/
+            # final-chunk, (tokens, logprobs, next_key, cache) for the
+            # fused step — the PRNG key chains through every sampling
+            # program (split in-trace, no host round-trip per block)
             self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(0,),
-                                        out_shardings=(rep, rep, cache_sh))
+                                        out_shardings=(rep, rep, rep,
+                                                       cache_sh))
             self._step_jit = jax.jit(self._step_fn, donate_argnums=(0,),
-                                     out_shardings=(rep, rep, cache_sh))
+                                     out_shardings=(rep, rep, rep, cache_sh))
             self._chunk_mid_jit = jax.jit(self._chunk_mid, donate_argnums=(0,),
                                           out_shardings=cache_sh)
             self._chunk_final_jit = jax.jit(self._chunk_final,
                                             donate_argnums=(0,),
-                                            out_shardings=(rep, rep, cache_sh))
+                                            out_shardings=(rep, rep, rep,
+                                                           cache_sh))
             if self._prefix_idx is not None:
                 # pool shards like the serving cache (batch rows over the
                 # data axes when they divide, KV heads over tp); pinning
@@ -505,6 +512,7 @@ class GenerationEngine:
         # flash prefill only off-mesh: a Pallas call inside a GSPMD-sharded
         # jit does not partition (custom calls are opaque to the
         # partitioner) — sharded engines keep the fusable jnp reference.
+        key, sub = jax.random.split(key)  # chained: see _fused_decode_scan
         logits, k, v, _ = llama.prefill_kv(
             params, self.cfg, tokens, jnp.asarray([length]),
             rope_max=self.max_seq, rope_tables=self.rope_tables,
@@ -513,8 +521,8 @@ class GenerationEngine:
         lengths = cache.lengths.at[slot].set(length)
         cache = llama.write_kv(cache, k, v, (0, slot, 0, 0, 0), lengths)
         last = logits[0, 0]  # [V] at the true prompt end (logit_pos)
-        tok, lp = self._sample(last[None, :], temp[None], key, top_k[None])
-        return tok[0], lp[0], cache
+        tok, lp = self._sample(last[None, :], temp[None], sub, top_k[None])
+        return tok[0], lp[0], key, cache
 
     def _chunk_fn(self, cache, params, tokens, start, slot, total_len,
                   pos_in_chunk, temp, top_k, key, adapter, sample: bool):
@@ -557,8 +565,10 @@ class GenerationEngine:
             return llama.KVCache(k_new, v_new, lengths, ks, vs)
         lengths = cache.lengths.at[slot].set(total_len)
         last = logits[0, 0]  # [V] at pos_in_chunk (logit_pos)
-        tok, lp = self._sample(last[None, :], temp[None], key, top_k[None])
-        return tok[0], lp[0], llama.KVCache(k_new, v_new, lengths, ks, vs)
+        key, sub = jax.random.split(key)  # chained: see _fused_decode_scan
+        tok, lp = self._sample(last[None, :], temp[None], sub, top_k[None])
+        return (tok[0], lp[0], key,
+                llama.KVCache(k_new, v_new, lengths, ks, vs))
 
     def _fused_decode_scan(self, cache, last_tokens, active, temps,
                            top_ks, key, step_model):
@@ -569,8 +579,14 @@ class GenerationEngine:
         garbage KV scatter lands at the frozen position, which admission
         either overwrites or — for parked slots — drops).
         ``step_model(tokens, cache) -> (logits, stepped)`` is the only
-        thing that differs between the contiguous and paged engines."""
-        keys = jax.random.split(key, self.decode_block)
+        thing that differs between the contiguous and paged engines.
+
+        The PRNG key chains THROUGH the program (split in-trace, next
+        key returned): the host never dispatches a separate
+        random.split between blocks — through the tunnel that was a
+        full extra roundtrip per block."""
+        keys = jax.random.split(key, self.decode_block + 1)
+        next_key = keys[0]
 
         def body(carry, step_key):
             tokens, cache = carry
@@ -582,8 +598,8 @@ class GenerationEngine:
             return (toks, stepped), (toks, lps)
 
         (_, cache), (toks, lps) = jax.lax.scan(body, (last_tokens, cache),
-                                               keys)
-        return toks, lps, cache
+                                               keys[1:])
+        return toks, lps, next_key, cache
 
     def _verify_epilogue(self, logits, window, active, stepped):
         """Shared verify-pass tail: greedy tokens + their logprobs, the
@@ -618,6 +634,7 @@ class GenerationEngine:
         padding lands nowhere), set the cursor, sample the first token."""
         from ..models import paged_llama
 
+        key, sub = jax.random.split(key)  # chained: see _fused_decode_scan
         logits, k, v, _ = llama.prefill_kv(
             params, self.cfg, tokens, jnp.asarray([length]),
             rope_max=self.max_seq, rope_tables=self.rope_tables,
@@ -626,8 +643,8 @@ class GenerationEngine:
         cache = paged_llama.write_prompt_blocks(cache, k, v, blocks, length)
         cache = cache._replace(lengths=cache.lengths.at[slot].set(length))
         last = logits[0, 0]  # [V] at the true prompt end (logit_pos)
-        tok, lp = self._sample(last[None, :], temp[None], key, top_k[None])
-        return tok[0], lp[0], cache
+        tok, lp = self._sample(last[None, :], temp[None], sub, top_k[None])
+        return tok[0], lp[0], key, cache
 
     def _paged_verify_fn(self, cache, params, window, active, key, table,
                          adapter=None):
@@ -848,8 +865,8 @@ class GenerationEngine:
                 for b in self.prompt_buckets:
                     toks = jnp.zeros((1, b), jnp.int32)
                     if paged_chunks:
-                        _, _, self._scratch = jax.block_until_ready(
-                            self._chunk_final_jit(
+                        _, _, self._key, self._scratch = \
+                            jax.block_until_ready(self._chunk_final_jit(
                                 self._scratch, self.params, toks,
                                 jnp.int32(0), jnp.int32(0), jnp.int32(1),
                                 jnp.int32(0), jnp.float32(0.0),
@@ -860,14 +877,14 @@ class GenerationEngine:
                         # 0); the cursor restore below undoes lengths
                         zeros = jnp.zeros((-(-b // self._block_t),),
                                           jnp.int32)
-                        _, _, self.cache = jax.block_until_ready(
+                        _, _, self._key, self.cache = jax.block_until_ready(
                             self._prefill_jit(
                                 self.cache, self.params, toks, jnp.int32(1),
                                 zeros, jnp.int32(free), jnp.float32(0.0),
                                 jnp.int32(0), self._key,
                                 self._adapter1(None)))
                     else:
-                        _, _, self.cache = jax.block_until_ready(
+                        _, _, self._key, self.cache = jax.block_until_ready(
                             self._prefill_jit(
                                 self.cache, self.params, toks, jnp.int32(1),
                                 jnp.int32(free), jnp.float32(0.0),
@@ -876,7 +893,7 @@ class GenerationEngine:
                     if chunked_reachable:
                         # chunked-admission lattice: the final chunk
                         # compiles per bucket, mid chunks only at C
-                        _, _, self.cache = jax.block_until_ready(
+                        _, _, self._key, self.cache = jax.block_until_ready(
                             self._chunk_final_jit(
                                 self.cache, self.params, toks, jnp.int32(0),
                                 jnp.int32(free), jnp.int32(1), jnp.int32(0),
@@ -915,18 +932,22 @@ class GenerationEngine:
                 # its clamped row redirect the dummy write INTO its last
                 # live block (offset 0 = position cursor-T); with zeros
                 # every garbage write lands in the trash block
-                _, _, self.cache = jax.block_until_ready(self._step_jit(
-                    self.cache, self.params, jnp.asarray(self._last_tokens),
-                    jnp.zeros((self.n_slots,), bool),
-                    jnp.asarray(self._temps), jnp.asarray(self._top_ks),
-                    self._key, jnp.zeros_like(jnp.asarray(self._table)),
-                    self._adapters()))
+                _, _, self._key, self.cache = jax.block_until_ready(
+                    self._step_jit(
+                        self.cache, self.params,
+                        jnp.asarray(self._last_tokens),
+                        jnp.zeros((self.n_slots,), bool),
+                        jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                        self._key, jnp.zeros_like(jnp.asarray(self._table)),
+                        self._adapters()))
             else:
-                _, _, self.cache = jax.block_until_ready(self._step_jit(
-                    self.cache, self.params, jnp.asarray(self._last_tokens),
-                    jnp.zeros((self.n_slots,), bool),
-                    jnp.asarray(self._temps), jnp.asarray(self._top_ks),
-                    self._key, self._adapters()))
+                _, _, self._key, self.cache = jax.block_until_ready(
+                    self._step_jit(
+                        self.cache, self.params,
+                        jnp.asarray(self._last_tokens),
+                        jnp.zeros((self.n_slots,), bool),
+                        jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                        self._key, self._adapters()))
             if self._spec_k:
                 # the verify program too — its first real tick would
                 # otherwise compile mid-serving under the device lock,
@@ -1026,20 +1047,32 @@ class GenerationEngine:
             req.stream._q.put(None)
 
     # -- the serving loop ----------------------------------------------------
+    def _dev(self, name: str, host):
+        """Device mirror of a host-owned dispatch array. These arrays
+        (active mask, temps, top-ks, adapters, block table) change only
+        at admission/retirement; re-uploading them every block cost a
+        handful of h2d transfers per dispatch — real milliseconds
+        through the tunnel. Mutation sites mark them dirty (_touch)."""
+        if name in self._dirty or name not in self._mirror:
+            self._mirror[name] = jnp.asarray(host)
+            self._dirty.discard(name)
+        return self._mirror[name]
+
+    def _touch(self, *names: str) -> None:
+        self._dirty.update(names)
+
     def _adapters(self):
         """[B] adapter ids for batch dispatches, or None when LoRA is
         off (None is an empty pytree: the jit signature stays stable
         and the model paths skip the gather entirely)."""
-        return jnp.asarray(self._slot_adapter) if self._n_adapters else None
+        if not self._n_adapters:
+            return None
+        return self._dev("adapters", self._slot_adapter)
 
     def _adapter1(self, req: "_Request | None"):
         if not self._n_adapters:
             return None
         return jnp.asarray([0 if req is None else req.adapter], jnp.int32)
-
-    def _next_key(self):
-        self._key, sub = jax.random.split(self._key)
-        return sub
 
     def _admit(self, defer_lattice: bool = False) -> int:
         """Admit pending requests into free slots; returns the number
@@ -1160,15 +1193,16 @@ class GenerationEngine:
         L = len(req.prompt)
         C = self.prompt_buckets[-1]
         self._slot_adapter[idx] = req.adapter
+        self._touch("adapters")
         pos = self._prefix_restore(idx, req, L, C)
         if pos == 0 and L <= C:
             Sb = pad_bucket(L, self.prompt_buckets)
             padded = np.zeros((1, Sb), np.int32)
             padded[0, :L] = req.prompt
-            tok, lp, self.cache = self._prefill_jit(
+            tok, lp, self._key, self.cache = self._prefill_jit(
                 self.cache, self.params, jnp.asarray(padded), jnp.int32(L),
                 jnp.int32(idx), jnp.float32(req.temperature),
-                jnp.int32(req.top_k), self._next_key(),
+                jnp.int32(req.top_k), self._key,
                 self._adapter1(req))
             return int(tok), float(lp)
         return self._chunk_lattice("cache", idx, req, pos)
@@ -1217,11 +1251,11 @@ class GenerationEngine:
         rem = L - pos
         Sb = pad_bucket(rem, self.prompt_buckets)
         final = req.prompt[L - Sb:]
-        tok, lp, new_cache = self._chunk_final_jit(
+        tok, lp, self._key, new_cache = self._chunk_final_jit(
             getattr(self, attr), self.params, jnp.asarray(final[None, :]),
             jnp.int32(L - Sb), jnp.int32(slot), jnp.int32(L),
             jnp.int32(Sb - 1), jnp.float32(req.temperature),
-            jnp.int32(req.top_k), self._next_key(), self._adapter1(req))
+            jnp.int32(req.top_k), self._key, self._adapter1(req))
         setattr(self, attr, new_cache)
         return int(tok), float(lp)
 
@@ -1243,6 +1277,7 @@ class GenerationEngine:
         C = self.prompt_buckets[-1]
         blocks = shared + fresh
         self._slot_adapter[idx] = req.adapter
+        self._touch("adapters")
         # Register the blocks as the slot's FIRST — every exit path
         # (cancel mid-lattice included) then frees them through the
         # normal _retire, instead of leaking pool blocks the allocator
@@ -1263,11 +1298,11 @@ class GenerationEngine:
             write_blocks = blocks + [0] * (n_wr - len(blocks))
             padded = np.zeros((1, Sb), np.int32)
             padded[0, :L] = req.prompt
-            tok, lp, self.cache = self._prefill_jit(
+            tok, lp, self._key, self.cache = self._prefill_jit(
                 self.cache, self.params, jnp.asarray(padded), jnp.int32(L),
                 jnp.asarray(write_blocks, jnp.int32), jnp.int32(idx),
                 jnp.float32(req.temperature), jnp.int32(req.top_k),
-                self._next_key(), self._adapter1(req))
+                self._key, self._adapter1(req))
             self._write_table_row(idx)
             return int(tok), float(lp)
         if m > 0:
@@ -1297,6 +1332,7 @@ class GenerationEngine:
         trash block. Slice-assigned — this runs on the GIL-held serving
         loop."""
         blocks = self._slot_blocks[idx]
+        self._touch("table")
         if not blocks:
             self._table[idx, :] = 0
             return
@@ -1427,6 +1463,7 @@ class GenerationEngine:
                 self._slot_blocks[idx] = []
                 self._table[idx, :] = 0
                 self._cursors[idx] = 0
+                self._touch("table")
                 self._alloc.free(shared + fresh)
             req.stream._q.put(GenerationError(f"prefill failed: {e!r}"))
             req.stream._q.put(None)
@@ -1444,12 +1481,14 @@ class GenerationEngine:
         self.total_requests += 1
         self._temps[idx] = req.temperature
         self._top_ks[idx] = req.top_k
+        self._touch("temps", "top_ks")
         if self._spec_k:
             self._hist_append(idx, int(first))
         self._deliver(idx, slot, first, first_lp)
         if slot.request is not None:  # not finished by the first token
             self._last_tokens[idx] = first
             self._active[idx] = True
+            self._touch("active")
 
     def _deliver(self, idx: int, slot: _Slot, token: int,
                  lp: float | None = None) -> None:
@@ -1483,6 +1522,7 @@ class GenerationEngine:
         self._temps[idx] = 0.0
         self._top_ks[idx] = 0
         self._slot_adapter[idx] = 0
+        self._touch("active", "temps", "top_ks", "adapters")
         if self._paged:
             # freed blocks may be re-issued immediately; the retired
             # slot's frozen-cursor garbage writes go to the trash block
@@ -1492,6 +1532,7 @@ class GenerationEngine:
                 self._slot_blocks[idx] = []
             self._table[idx, :] = 0
             self._cursors[idx] = 0
+            self._touch("table")
 
     def _loop(self) -> None:
         while not self._closed:
@@ -1528,6 +1569,9 @@ class GenerationEngine:
                 # health reports it instead of serving a bricked cache.
                 try:
                     with self._device_lock:
+                        # device-mirror buffers may have died with the
+                        # failed dispatch — rebuild them all on next use
+                        self._mirror.clear()
                         if self._paged:
                             from ..models.paged_llama import init_paged_cache
 
@@ -1662,18 +1706,20 @@ class GenerationEngine:
         for idx, d in drafts.items():
             if d is not None:
                 window[idx, 1:] = d
+        # the verify pass is greedy-only: the key argument is unused, so
+        # pass the live key as-is — no split dispatch, no chain needed
         if self._paged:
             self._ensure_blocks(W)  # window rows span up to W positions
             if not self._active.any():
                 return None
             toks, lps, emit, self.cache = self._verify_jit(
                 self.cache, self.params, jnp.asarray(window),
-                jnp.asarray(self._active), self._next_key(),
-                jnp.asarray(self._table), self._adapters())
+                self._dev("active", self._active), self._key,
+                self._dev("table", self._table), self._adapters())
         else:
             toks, lps, emit, self.cache = self._verify_jit(
                 self.cache, self.params, jnp.asarray(window),
-                jnp.asarray(self._active), self._next_key(),
+                self._dev("active", self._active), self._key,
                 self._adapters())
         # Dispatch-time snapshots: in-flight admissions mutate _active /
         # slot.request before the reap runs, and this window's tokens
@@ -1719,17 +1765,19 @@ class GenerationEngine:
             self._ensure_blocks()  # may retire starving slots
             if not self._active.any():
                 return None
-            toks, lps, self.cache = self._step_jit(
+            toks, lps, self._key, self.cache = self._step_jit(
                 self.cache, self.params, jnp.asarray(self._last_tokens),
-                jnp.asarray(self._active), jnp.asarray(self._temps),
-                jnp.asarray(self._top_ks), self._next_key(),
-                jnp.asarray(self._table), self._adapters())
+                self._dev("active", self._active),
+                self._dev("temps", self._temps),
+                self._dev("top_ks", self._top_ks), self._key,
+                self._dev("table", self._table), self._adapters())
             self._cursors[self._active] += self.decode_block
         else:
-            toks, lps, self.cache = self._step_jit(
+            toks, lps, self._key, self.cache = self._step_jit(
                 self.cache, self.params, jnp.asarray(self._last_tokens),
-                jnp.asarray(self._active), jnp.asarray(self._temps),
-                jnp.asarray(self._top_ks), self._next_key(),
+                self._dev("active", self._active),
+                self._dev("temps", self._temps),
+                self._dev("top_ks", self._top_ks), self._key,
                 self._adapters())
         # snapshots: see _verify_tick — this block's tokens belong to
         # the slots as dispatched, not as mutated by in-flight admissions
