@@ -137,6 +137,48 @@ def test_soak_paged_engine_under_block_churn():
         eng.close()
 
 
+def test_soak_greedy_determinism_under_load():
+    """Greedy serving must be BITWISE deterministic regardless of host
+    timing: the same prompt re-served while CPU-burner threads skew
+    every thread interleaving (in-flight admission polls, reap timing,
+    GIL handoffs) must stream identical tokens. This is the harness
+    that catches host/device state-handoff bugs — a device-carried
+    token-vector optimization produced rare order-dependent divergence
+    EXACTLY here (r4, reverted): failures only appeared under parallel
+    load, never in isolation."""
+    params = llama.init(TINY, jax.random.PRNGKey(1))
+    eng = GenerationEngine(TINY, params, slots=3, max_seq=64,
+                           prompt_buckets=(8, 16), decode_block=2,
+                           spec_decode_k=2)
+    rng = np.random.default_rng(5)
+    prompts = [[7, 9] * 5,                                     # spec hits
+               rng.integers(1, TINY.vocab_size, 11).tolist(),
+               rng.integers(1, TINY.vocab_size, 4).tolist()]
+    stop = threading.Event()
+
+    def burn():
+        x = 1.0
+        while not stop.is_set():
+            x = (x * 1.0000001) % 97.0
+
+    burners = [threading.Thread(target=burn, daemon=True)
+               for _ in range(4)]
+    try:
+        oracle = {tuple(p): eng.generate(p, max_new_tokens=12).tokens()
+                  for p in prompts}
+        for b in burners:
+            b.start()
+        for rep in range(6):
+            streams = [eng.generate(p, max_new_tokens=12) for p in prompts]
+            for p, s in zip(prompts, streams):
+                got = s.tokens()
+                assert got == oracle[tuple(p)], \
+                    f"rep {rep}: divergence for prompt {p[:4]}..."
+    finally:
+        stop.set()
+        eng.close()
+
+
 def test_soak_paged_all_features_composed():
     """Everything on at once over one paged engine: zero-copy prefix
     sharing, speculative decoding, long-prompt scratch admission, and
