@@ -325,6 +325,8 @@ class GenerationEngine:
         # device mirrors of host-owned dispatch arrays (see _dev)
         self._mirror: dict[str, Any] = {}
         self._dirty: set[str] = set()
+        self._last_dev = None
+        self._host_wins = np.ones((slots,), bool)
 
         # Prefix KV cache (tpu/prefix_cache.py): a P-row pool of stored
         # prompt-prefix KV. A hit replaces MXU prefill work for the
@@ -407,7 +409,8 @@ class GenerationEngine:
                                         out_shardings=(rep, rep, rep,
                                                        cache_sh))
             self._step_jit = jax.jit(self._step_fn, donate_argnums=(0,),
-                                     out_shardings=(rep, rep, rep, cache_sh))
+                                     out_shardings=(rep, rep, rep, rep,
+                                                    cache_sh))
             self._chunk_mid_jit = jax.jit(self._chunk_mid, donate_argnums=(0,),
                                           out_shardings=cache_sh)
             self._chunk_final_jit = jax.jit(self._chunk_final,
@@ -585,6 +588,8 @@ class GenerationEngine:
         key returned): the host never dispatches a separate
         random.split between blocks — through the tunnel that was a
         full extra roundtrip per block."""
+        host_tokens, host_wins, carry0 = last_tokens
+        tokens0 = jnp.where(host_wins, host_tokens, carry0)
         keys = jax.random.split(key, self.decode_block + 1)
         next_key = keys[0]
 
@@ -597,9 +602,9 @@ class GenerationEngine:
             toks = jnp.where(active, toks, tokens)
             return (toks, stepped), (toks, lps)
 
-        (_, cache), (toks, lps) = jax.lax.scan(body, (last_tokens, cache),
-                                               keys[1:])
-        return toks, lps, next_key, cache
+        (last, cache), (toks, lps) = jax.lax.scan(body, (tokens0, cache),
+                                                  keys[1:])
+        return toks, lps, last, next_key, cache
 
     def _verify_epilogue(self, logits, window, active, stepped):
         """Shared verify-pass tail: greedy tokens + their logprobs, the
@@ -932,19 +937,17 @@ class GenerationEngine:
                 # its clamped row redirect the dummy write INTO its last
                 # live block (offset 0 = position cursor-T); with zeros
                 # every garbage write lands in the trash block
-                _, _, self._key, self.cache = jax.block_until_ready(
+                _, _, _, self._key, self.cache = jax.block_until_ready(
                     self._step_jit(
-                        self.cache, self.params,
-                        jnp.asarray(self._last_tokens),
+                        self.cache, self.params, self._warm_last3(),
                         jnp.zeros((self.n_slots,), bool),
                         jnp.asarray(self._temps), jnp.asarray(self._top_ks),
                         self._key, jnp.zeros_like(jnp.asarray(self._table)),
                         self._adapters()))
             else:
-                _, _, self._key, self.cache = jax.block_until_ready(
+                _, _, _, self._key, self.cache = jax.block_until_ready(
                     self._step_jit(
-                        self.cache, self.params,
-                        jnp.asarray(self._last_tokens),
+                        self.cache, self.params, self._warm_last3(),
                         jnp.zeros((self.n_slots,), bool),
                         jnp.asarray(self._temps), jnp.asarray(self._top_ks),
                         self._key, self._adapters()))
@@ -1047,14 +1050,25 @@ class GenerationEngine:
             req.stream._q.put(None)
 
     # -- the serving loop ----------------------------------------------------
+    def _warm_last3(self):
+        host = jnp.asarray(self._last_tokens)
+        return (host, jnp.ones((self.n_slots,), bool), host)
+
     def _dev(self, name: str, host):
         """Device mirror of a host-owned dispatch array. These arrays
         (active mask, temps, top-ks, adapters, block table) change only
         at admission/retirement; re-uploading them every block cost a
         handful of h2d transfers per dispatch — real milliseconds
-        through the tunnel. Mutation sites mark them dirty (_touch)."""
+        through the tunnel. Mutation sites mark them dirty (_touch).
+
+        The np source is COPIED before device conversion: on the CPU
+        backend jnp.asarray ALIASES numpy memory zero-copy, and
+        dispatches are async — a host mutation (in-flight admission,
+        post-dispatch bookkeeping) would otherwise be read by the
+        still-executing block. That aliasing was the r4 token-carry
+        flake's root cause."""
         if name in self._dirty or name not in self._mirror:
-            self._mirror[name] = jnp.asarray(host)
+            self._mirror[name] = jnp.asarray(np.array(host))
             self._dirty.discard(name)
         return self._mirror[name]
 
@@ -1488,7 +1502,8 @@ class GenerationEngine:
         if slot.request is not None:  # not finished by the first token
             self._last_tokens[idx] = first
             self._active[idx] = True
-            self._touch("active")
+            self._host_wins[idx] = True
+            self._touch("active", "last_tokens", "host_wins")
 
     def _deliver(self, idx: int, slot: _Slot, token: int,
                  lp: float | None = None) -> None:
@@ -1572,6 +1587,8 @@ class GenerationEngine:
                         # device-mirror buffers may have died with the
                         # failed dispatch — rebuild them all on next use
                         self._mirror.clear()
+                        self._last_dev = None
+                        self._host_wins[:] = True
                         if self._paged:
                             from ..models.paged_llama import init_paged_cache
 
@@ -1752,6 +1769,8 @@ class GenerationEngine:
                 self._last_tokens[idx] = t
                 self._hist_append(idx, t)
                 self._deliver(idx, slot, t, lps_l[idx][k])
+        self._host_wins |= snap_active
+        self._touch("last_tokens", "host_wins")
 
     def _decode_tick(self) -> "_Inflight | None":
         """Dispatch one fused decode block; the reap fetches [K, B]
@@ -1765,20 +1784,31 @@ class GenerationEngine:
             self._ensure_blocks()  # may retire starving slots
             if not self._active.any():
                 return None
-            toks, lps, self._key, self.cache = self._step_jit(
-                self.cache, self.params, jnp.asarray(self._last_tokens),
-                self._dev("active", self._active),
-                self._dev("temps", self._temps),
-                self._dev("top_ks", self._top_ks), self._key,
-                self._dev("table", self._table), self._adapters())
+        if self._last_dev is None:  # first block / post-recovery;
+            # np.array copy: see _dev's aliasing note
+            self._last_dev = jnp.asarray(np.array(self._last_tokens))
+        last3 = (self._dev("last_tokens", self._last_tokens),
+                 self._dev("host_wins", self._host_wins), self._last_dev)
+        if self._paged:
+            toks, lps, self._last_dev, self._key, self.cache = \
+                self._step_jit(
+                    self.cache, self.params, last3,
+                    self._dev("active", self._active),
+                    self._dev("temps", self._temps),
+                    self._dev("top_ks", self._top_ks), self._key,
+                    self._dev("table", self._table), self._adapters())
             self._cursors[self._active] += self.decode_block
         else:
-            toks, lps, self._key, self.cache = self._step_jit(
-                self.cache, self.params, jnp.asarray(self._last_tokens),
-                self._dev("active", self._active),
-                self._dev("temps", self._temps),
-                self._dev("top_ks", self._top_ks), self._key,
-                self._adapters())
+            toks, lps, self._last_dev, self._key, self.cache = \
+                self._step_jit(
+                    self.cache, self.params, last3,
+                    self._dev("active", self._active),
+                    self._dev("temps", self._temps),
+                    self._dev("top_ks", self._top_ks), self._key,
+                    self._adapters())
+        if self._host_wins.any():
+            self._host_wins[:] = False
+            self._touch("host_wins")
         # snapshots: see _verify_tick — this block's tokens belong to
         # the slots as dispatched, not as mutated by in-flight admissions
         snap_active = self._active.copy()
