@@ -321,7 +321,11 @@ class GenerationEngine:
         self._active = np.zeros((slots,), bool)
         self._temps = np.zeros((slots,), np.float32)
         self._top_ks = np.zeros((slots,), np.int32)
+        self._seed = int(seed)  # recovery reseeds the chained key
+        self._recoveries = 0
         self._key = jax.random.PRNGKey(seed)
+        self._rep_sh = None   # mesh: replicated sharding (set below)
+        self._pool_sh = None  # mesh: prefix-pool sharding (set below)
         # device mirrors of host-owned dispatch arrays (see _dev)
         self._mirror: dict[str, Any] = {}
         self._dirty: set[str] = set()
@@ -401,6 +405,14 @@ class GenerationEngine:
             self._cache_sh = cache_sh
             self.cache = jax.device_put(self.cache, cache_sh)
             rep = replicated(mesh)
+            self._rep_sh = rep
+            # commit the seed key to the replicated sharding NOW: the
+            # chained key outputs are rep-committed, and a first
+            # dispatch with an UNCOMMITTED key would occupy a different
+            # jit cache entry than every later one — warming one
+            # signature and serving the other re-lowers the program
+            # mid-serving under the device lock
+            self._key = jax.device_put(self._key, rep)
             # outputs: (token, logprob, next_key, cache) for prefill/
             # final-chunk, (tokens, logprobs, next_key, cache) for the
             # fused step — the PRNG key chains through every sampling
@@ -422,6 +434,7 @@ class GenerationEngine:
                 # data axes when they divide, KV heads over tp); pinning
                 # out_shardings keeps donation aliasing across copies
                 pool_sh = kv_cache_specs(mesh, self._pool)
+                self._pool_sh = pool_sh
                 self._pool = jax.device_put(self._pool, pool_sh)
                 self._pool_load_jit = jax.jit(_copy_row_masked,
                                               donate_argnums=(0,),
@@ -937,17 +950,40 @@ class GenerationEngine:
                 # its clamped row redirect the dummy write INTO its last
                 # live block (offset 0 = position cursor-T); with zeros
                 # every garbage write lands in the trash block
-                _, _, _, self._key, self.cache = jax.block_until_ready(
-                    self._step_jit(
+                # two calls: the first covers the host-built carry
+                # signature (first live block, _last_dev=None); the
+                # second feeds the returned carry + chained key back —
+                # the STEADY-STATE signature, whose inputs are
+                # jit-output-committed (mesh: rep-sharded). Warming only
+                # one would re-lower the big fused scan mid-serving.
+                _, _, carry_w, self._key, self.cache = \
+                    jax.block_until_ready(self._step_jit(
                         self.cache, self.params, self._warm_last3(),
                         jnp.zeros((self.n_slots,), bool),
                         jnp.asarray(self._temps), jnp.asarray(self._top_ks),
                         self._key, jnp.zeros_like(jnp.asarray(self._table)),
                         self._adapters()))
-            else:
                 _, _, _, self._key, self.cache = jax.block_until_ready(
                     self._step_jit(
+                        self.cache, self.params,
+                        (jnp.asarray(np.array(self._last_tokens)),
+                         jnp.zeros((self.n_slots,), bool), carry_w),
+                        jnp.zeros((self.n_slots,), bool),
+                        jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                        self._key, jnp.zeros_like(jnp.asarray(self._table)),
+                        self._adapters()))
+            else:
+                _, _, carry_w, self._key, self.cache = \
+                    jax.block_until_ready(self._step_jit(
                         self.cache, self.params, self._warm_last3(),
+                        jnp.zeros((self.n_slots,), bool),
+                        jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                        self._key, self._adapters()))
+                _, _, _, self._key, self.cache = jax.block_until_ready(
+                    self._step_jit(
+                        self.cache, self.params,
+                        (jnp.asarray(np.array(self._last_tokens)),
+                         jnp.zeros((self.n_slots,), bool), carry_w),
                         jnp.zeros((self.n_slots,), bool),
                         jnp.asarray(self._temps), jnp.asarray(self._top_ks),
                         self._key, self._adapters()))
@@ -1589,6 +1625,30 @@ class GenerationEngine:
                         self._mirror.clear()
                         self._last_dev = None
                         self._host_wins[:] = True
+                        # the PRNG key chains THROUGH dispatches now: an
+                        # async failure leaves self._key bound to the
+                        # failed computation's error-state output, and
+                        # every later program would consume it and
+                        # re-raise forever — reseed from the host,
+                        # salted so recoveries don't replay the stream
+                        self._recoveries += 1
+                        self._key = jax.random.PRNGKey(
+                            self._seed + self._recoveries)
+                        if self._rep_sh is not None:
+                            self._key = jax.device_put(self._key,
+                                                       self._rep_sh)
+                        if self._pool is not None:
+                            # _pool_store_jit donates the pool buffer —
+                            # a failed store leaves it consumed/poisoned
+                            # — and its stored keys would match prompts
+                            # against the fresh zeroed rows
+                            pool = llama.init_cache(
+                                self.cfg, self._prefix_idx.slots,
+                                self.max_seq, dtype=self._kv_dtype)
+                            if self._pool_sh is not None:
+                                pool = jax.device_put(pool, self._pool_sh)
+                            self._pool = jax.block_until_ready(pool)
+                            self._prefix_idx.clear()
                         if self._paged:
                             from ..models.paged_llama import init_paged_cache
 

@@ -103,6 +103,17 @@ class PrefixIndex:
         self._used[victim] = self._tick
         return victim
 
+    def clear(self) -> int:
+        """Drop every entry. Engine recovery calls this after
+        reallocating the side pool: stored keys would otherwise match
+        prompts against rows of the NEW (zeroed) pool and restore
+        all-zero KV."""
+        n = len(self)
+        self._keys = [None] * self.slots
+        self._adapter = [0] * self.slots
+        self._used = [0] * self.slots
+        return n
+
     def invalidate_adapter(self, adapter: int) -> int:
         """Drop every entry stored under ``adapter`` — required when its
         LoRA weights are hot-swapped (the stored KV was computed through

@@ -424,6 +424,41 @@ def test_generation_loop_recovers_after_device_failure(tiny_llama):
         eng.close()
 
 
+def test_recovery_clears_prefix_pool_and_keeps_serving(tiny_llama):
+    """Device-failure recovery with a prefix cache enabled: the side
+    pool is reallocated (a failed store leaves the donated buffer
+    consumed) and the index cleared — stored entries would otherwise
+    restore all-zero KV from the fresh pool. The engine must keep
+    serving EXACT tokens afterwards, and the old prefix must miss."""
+    eng = GenerationEngine(TINY, tiny_llama, slots=2, max_seq=32,
+                           prompt_buckets=(8,), prefix_cache_slots=2,
+                           prefix_store_min=8)
+    try:
+        prefix = [3, 1, 4, 1, 5, 9, 2, 6]
+        want = eng.generate(prefix + [8, 8], max_new_tokens=4).tokens()
+        assert len(eng._prefix_idx) == 1  # stored
+        real = eng._step_jit
+        state = {"fired": False}
+
+        def flaky(*a, **k):
+            if not state["fired"]:
+                state["fired"] = True
+                raise RuntimeError("injected device failure")
+            return real(*a, **k)
+
+        eng._step_jit = flaky
+        with pytest.raises(GenerationError):
+            eng.generate([1, 2, 3], max_new_tokens=4).tokens()
+        assert eng.down is None
+        assert len(eng._prefix_idx) == 0  # cleared with the pool
+        hits_before = eng._prefix_idx.hits
+        got = eng.generate(prefix + [8, 8], max_new_tokens=4).tokens()
+        assert got == want  # full recompute, exact tokens
+        assert eng._prefix_idx.hits == hits_before  # no zero-KV hit
+    finally:
+        eng.close()
+
+
 def test_generation_engine_down_when_recovery_fails(tiny_llama, monkeypatch):
     eng = GenerationEngine(TINY, tiny_llama, slots=2, max_seq=32,
                            prompt_buckets=(8,))
