@@ -137,7 +137,7 @@ class GenStream:
 
 class _Request:
     __slots__ = ("stream", "prompt", "max_new", "temperature", "top_k",
-                 "eos_id", "adapter", "enqueued_at")
+                 "eos_id", "adapter", "enqueued_at", "lattice_peek")
 
     @property
     def logprobs(self) -> bool:
@@ -154,6 +154,7 @@ class _Request:
         self.eos_id = eos_id
         self.adapter = adapter
         self.enqueued_at = time.monotonic()
+        self.lattice_peek: tuple[int, bool] | None = None
 
 
 class _Inflight:
@@ -1180,14 +1181,23 @@ class GenerationEngine:
         hits (a hit resumes the lattice from the match point).
         SharedPrefixIndex.match is pure — hit/miss accounting happens
         in accept()/reject() at real admission — so peeking here costs
-        one LCP scan and perturbs nothing."""
+        one LCP scan and perturbs nothing. The verdict is memoized on
+        the request, keyed by the index's version counter: the in-flight
+        admission path re-peeks the queue head every ~2 ms poll, and an
+        O(entries x prompt) LCP rescan of an unchanged index on the
+        serving-loop thread is pure waste."""
         L = len(req.prompt)
         if L > self.prompt_buckets[-1]:
             return True
         if self._paged and self._prefix_idx is not None:
+            ver = self._prefix_idx.version
+            if req.lattice_peek is not None and req.lattice_peek[0] == ver:
+                return req.lattice_peek[1]
             _, m = self._prefix_idx.match(
                 np.asarray(req.prompt, np.int32), req.adapter)
-            return bool(m) and self._lattice_resume_valid(L, m)
+            verdict = bool(m) and self._lattice_resume_valid(L, m)
+            req.lattice_peek = (ver, verdict)
+            return verdict
         return False
 
     def _paged_admission_blocks(self, req: _Request
@@ -1609,29 +1619,44 @@ class GenerationEngine:
                     self.logger.error({"event": "generation loop failed",
                                        "error": repr(e)})
                 err = GenerationError(f"generation failed: {e!r}")
+                # A failed prefill/step may have consumed the DONATED cache
+                # buffer; continuing would serve every later request an
+                # opaque "donated buffer" error. Recovery runs in three
+                # phases, ordered so consumers neither observe stale
+                # state NOR hang behind device work:
+                #   1. host-side invariants (mirrors, PRNG epoch, prefix
+                #      index) — pure Python, cannot hang;
+                #   2. error delivery — waiters fail fast with every
+                #      host-observable invariant already consistent;
+                #   3. device reallocation — may block indefinitely on a
+                #      WEDGED device, which is exactly why it runs after
+                #      delivery. No admission can race it: only this
+                #      loop thread admits, and it is here.
+                with self._device_lock:
+                    # device-mirror buffers may have died with the
+                    # failed dispatch — rebuild them all on next use
+                    self._mirror.clear()
+                    self._last_dev = None
+                    self._host_wins[:] = True
+                    self._recoveries += 1
+                    if self._prefix_idx is not None:
+                        # pool-branch entries would match prompts against
+                        # the fresh zeroed rows; paged entries reference
+                        # blocks of the OLD pool and would restore
+                        # all-zero KV on a hit
+                        self._prefix_idx.clear()
                 for idx, slot in enumerate(self._slots):
                     if slot.request is not None:
                         slot.request.stream._q.put(err)
                         self._retire(idx, slot)
-                # A failed prefill/step may have consumed the DONATED cache
-                # buffer; continuing would serve every later request an
-                # opaque "donated buffer" error. Reallocate the cache to
-                # recover; if even that fails, mark the engine DOWN so
-                # health reports it instead of serving a bricked cache.
                 try:
                     with self._device_lock:
-                        # device-mirror buffers may have died with the
-                        # failed dispatch — rebuild them all on next use
-                        self._mirror.clear()
-                        self._last_dev = None
-                        self._host_wins[:] = True
                         # the PRNG key chains THROUGH dispatches now: an
                         # async failure leaves self._key bound to the
                         # failed computation's error-state output, and
                         # every later program would consume it and
                         # re-raise forever — reseed from the host,
                         # salted so recoveries don't replay the stream
-                        self._recoveries += 1
                         self._key = jax.random.PRNGKey(
                             self._seed + self._recoveries)
                         if self._rep_sh is not None:
@@ -1640,15 +1665,12 @@ class GenerationEngine:
                         if self._pool is not None:
                             # _pool_store_jit donates the pool buffer —
                             # a failed store leaves it consumed/poisoned
-                            # — and its stored keys would match prompts
-                            # against the fresh zeroed rows
                             pool = llama.init_cache(
                                 self.cfg, self._prefix_idx.slots,
                                 self.max_seq, dtype=self._kv_dtype)
                             if self._pool_sh is not None:
                                 pool = jax.device_put(pool, self._pool_sh)
                             self._pool = jax.block_until_ready(pool)
-                            self._prefix_idx.clear()
                         if self._paged:
                             from ..models.paged_llama import init_paged_cache
 
@@ -1656,11 +1678,6 @@ class GenerationEngine:
                                 self.cfg, self.n_slots,
                                 self._alloc.n_blocks, self._block_t,
                                 dtype=self._kv_dtype)
-                            if self._prefix_idx is not None:
-                                # stored entries reference blocks of the
-                                # OLD pool; through the fresh one they
-                                # would restore all-zero KV on a hit
-                                self._prefix_idx.clear()
                             if hasattr(self, "_scratch"):
                                 # the chunk jits donate the scratch row
                                 # too — a failed chunk dispatch leaves it
@@ -1686,6 +1703,7 @@ class GenerationEngine:
                     if self.logger is not None:
                         self.logger.error({"event": "generation engine down",
                                            "error": self.down})
+                if self.down is not None:
                     # fail queued requests too — their consumers block on
                     # the stream and no later iteration will admit them
                     down_err = GenerationError(
