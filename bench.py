@@ -780,8 +780,15 @@ def run_section(args) -> None:
         elif args.section == "spec":
             emit(bench_spec_decode(cfg))
         elif args.section == "paged":
+            # live_len matches the contiguous sweep's half-full point
+            # (cache_len//2 = 512) so the promoted headline compares the
+            # two configs on identical KV workloads — with the v3
+            # DMA-skip, attention cost tracks live length, so a lighter
+            # paged workload would flatter the pool. Same pool size
+            # either way: ceil((512+72)/128) = ceil((448+72)/128) = 5
+            # blocks/slot.
             emit(bench_paged_decode(cfg, batch=args.paged_batch,
-                                    live_len=448))
+                                    live_len=512))
         elif args.section == "paged_engine":
             # full serving stack over the paged pool at the slot count
             # the raw sweep proved (--slots). Pool sizing: a stream's

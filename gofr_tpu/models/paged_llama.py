@@ -25,10 +25,12 @@ Table invariants (maintained by the engine's allocator):
   - block 0 is a reserved trash block no slot ever owns: retired slots'
     frozen-cursor garbage writes land there.
 
-Reference provenance: the reference serves via torch/CUDA allocators
-with pointer indirection; this is the TPU-native equivalent (SURVEY.md
-§2 TPU serving rows; design cross-checked against the public
-PagedAttention idea, rebuilt for static shapes + Mosaic).
+Reference provenance: the reference (GoFr) is a pure-Go microservice
+framework with zero ML code — paged serving has NO reference
+counterpart. This module implements the TPU-inference rows SURVEY.md §2
+adds to the inventory (the "to build — native" rows); the design is
+cross-checked against the public PagedAttention idea, rebuilt for
+static shapes + Mosaic.
 """
 
 from __future__ import annotations
@@ -412,6 +414,11 @@ class SharedPrefixIndex:
         self._tick = 0
         self.hits = 0
         self.misses = 0
+        # bumped on every mutation that can change a match() outcome —
+        # lets callers memoize peek results (the serving loop polls
+        # _needs_lattice every ~2 ms while a request heads the queue;
+        # re-scanning an unchanged index is pure waste)
+        self.version = 0
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -480,6 +487,7 @@ class SharedPrefixIndex:
         held = list(blocks[:n_full])
         self._alloc.ref(held)
         self._tick += 1
+        self.version += 1
         self._entries.append({"key": prompt[:n_full * self._t].copy(),
                               "blocks": held, "adapter": int(adapter),
                               "used": self._tick})
@@ -509,17 +517,20 @@ class SharedPrefixIndex:
             order[0])
         e = self._entries.pop(victim)
         self._alloc.free(e["blocks"])
+        self.version += 1
         return True
 
     def clear(self) -> int:
         """Drop every entry, releasing its block references. Engine
-        recovery calls this after reallocating the pool: stored entries
-        would otherwise keep pointing into the NEW (zeroed) pool and
+        recovery calls this BEFORE reallocating the pool (host-side
+        phase, so waiters never observe a stale index): stored entries
+        would otherwise keep pointing into the fresh zeroed pool and
         silently serve all-zero KV on their next hit."""
         n = len(self._entries)
         for e in self._entries:
             self._alloc.free(e["blocks"])
         self._entries = []
+        self.version += 1
         return n
 
     def invalidate_adapter(self, adapter: int) -> int:
@@ -533,6 +544,8 @@ class SharedPrefixIndex:
             else:
                 keep.append(e)
         self._entries = keep
+        if dropped:
+            self.version += 1
         return dropped
 
     def stats(self) -> dict:

@@ -238,14 +238,49 @@ def flash_decode_appended(q, k_cache, v_cache, k_new, v_new, lengths,
     return out.astype(q.dtype).reshape(b, 1, h, d)
 
 
-def _kernel_ok(q, k_cache, block_s: int) -> bool:
+def _kernel_gate(q, k_cache, block_s: int) -> str | None:
+    """None when the Pallas kernel can run; otherwise the NAME of the
+    first failing gate. Single source of truth for dispatch AND for the
+    GOFR_FLASH_BLOCK_S diagnostics — the warn path must know whether
+    block_s is what disqualified the kernel, and a second copy of this
+    predicate would silently diverge as gates are added."""
     from .flash import tpu_backend_ok
 
-    b, _, h, d = q.shape
+    _, _, h, d = q.shape
     smax, n_kv = k_cache.shape[1], k_cache.shape[2]
-    if d % _LANES or smax % block_s or h % n_kv or smax < block_s:
-        return False
-    return tpu_backend_ok()
+    if d % _LANES:
+        return "head_dim"
+    if h % n_kv:
+        return "gqa_ratio"
+    if not tpu_backend_ok():
+        return "backend"
+    # checked LAST: "block_s" means every gate the env var cannot fix
+    # passed, so the warn path can blame GOFR_FLASH_BLOCK_S truthfully
+    if smax % block_s or smax < block_s:
+        return "block_s"
+    return None
+
+
+def _kernel_ok(q, k_cache, block_s: int) -> bool:
+    return _kernel_gate(q, k_cache, block_s) is None
+
+
+_block_s_warned: set[str] = set()
+
+
+def _warn_block_s_once(kind: str, msg: str) -> None:
+    """Once-per-kind warning when an operator-set GOFR_FLASH_BLOCK_S is
+    ignored or disqualifies the flash kernel — the silent jnp fallback
+    would otherwise make a bad tuning value read as 'flash got slower'.
+    Keyed per diagnostic kind: the env var is re-read every call, so an
+    invalid-value warning must not suppress a later kernel-disabled one
+    (or vice versa) after the operator changes the value."""
+    if kind in _block_s_warned:
+        return
+    _block_s_warned.add(kind)
+    import warnings
+
+    warnings.warn(msg, RuntimeWarning, stacklevel=3)
 
 
 def decode_attention_auto(q, k_cache, v_cache, k_new, v_new, lengths,
@@ -256,16 +291,37 @@ def decode_attention_auto(q, k_cache, v_cache, k_new, v_new, lengths,
     otherwise. Same contract as decode_attention_appended.
     ``block_s`` defaults from GOFR_FLASH_BLOCK_S (128): larger blocks
     amortize per-grid-step overhead, at (block_s/S)-granular DMA skip."""
+    explicit = False
     if block_s is None:
         import os
 
+        raw = os.environ.get("GOFR_FLASH_BLOCK_S")
+        explicit = raw is not None
         try:
-            block_s = int(os.environ.get("GOFR_FLASH_BLOCK_S", "128"))
+            block_s = int(raw) if explicit else 128
         except ValueError:
+            block_s = 0
+        if block_s <= 0:  # 0 would ZeroDivide inside _kernel_gate
+            if explicit:
+                # the set value is unusable and silently becomes the
+                # default — say so, naming what the operator actually set
+                _warn_block_s_once(
+                    "invalid", f"GOFR_FLASH_BLOCK_S={raw!r} is not a "
+                    f"positive integer; using the default block_s=128")
+                explicit = False  # don't blame the env var for 128's gates
             block_s = 128
-        if block_s <= 0:  # 0 would ZeroDivide inside _kernel_ok's gate
-            block_s = 128
-    if interpret or _kernel_ok(q, k_cache, block_s):
+    gate = None if interpret else _kernel_gate(q, k_cache, block_s)
+    if gate == "block_s" and explicit:
+        # every gate the env var cannot fix passed; only the operator's
+        # block size disqualified the kernel
+        smax = k_cache.shape[1]
+        reason = (f"exceeds the cache length {smax}" if smax < block_s
+                  else f"does not divide the cache length {smax}")
+        _warn_block_s_once(
+            "rejected", f"GOFR_FLASH_BLOCK_S={block_s} {reason}; the "
+            f"flash-decode kernel is DISABLED and attention falls "
+            f"back to the jnp reference path")
+    if gate is None:
         return flash_decode_appended(q, k_cache, v_cache, k_new, v_new,
                                      lengths, k_scale, v_scale,
                                      block_s=block_s, interpret=interpret)

@@ -26,10 +26,13 @@ The jnp reference (``paged_attention_reference``) gathers each slot's
 blocks into a dense view and calls the exact reference attention — the
 numerics oracle for interpret-mode tests and the CPU/sharded fallback.
 
-Reference provenance: the reference framework serves its models through
-torch+CUDA paged allocators; this module is the TPU-native equivalent
-(static block lattice + scalar-prefetch index maps instead of pointer
-indirection). See SURVEY.md §2 (TPU serving rows).
+Reference provenance: the reference (GoFr) is a pure-Go microservice
+framework with no ML/serving code at all — this module has NO reference
+counterpart. It implements the TPU-inference rows SURVEY.md §2 adds to
+the component inventory (the "to build — native" rows), with the design
+cross-checked against the public PagedAttention idea, rebuilt for
+static shapes + Mosaic (static block lattice + scalar-prefetch index
+maps instead of pointer indirection).
 """
 
 from __future__ import annotations

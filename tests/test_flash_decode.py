@@ -64,3 +64,54 @@ def test_auto_falls_back_off_tpu():
     want = decode_attention_appended(q, k, v, k_new, v_new, lens, sk, sv)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_block_s_env_rejection_warns_once(monkeypatch):
+    """An operator-set GOFR_FLASH_BLOCK_S that fails _kernel_ok's
+    divisibility gate must emit a one-time warning naming the failed
+    constraint (ADVICE r4) — but ONLY when block_s is the failing gate:
+    off-TPU the kernel is disqualified regardless, so blaming the env
+    var would mislead."""
+    import warnings
+
+    from gofr_tpu.ops import flash_decode as fd
+
+    q, k, v, k_new, v_new, sk, sv = _mk(jax.random.PRNGKey(3), True)
+    lens = jnp.asarray([10, 20, 30], jnp.int32)
+
+    # off-TPU: no warning even with a bad explicit value (backend gate
+    # fails regardless; the env var is not what disables the kernel)
+    monkeypatch.setenv("GOFR_FLASH_BLOCK_S", "100")
+    monkeypatch.setattr(fd, "_block_s_warned", set())
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        decode_attention_auto(q, k, v, k_new, v_new, lens, sk, sv)
+
+    # TPU-would-run case (backend gate forced green): 100 does not
+    # divide S=256 -> exactly one warning naming the constraint
+    import gofr_tpu.ops.flash as flash_mod
+
+    monkeypatch.setattr(flash_mod, "tpu_backend_ok", lambda: True)
+    with pytest.warns(RuntimeWarning, match="does not divide"):
+        decode_attention_auto(q, k, v, k_new, v_new, lens, sk, sv)
+    with warnings.catch_warnings():  # one-time: silent on repeat
+        warnings.simplefilter("error")
+        decode_attention_auto(q, k, v, k_new, v_new, lens, sk, sv)
+
+
+def test_block_s_env_invalid_value_warns(monkeypatch):
+    """A non-positive-integer GOFR_FLASH_BLOCK_S silently becoming the
+    default was the exact 'tuning ignored' failure mode the warning
+    exists for — the coercion itself must warn, naming the raw value."""
+    from gofr_tpu.ops import flash_decode as fd
+
+    q, k, v, k_new, v_new, sk, sv = _mk(jax.random.PRNGKey(4), True)
+    lens = jnp.asarray([10, 20, 30], jnp.int32)
+    monkeypatch.setenv("GOFR_FLASH_BLOCK_S", "abc")
+    monkeypatch.setattr(fd, "_block_s_warned", set())
+    with pytest.warns(RuntimeWarning, match="'abc' is not a positive"):
+        got = decode_attention_auto(q, k, v, k_new, v_new, lens, sk, sv)
+    # and the computation still ran (jnp fallback, default block_s)
+    want = decode_attention_appended(q, k, v, k_new, v_new, lens, sk, sv)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)

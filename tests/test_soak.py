@@ -144,8 +144,11 @@ def test_soak_greedy_determinism_under_load():
     GIL handoffs) must stream identical tokens. This is the harness
     that catches host/device state-handoff bugs — a device-carried
     token-vector optimization produced rare order-dependent divergence
-    EXACTLY here (r4, reverted): failures only appeared under parallel
-    load, never in isolation."""
+    EXACTLY here (r4): failures only appeared under parallel load,
+    never in isolation. Root cause was CPU-backend jnp.asarray aliasing
+    host buffers that mutated while async dispatches were in flight;
+    the carry re-landed with copying device mirrors, and this harness
+    is the regression gate for it."""
     params = llama.init(TINY, jax.random.PRNGKey(1))
     eng = GenerationEngine(TINY, params, slots=3, max_seq=64,
                            prompt_buckets=(8, 16), decode_block=2,
