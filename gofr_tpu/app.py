@@ -36,7 +36,7 @@ from .http.middleware import (
     JWKSKeyProvider,
 )
 from .http.request import Request
-from .http.responder import Responder, ResponseWriter, FileResponse
+from .http.responder import Responder, ResponseWriter
 from .http.router import Router
 from .http.server import HTTPServer
 from .metrics import update_system_metrics

@@ -21,7 +21,7 @@ import dataclasses
 import re
 import threading
 import time
-from typing import Any, Iterable, Sequence
+from typing import Any, Sequence
 
 from . import DSLogger, Health, STATUS_DOWN, STATUS_UP
 

@@ -19,7 +19,7 @@ what token streaming needs (SURVEY §3.3 note).
 from __future__ import annotations
 
 import json
-from typing import Any, Callable, Iterator
+from typing import Any, Callable
 
 # gRPC status codes (subset used by the framework)
 OK = 0

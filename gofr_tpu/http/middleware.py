@@ -107,7 +107,9 @@ def logging_middleware(logger) -> Middleware:
 
 
 def cors_middleware(allowed_origin: str = "*",
-                    allowed_headers: str = "Authorization, Content-Type, x-requested-with, origin, true-client-ip, X-Correlation-ID",
+                    allowed_headers: str = ("Authorization, Content-Type, "
+                                            "x-requested-with, origin, "
+                                            "true-client-ip, X-Correlation-ID"),
                     allowed_methods: str = "GET, POST, PUT, PATCH, DELETE, OPTIONS") -> Middleware:
     def mw(next_h: Handler) -> Handler:
         def wrapped(req: Request, w: ResponseWriter) -> None:

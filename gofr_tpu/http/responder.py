@@ -89,7 +89,8 @@ class Responder:
         w = self.writer
         if error is not None:
             status = status_from_error(error)
-            detail = error.to_dict() if isinstance(error, HTTPError) else {"message": str(error) or "internal server error"}
+            detail = (error.to_dict() if isinstance(error, HTTPError)
+                      else {"message": str(error) or "internal server error"})
             w.status = status
             w.set_header("Content-Type", "application/json")
             w.write(json.dumps({"error": detail}, default=str).encode())

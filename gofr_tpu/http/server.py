@@ -99,11 +99,14 @@ class HTTPServer:
         self._thread: threading.Thread | None = None
 
     def start(self) -> None:
-        handler_cls = type("BoundHandler", (_Handler,), {"router": self.router, "logger": self.logger})
+        handler_cls = type("BoundHandler", (_Handler,),
+                           {"router": self.router, "logger": self.logger})
         self._server = ThreadingHTTPServer((self.host, self.port), handler_cls)
         self._server.daemon_threads = True
         self.port = self._server.server_address[1]  # resolve port 0
-        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True, name=f"http-server-{self.port}")
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True,
+                                        name=f"http-server-{self.port}")
         self._thread.start()
         if self.logger is not None:
             self.logger.info({"event": "http server started", "port": self.port})

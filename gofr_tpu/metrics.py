@@ -99,7 +99,9 @@ class Manager:
     def new_updown_counter(self, name: str, desc: str = "") -> None:
         self._register(name, desc, "updown")
 
-    def new_histogram(self, name: str, desc: str = "", buckets: Sequence[float] = DEFAULT_HISTOGRAM_BUCKETS) -> None:
+    def new_histogram(self, name: str, desc: str = "",
+                      buckets: Sequence[float] = DEFAULT_HISTOGRAM_BUCKETS,
+                      ) -> None:
         self._register(name, desc, "histogram", sorted(buckets))
 
     def new_gauge(self, name: str, desc: str = "") -> None:
@@ -166,7 +168,8 @@ class Manager:
         with self._lock:
             metrics = list(self._metrics.values())
         for m in sorted(metrics, key=lambda x: x.name):
-            ptype = {"counter": "counter", "updown": "gauge", "gauge": "gauge", "histogram": "histogram"}[m.kind]
+            ptype = {"counter": "counter", "updown": "gauge",
+                     "gauge": "gauge", "histogram": "histogram"}[m.kind]
             if m.desc:
                 lines.append(f"# HELP {m.name} {m.desc}")
             lines.append(f"# TYPE {m.name} {ptype}")
@@ -212,7 +215,11 @@ def _fmt_labels(key: tuple, extra: tuple[str, str] | None = None) -> str:
         items.append(extra)
     if not items:
         return ""
-    inner = ",".join(f'{k}="{str(v).replace(chr(92), chr(92)*2).replace(chr(34), chr(92)+chr(34))}"' for k, v in items)
+    def esc(v):
+        return str(v).replace(chr(92), chr(92) * 2).replace(
+            chr(34), chr(92) + chr(34))
+
+    inner = ",".join(f'{k}="{esc(v)}"' for k, v in items)
     return "{" + inner + "}"
 
 
@@ -241,11 +248,15 @@ def register_framework_metrics(m: Manager) -> None:
     m.new_gauge("app_go_sys", "virtual memory size in bytes")
 
     m.new_histogram("app_http_response", "response time of http requests in seconds", HTTP_BUCKETS)
-    m.new_histogram("app_http_service_response", "response time of http service requests in seconds", HTTP_BUCKETS)
+    m.new_histogram("app_http_service_response",
+                    "response time of http service requests in seconds",
+                    HTTP_BUCKETS)
     m.new_histogram("app_sql_stats", "response time of sql queries in microseconds", SQL_BUCKETS_US)
     m.new_gauge("app_sql_open_connections", "open sql connections")
     m.new_gauge("app_sql_inUse_connections", "in-use sql connections")
-    m.new_histogram("app_redis_stats", "response time of redis commands in microseconds", REDIS_BUCKETS_US)
+    m.new_histogram("app_redis_stats",
+                    "response time of redis commands in microseconds",
+                    REDIS_BUCKETS_US)
 
     m.new_counter("app_pubsub_publish_total_count", "total publish attempts")
     m.new_counter("app_pubsub_publish_success_count", "successful publishes")
@@ -253,9 +264,13 @@ def register_framework_metrics(m: Manager) -> None:
     m.new_counter("app_pubsub_subscribe_success_count", "successful subscribe receives")
 
     # TPU datasource family (no reference equivalent; BASELINE.json north star)
-    m.new_histogram("app_tpu_predict_duration", "end-to-end predict latency in seconds", TPU_BUCKETS)
-    m.new_histogram("app_tpu_device_execute_duration", "on-device execution time in seconds", TPU_BUCKETS)
-    m.new_histogram("app_tpu_batch_wait_duration", "time a request waits for a batch in seconds", TPU_BUCKETS)
+    m.new_histogram("app_tpu_predict_duration",
+                    "end-to-end predict latency in seconds", TPU_BUCKETS)
+    m.new_histogram("app_tpu_device_execute_duration",
+                    "on-device execution time in seconds", TPU_BUCKETS)
+    m.new_histogram("app_tpu_batch_wait_duration",
+                    "time a request waits for a batch in seconds",
+                    TPU_BUCKETS)
     m.new_gauge("app_tpu_batch_fill", "fraction of batch slots occupied at dispatch")
     m.new_counter("app_tpu_requests_total", "total TPU predict requests")
     m.new_counter("app_tpu_tokens_generated_total", "total generated tokens")

@@ -24,10 +24,9 @@ from __future__ import annotations
 from typing import Any, Callable
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import (AXIS_DP, AXIS_EP, AXIS_FSDP, AXIS_PP, AXIS_SP, AXIS_TP,
+from .mesh import (AXIS_EP, AXIS_FSDP, AXIS_PP, AXIS_SP, AXIS_TP,
                    DATA_AXES)
 
 # leaf name -> spec for the *full* (possibly [L, ...]-stacked) weight

@@ -164,7 +164,8 @@ class ZipkinExporter(SpanExporter):
     """Batched Zipkin v2 JSON exporter (reference: gofr.go:245-257 wires a
     zipkin batch exporter when TRACER_HOST is set)."""
 
-    def __init__(self, host: str, port: int = 9411, batch_size: int = 64, flush_interval: float = 2.0):
+    def __init__(self, host: str, port: int = 9411, batch_size: int = 64,
+                 flush_interval: float = 2.0):
         self.url = f"http://{host}:{port}/api/v2/spans"
         self.batch_size = batch_size
         self.flush_interval = flush_interval

@@ -1,4 +1,3 @@
-import os
 
 from gofr_tpu.config import EnvConfig, MapConfig, parse_env_file
 

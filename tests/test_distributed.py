@@ -13,7 +13,6 @@ import socket
 import subprocess
 import sys
 
-import pytest
 
 from gofr_tpu.config import MapConfig
 from gofr_tpu.parallel import distributed

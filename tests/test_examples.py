@@ -11,7 +11,6 @@ import sys
 import urllib.request
 from pathlib import Path
 
-import pytest
 
 from gofr_tpu.config import MapConfig
 
@@ -44,7 +43,9 @@ def http(method: str, url: str, body: dict | None = None):
     data = json.dumps(body).encode() if body is not None else None
     req = urllib.request.Request(url, data=data, method=method)
     try:
-        with urllib.request.urlopen(req, timeout=10) as r:
+        # generous: first-request XLA compiles + CPU contention from a
+        # parallel suite run can push a tiny-model generate past 10s
+        with urllib.request.urlopen(req, timeout=60) as r:
             return r.status, json.loads(r.read() or b"{}")
     except urllib.error.HTTPError as e:
         return e.code, json.loads(e.read() or b"{}")
@@ -157,7 +158,7 @@ def test_custom_metrics_example():
              {"duration": 0.05, "amount": 10, "stock": 3})
         mtext = urllib.request.urlopen(
             f"http://127.0.0.1:{mod.app.metrics_port}/metrics",
-            timeout=10).read().decode()
+            timeout=60).read().decode()
         assert "transaction_success 1" in mtext
         assert "total_credit_day_sale 10" in mtext
         assert "product_stock 3" in mtext
@@ -341,7 +342,10 @@ def test_sharded_70b_example_scaled_with_breaker():
             t0 = time.monotonic()
             status, out = http("POST", f"http://127.0.0.1:{gport}/chat",
                                {"tokens": [1], "max_new_tokens": 1})
-            assert status == 503 and time.monotonic() - t0 < 1.0  # fail fast
+            # fail FAST = the breaker short-circuits instead of dialing
+            # the dead backend (its own connect timeout is >> 3s); the
+            # bound is loose so CPU contention can't flake it
+            assert status == 503 and time.monotonic() - t0 < 3.0
 
 
 def test_tpu_finetune_example_train_and_resume(tmp_path, capsys):

@@ -11,7 +11,7 @@ import time
 
 import pytest
 
-from gofr_tpu.grpcx import (GRPCError, GRPCService, GRPCServer, JSONCodec,
+from gofr_tpu.grpcx import (GRPCError, GRPCService, GRPCServer,
                             dial, INVALID_ARGUMENT, INTERNAL,
                             DEADLINE_EXCEEDED, UNIMPLEMENTED)
 from gofr_tpu.grpcx.hpack import (Decoder, Encoder, HPACKError,

@@ -16,7 +16,9 @@ import pytest
 
 
 def test_request_params_and_headers():
-    req = Request("get", "/items?x=1&x=2&y=hello", headers={"Content-Type": "application/json", "Host": "h:80"})
+    req = Request("get", "/items?x=1&x=2&y=hello",
+                  headers={"Content-Type": "application/json",
+                           "Host": "h:80"})
     assert req.method == "GET"
     assert req.path == "/items"
     assert req.param("x") == "1"

@@ -7,7 +7,8 @@ from gofr_tpu.testutil import new_mock_logger
 
 
 def test_level_ordering_and_parse():
-    assert LogLevel.DEBUG < LogLevel.INFO < LogLevel.NOTICE < LogLevel.WARN < LogLevel.ERROR < LogLevel.FATAL
+    assert (LogLevel.DEBUG < LogLevel.INFO < LogLevel.NOTICE
+            < LogLevel.WARN < LogLevel.ERROR < LogLevel.FATAL)
     assert LogLevel.parse("debug") == LogLevel.DEBUG
     assert LogLevel.parse("WARN") == LogLevel.WARN
     assert LogLevel.parse("nonsense") == LogLevel.INFO

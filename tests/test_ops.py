@@ -44,7 +44,8 @@ def test_layer_norm_matches_reference():
     b = jnp.ones((8,)) * 0.25
     got = layer_norm(x, w, b, eps=1e-12)
     xf = np.asarray(x, np.float64)
-    want = (xf - xf.mean(-1, keepdims=True)) / np.sqrt(xf.var(-1, keepdims=True) + 1e-12) * 1.5 + 0.25
+    want = ((xf - xf.mean(-1, keepdims=True))
+            / np.sqrt(xf.var(-1, keepdims=True) + 1e-12) * 1.5 + 0.25)
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
 
 

@@ -13,7 +13,7 @@ import numpy as np
 import pytest
 
 from gofr_tpu.models import LLAMA_CONFIGS, llama
-from gofr_tpu.models.paged_llama import (BlockAllocator, PagedKVCache,
+from gofr_tpu.models.paged_llama import (BlockAllocator,
                                          init_paged_cache,
                                          paged_decode_step,
                                          write_prompt_blocks)

@@ -5,7 +5,7 @@ from __future__ import annotations
 
 import pytest
 
-from gofr_tpu.datasource.redisclient import RedisClient, RedisError, new_redis_client
+from gofr_tpu.datasource.redisclient import RedisClient, RedisError
 from gofr_tpu.metrics import Manager, register_framework_metrics
 from gofr_tpu.testutil import new_mock_config, new_mock_logger
 from gofr_tpu.testutil.redisfake import FakeRedisServer

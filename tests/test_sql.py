@@ -7,7 +7,7 @@ import dataclasses
 
 import pytest
 
-from gofr_tpu.datasource.sql import DB, new_sql, to_snake_case
+from gofr_tpu.datasource.sql import new_sql, to_snake_case
 from gofr_tpu.metrics import Manager, register_framework_metrics
 from gofr_tpu.testutil import new_mock_config, new_mock_logger
 

@@ -17,7 +17,7 @@ import pytest
 from gofr_tpu.config import MapConfig
 from gofr_tpu.models import LLAMA_CONFIGS, llama
 from gofr_tpu.tpu import (CoalescingBatcher, GenerationEngine, GenerationError,
-                          TPUEngine, load_npz, maybe_quantize,
+                          load_npz, maybe_quantize,
                           new_engine_from_config, pad_bucket, save_npz)
 from gofr_tpu.ops.quant import QuantizedLinear
 

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""A/B profile the fused XLA decode path vs the flash-decode kernel.
+
+VERDICT r4 #5: if flash-decode loses its hardware A/B a third time,
+capture profiler traces of BOTH paths and write the postmortem. This
+tool runs each path for a handful of fused blocks under
+``jax.profiler.trace`` and saves the traces side by side:
+
+    /tmp/gofr_flash_ab/xla/      the jnp/XLA fused-block path
+    /tmp/gofr_flash_ab/flash/    the Pallas flash-decode path
+
+Open with TensorBoard (or xprof) elsewhere; the trace contains per-HLO
+timing, DMA sizes, and MXU/VPU occupancy — enough to attribute the gap
+(per-grid-step overhead vs DMA-skip benefit vs scheduling slack).
+
+Also prints the same wall-clock A/B bench.py reports, so the traces
+and the numbers come from the same run. Holds the chip lock.
+
+Usage:  python tools/flash_ab_profile.py [--cpu] [--batch 64]
+        [--cache-len 1024] [--blocks 6]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+sys.path.insert(0, ".")
+
+import bench  # noqa: E402
+
+
+def run_path(name: str, multistep, params, rope, tokens, cache, blocks,
+             trace_dir):
+    import jax
+    import numpy as np
+
+    # warm (compile + first block) outside the trace
+    tokens2, cache = multistep(params, rope, tokens, cache)
+    np.asarray(tokens2)
+    t0 = time.perf_counter()
+    with jax.profiler.trace(trace_dir):
+        for _ in range(blocks):
+            tokens2, cache = multistep(params, rope, tokens2, cache)
+        np.asarray(tokens2)
+    dt = time.perf_counter() - t0
+    return dt, cache
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--cache-len", type=int, default=1024)
+    ap.add_argument("--blocks", type=int, default=6)
+    ap.add_argument("--decode-block", type=int, default=8)
+    ap.add_argument("--out", default="/tmp/gofr_flash_ab")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from gofr_tpu.models import llama
+    from gofr_tpu.models.common import LLAMA_CONFIGS
+
+    bench.init_backend()
+    cfg = LLAMA_CONFIGS["tiny" if args.cpu else "llama3-8b"]
+    params = bench.int8_random_params(cfg, jax.random.PRNGKey(0))
+    rope = llama.get_rope_tables(cfg, args.cache_len)
+
+    def make(flash: bool):
+        @functools.partial(jax.jit, donate_argnums=(3,))
+        def multistep(params, rope, tokens, cache):
+            def body(carry, _):
+                tokens, cache = carry
+                logits, cache = llama.decode_step(params, cfg, tokens,
+                                                  cache, rope, flash=flash)
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (tok, cache), tok
+
+            (tokens, cache), _ = jax.lax.scan(body, (tokens, cache),
+                                              None, length=args.decode_block)
+            return tokens, cache
+
+        return multistep
+
+    results = {}
+    for name, flash in (("xla", False), ("flash", True)):
+        cache = llama.init_cache(cfg, args.batch, args.cache_len,
+                                 dtype=jnp.int8)
+        cache = cache._replace(lengths=jnp.full((args.batch,),
+                                                args.cache_len // 2,
+                                                jnp.int32))
+        tokens = jnp.zeros((args.batch,), jnp.int32)
+        dt, cache = run_path(name, make(flash), params, rope, tokens,
+                             cache, args.blocks,
+                             os.path.join(args.out, name))
+        n = args.blocks * args.decode_block
+        results[name] = dt / n * 1e3
+        print(f"{name}: {dt / n * 1e3:.2f} ms/step "
+              f"({args.batch * n / dt:.0f} tok/s), trace in "
+              f"{os.path.join(args.out, name)}", flush=True)
+        del cache
+
+    faster = min(results, key=results.get)
+    print(f"winner: {faster} "
+          f"({results[faster]:.2f} vs "
+          f"{results[max(results, key=results.get)]:.2f} ms/step)")
+
+
+if __name__ == "__main__":
+    # serialize with any other chip holder (bench.py / retry loop):
+    # concurrent TPU clients through the tunnel wedge it for hours
+    _chip_lock = bench.acquire_chip_lock(section="probe")
+    main()

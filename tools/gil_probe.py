@@ -18,7 +18,11 @@ import jax, jax.numpy as jnp, numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from gofr_tpu.models import llama
 from gofr_tpu.models.common import LLAMA_CONFIGS
-from bench import int8_random_params
+from bench import acquire_chip_lock, int8_random_params
+
+# serialize with any other chip holder (bench.py / retry loop):
+# concurrent TPU clients through the tunnel wedge it for hours
+_chip_lock = acquire_chip_lock(section="probe")
 
 cfg = LLAMA_CONFIGS["llama3-8b"]
 batch, cache_len, K = 64, 1024, 4

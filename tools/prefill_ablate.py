@@ -145,4 +145,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    # serialize with any other chip holder (bench.py / retry loop):
+    # concurrent TPU clients through the tunnel wedge it for hours
+    import bench
+
+    _chip_lock = bench.acquire_chip_lock(section="probe")
     main()
