@@ -424,6 +424,48 @@ def test_generation_loop_recovers_after_device_failure(tiny_llama):
         eng.close()
 
 
+def test_recovery_observer_consistency_cycles(tiny_llama):
+    """Regression for the r4 ordering race, made repeatable: across
+    MANY inject-recover cycles, the INSTANT a consumer receives the
+    GenerationError its thread must already observe consistent engine
+    state — prefix index cleared, engine not down, and the very next
+    serve returning exact tokens. The flaky-window version of this
+    (one cycle) only tripped ~50% of the time; cycling shrinks the
+    escape probability to negligible."""
+    eng = GenerationEngine(TINY, tiny_llama, slots=2, max_seq=32,
+                           prompt_buckets=(8,), prefix_cache_slots=2,
+                           prefix_store_min=8)
+    try:
+        prefix = [3, 1, 4, 1, 5, 9, 2, 6]
+        want = eng.generate(prefix + [8, 8], max_new_tokens=4).tokens()
+        real = eng._step_jit
+        for cycle in range(8):
+            # (re)populate the index so recovery has something to clear
+            if len(eng._prefix_idx) == 0:
+                eng.generate(prefix + [8, 8], max_new_tokens=4)
+            assert len(eng._prefix_idx) >= 1
+            state = {"fired": False}
+
+            def flaky(*a, **k):
+                if not state["fired"]:
+                    state["fired"] = True
+                    raise RuntimeError(f"injected failure #{cycle}")
+                return real(*a, **k)
+
+            eng._step_jit = flaky
+            with pytest.raises(GenerationError):
+                eng.generate([1, 2, 3], max_new_tokens=4).tokens()
+            # the moment the error unblocked THIS thread, invariants
+            # must already hold (the old handler delivered first and
+            # cleared after — the exact interleaving this pins down)
+            assert len(eng._prefix_idx) == 0, f"cycle {cycle}"
+            assert eng.down is None, f"cycle {cycle}"
+            got = eng.generate(prefix + [8, 8], max_new_tokens=4).tokens()
+            assert got == want, f"cycle {cycle}"
+    finally:
+        eng.close()
+
+
 def test_recovery_clears_prefix_pool_and_keeps_serving(tiny_llama):
     """Device-failure recovery with a prefix cache enabled: the side
     pool is reallocated (a failed store leaves the donated buffer
