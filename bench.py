@@ -48,9 +48,11 @@ def candidate_note() -> str | None:
     tools/bench_retry.sh re-attempts across the whole round; when the
     round-end run hits an outage, the error line cites the artifact a
     successful earlier attempt captured (the headline stays 0 — this
-    run measured nothing). Freshness (24h) comes from the artifact's
-    OWN timestamp — file mtime is rewritten by checkouts/copies — so a
-    stale file from an earlier round can't masquerade as current."""
+    run measured nothing). Freshness (72h — outages have run >24h, and
+    the note states the age so the reader can judge) comes from the
+    artifact's OWN timestamp — file mtime is rewritten by
+    checkouts/copies — so a stale file from a much earlier round can't
+    masquerade as current."""
     try:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_CANDIDATE.json")
@@ -59,7 +61,7 @@ def candidate_note() -> str | None:
         cap = time.strptime(cand["captured_at"], "%Y-%m-%dT%H:%M:%SZ")
         import calendar
         age_s = time.time() - calendar.timegm(cap)
-        if 0 <= age_s < 24 * 3600:
+        if 0 <= age_s < 72 * 3600:
             return ("BENCH_CANDIDATE.json: a clean run captured at "
                     f"{cand.get('captured_at')} ({age_s / 3600:.1f}h ago) "
                     f"measured {cand.get('value')} {cand.get('unit')}")
