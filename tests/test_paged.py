@@ -206,7 +206,7 @@ def test_block_allocator():
 
 # -- engine level -------------------------------------------------------------
 
-from gofr_tpu.tpu import GenerationEngine  # noqa: E402
+from gofr_tpu.tpu import GenerationEngine, GenerationError  # noqa: E402
 
 
 @pytest.fixture(scope="module")
@@ -692,5 +692,59 @@ def test_paged_engine_warmup_and_drain(params):
         s = eng.generate([3, 1, 4, 1, 5], max_new_tokens=4)
         assert len(s.tokens()) == 4
         assert eng.drain(timeout=5.0)
+    finally:
+        eng.close()
+
+
+def test_paged_recovery_cycles_clear_shared_prefix_and_keep_serving(params):
+    """Device-failure recovery on a PAGED engine with the zero-copy
+    prefix cache, cycled: each recovery must reallocate the pool and
+    clear the shared-prefix index (stored entries reference blocks of
+    the OLD pool — a hit through the fresh pool would restore all-zero
+    KV), with every invariant already consistent the instant the error
+    unblocks the consumer, exact tokens on the next serve, and the
+    allocator's free-block accounting balanced across recoveries (no
+    reference leaks)."""
+    rng = np.random.default_rng(23)
+    prefix = rng.integers(1, TINY.vocab_size, 36).tolist()  # 2 full blocks
+    dense = GenerationEngine(TINY, params, slots=2, max_seq=64,
+                             prompt_buckets=(8, 16))
+    try:
+        want = dense.generate(prefix, max_new_tokens=6).tokens()
+    finally:
+        dense.close()
+    eng = GenerationEngine(TINY, params, slots=2, max_seq=64,
+                           prompt_buckets=(8, 16),
+                           paged_blocks=13, paged_block_size=16,
+                           prefix_cache_slots=2, prefix_store_min=16)
+    try:
+        idle_free = eng.stats()["paged"]["free"]
+        for cycle in range(4):
+            got = eng.generate(prefix, max_new_tokens=6).tokens()
+            assert got == want, f"cycle {cycle}"
+            assert eng.stats()["prefix_cache"]["entries"] == 1
+            assert eng.stats()["paged"]["free"] < idle_free  # entry holds
+            real = eng._step_jit
+            state = {"fired": False}
+
+            def flaky(*a, **k):
+                if not state["fired"]:
+                    state["fired"] = True
+                    raise RuntimeError(f"paged injected failure #{cycle}")
+                return real(*a, **k)
+
+            eng._step_jit = flaky
+            with pytest.raises(GenerationError):
+                eng.generate([1, 2, 3], max_new_tokens=4).tokens()
+            eng._step_jit = real
+            # observer-consistency at the instant the error unblocked us
+            assert eng.down is None, f"cycle {cycle}"
+            assert len(eng._prefix_idx) == 0, f"cycle {cycle}"
+            # refcount balance: entries cleared + failed slot retired
+            # returns EVERY block to the free list — leaks here would
+            # shrink the pool a little every recovery until admissions
+            # stall under phantom pressure
+            assert eng.stats()["paged"]["free"] == idle_free, \
+                f"cycle {cycle}"
     finally:
         eng.close()
