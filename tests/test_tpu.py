@@ -525,6 +525,50 @@ def test_generation_engine_down_when_recovery_fails(tiny_llama, monkeypatch):
         eng.close()
 
 
+def test_engine_down_fails_pending_queue_without_hanging(tiny_llama,
+                                                         monkeypatch):
+    """When recovery itself fails (engine DOWN), consumers whose
+    requests were still QUEUED — never admitted to a slot — must
+    receive the down error instead of blocking forever: the loop
+    thread exits, so no later iteration would ever admit them."""
+    eng = GenerationEngine(TINY, tiny_llama, slots=1, max_seq=32,
+                           prompt_buckets=(8,))
+    try:
+        # a gate inside the fake step keeps slot 0 BUSY long enough for
+        # the extra submissions to pile up in the pending queue
+        release = threading.Event()
+
+        def dead(*a, **k):
+            release.wait(5.0)
+            raise RuntimeError("dead chip")
+
+        eng._step_jit = dead
+        monkeypatch.setattr("gofr_tpu.tpu.generator.llama.init_cache", dead)
+        results = [None] * 3
+
+        def consume(i):
+            try:
+                eng.generate([1, 2, i + 1], max_new_tokens=2).tokens()
+                results[i] = "completed"
+            except GenerationError:
+                results[i] = "errored"
+
+        threads = [threading.Thread(target=consume, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # one admitted (blocked in the gated step),
+        release.set()    # two pending; now let the failure fire
+        for t in threads:
+            t.join(timeout=30.0)
+        assert not any(t.is_alive() for t in threads), results
+        assert results == ["errored"] * 3
+        assert eng.down is not None
+    finally:
+        monkeypatch.undo()
+        eng.close()
+
+
 def test_generation_top_k_one_is_greedy(gen_engine):
     # top_k=1 collapses sampling to argmax even at high temperature
     prompt = [5, 17, 42, 7]
