@@ -440,6 +440,19 @@ def bench_paged_decode(cfg, batch: int, live_len: int, steps: int = 64,
                                              None, length=decode_block)
         return tokens, cache, toks
 
+    # pool footprint, so an OOM at this batch is attributable from the
+    # log alone: int8 K+V pools + f32 scale planes, next to the int8
+    # projections + bf16 embedding the params stream
+    pool_bytes = 2 * cfg.n_layers * n_blocks * block_t * cfg.n_kv_heads \
+        * (cfg.head_dim + 4)
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+    w_bytes = cfg.n_layers * (2 * cfg.dim * cfg.dim
+                              + 2 * cfg.dim * kv_dim
+                              + 3 * cfg.dim * cfg.ffn_dim) \
+        + cfg.vocab_size * cfg.dim * 3  # bf16 embedding + int8 lm_head
+    log(f"  paged pool: {n_blocks} blocks x {block_t} tok = "
+        f"{pool_bytes / 2**30:.2f} GiB KV "
+        f"(~{w_bytes / 2**30:.1f} GiB weights alongside)")
     t0 = time.perf_counter()
     tokens, cache, toks = multistep(params, rope, tokens, cache, table)
     np.asarray(toks)
@@ -452,7 +465,8 @@ def bench_paged_decode(cfg, batch: int, live_len: int, steps: int = 64,
     dt = time.perf_counter() - t0
     n = blocks * decode_block
     out = {"tok_s": batch * n / dt, "step_ms": dt / n * 1e3,
-           "batch": batch, "live_len": live_len}
+           "batch": batch, "live_len": live_len,
+           "pool_gib": round(pool_bytes / 2**30, 2)}
     log(f"  paged batch={batch} live={live_len} T={block_t}: "
         f"{n} fused steps in {dt:.3f}s -> {out['tok_s']:.0f} tok/s "
         f"({out['step_ms']:.2f} ms/step)")
