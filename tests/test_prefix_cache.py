@@ -187,3 +187,49 @@ def test_mesh_engine_prefix_hits_stream_exact_tokens(params, axes):
         assert again == first
     finally:
         eng.close()
+
+
+def test_mesh_engine_recovery_reallocates_sharded_pool(params):
+    """Device-failure recovery on a SHARDED engine: the cache and the
+    prefix pool must reallocate with their mesh shardings intact (the
+    recovery path re-applies _cache_sh/_pool_sh), the index must clear
+    before the consumer observes the error, and post-recovery serving
+    must stream exact tokens again — including a fresh prefix store."""
+    from gofr_tpu import parallel
+    from gofr_tpu.tpu import GenerationError
+
+    mesh = parallel.make_mesh(dp=2, fsdp=2, tp=2)
+    eng = GenerationEngine(TINY, parallel.shard_params(params, mesh),
+                           slots=2, max_seq=64, prompt_buckets=(8, 16),
+                           mesh=mesh, prefix_cache_slots=2,
+                           prefix_store_min=16)
+    try:
+        rng = np.random.default_rng(13)
+        prefix = rng.integers(1, TINY.vocab_size, 24).tolist()
+        want = eng.generate(prefix, max_new_tokens=4).tokens()
+        assert want == _ref_greedy(params, prefix, 4)
+        assert eng.stats()["prefix_cache"]["entries"] == 1
+        real = eng._step_jit
+        state = {"fired": False}
+
+        def flaky(*a, **k):
+            if not state["fired"]:
+                state["fired"] = True
+                raise RuntimeError("injected sharded device failure")
+            return real(*a, **k)
+
+        eng._step_jit = flaky
+        with pytest.raises(GenerationError):
+            eng.generate([1, 2, 3], max_new_tokens=4).tokens()
+        assert eng.down is None
+        assert eng.stats()["prefix_cache"]["entries"] == 0
+        # the reallocated pool/cache kept their shardings: serving and
+        # a fresh store work exactly as before
+        got = eng.generate(prefix, max_new_tokens=4).tokens()
+        assert got == want
+        assert eng.stats()["prefix_cache"]["entries"] == 1
+        cont = prefix[:16] + [5, 6]
+        assert eng.generate(cont, max_new_tokens=4).tokens() == \
+            _ref_greedy(params, cont, 4)
+    finally:
+        eng.close()
