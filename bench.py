@@ -1005,22 +1005,26 @@ def main() -> None:
         if "grpc_error" in ttft:
             payload["ttft_grpc_error"] = ttft["grpc_error"]
         payload["ttft_target_ms"] = TARGET_TTFT_MS
+    emit({**payload, "partial": "sections after ttft pending"})
     tp = section("ttft_paged", "--slots", str(min(used or 8, 32)))
     if "error" in tp:
         payload["ttft_paged_error"] = tp["error"]
     else:
         payload["ttft_paged_p50_ms"] = round(tp["p50_ms"], 1)
+    emit({**payload, "partial": "sections after ttft_paged pending"})
     pfx = section("prefix")
     if "error" in pfx:
         payload["prefix_error"] = pfx["error"]
     else:
         payload["prefix_miss_ttft_ms"] = round(pfx["miss_ms"], 1)
         payload["prefix_hit_ttft_ms"] = round(pfx["hit_ms"], 1)
+    emit({**payload, "partial": "sections after prefix pending"})
     eng = section("engine")
     if "error" in eng:
         payload["engine_error"] = eng["error"]
     else:
         payload["engine_tok_s"] = round(eng["tok_s"], 1)
+    emit({**payload, "partial": "sections after engine pending"})
     spec = section("spec")
     if "error" in spec:
         payload["spec_error"] = spec["error"]
@@ -1028,6 +1032,9 @@ def main() -> None:
         payload["spec_tok_s"] = round(spec["tok_s"], 1)
         payload["spec_tokens_per_window"] = round(
             spec["tokens_per_window"], 2)
+    # a kill during the (long) paged sweep must not cost the measured
+    # sections: the last stdout line stays a valid, honest artifact
+    emit({**payload, "partial": "paged sweep pending"})
     # paged-pool sweep: contiguous rows OOM past ~96; the pool admits
     # 128 (~5.5 GB at 512 live tokens/slot next to the 8.6 GB weight
     # stream) and 160 (~6.9 GB) is worth an attempt now that each try
