@@ -1,0 +1,83 @@
+"""Unit tests for tools/lint.py — the hermetic CI lint gate.
+
+The fallback linter guards the repo wherever ruff cannot be installed,
+so its own blind spots become the repo's. These pin the cases found in
+review: @overload redefinitions must not false-positive F811, unused
+imports must not hide inside larger identifiers (word-boundary
+matching), and f-string format specs must not read as placeholder-less
+f-strings.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+LINT = pathlib.Path(__file__).resolve().parent.parent / "tools" / "lint.py"
+
+
+def run_lint(tmp_path, source: str):
+    f = tmp_path / "case.py"
+    f.write_text(source)
+    p = subprocess.run([sys.executable, str(LINT), str(f)],
+                       capture_output=True, text=True)
+    return p.returncode, p.stdout
+
+
+def test_clean_file_passes(tmp_path):
+    rc, out = run_lint(tmp_path, "import os\n\n\ndef f():\n    return os.getpid()\n")
+    assert rc == 0 and out == ""
+
+
+def test_unused_import_flagged(tmp_path):
+    rc, out = run_lint(tmp_path, "import os\n\nX = 1\n")
+    assert rc == 1 and "F401" in out and "'os'" in out
+
+
+def test_unused_import_not_hidden_by_substring(tmp_path):
+    # 'time' appears inside 'settimeout' — substring matching would
+    # silently exempt it (review finding)
+    src = ("import time\nimport socket\n\n\ndef f(s: socket.socket):\n"
+           "    s.settimeout(5)\n")
+    rc, out = run_lint(tmp_path, src)
+    assert rc == 1 and "'time'" in out
+
+
+def test_overload_defs_not_f811(tmp_path):
+    src = ("from typing import overload\n\n\n@overload\n"
+           "def f(x: int) -> int: ...\n@overload\n"
+           "def f(x: str) -> str: ...\n\n\ndef f(x):\n    return x\n")
+    rc, out = run_lint(tmp_path, src)
+    assert "F811" not in out, out
+
+
+def test_plain_redefinition_is_f811(tmp_path):
+    src = "def f():\n    return 1\n\n\ndef f():\n    return 2\n"
+    rc, out = run_lint(tmp_path, src)
+    assert rc == 1 and "F811" in out
+
+
+def test_format_spec_is_not_f541(tmp_path):
+    # {x:.2f} parses as a nested placeholder-less JoinedStr in
+    # format_spec — must not be reported (review finding)
+    rc, out = run_lint(tmp_path, 'x = 1.0\ny = f"{x:.2f}"\n')
+    assert "F541" not in out, out
+    rc, out = run_lint(tmp_path, 'z = f"no placeholders"\n')
+    assert rc == 1 and "F541" in out
+
+
+def test_mutable_default_and_bare_except(tmp_path):
+    src = ("def f(a=[]):\n    try:\n        return a\n"
+           "    except:\n        return None\n")
+    rc, out = run_lint(tmp_path, src)
+    assert "B006" in out and "E722" in out
+
+
+def test_reexport_and_dunder_all_exempt(tmp_path):
+    src = ("import os as os\nimport sys\n\n__all__ = [\"sys\"]\n")
+    rc, out = run_lint(tmp_path, src)
+    assert "F401" not in out, out
+
+
+def test_syntax_error_reported_not_crash(tmp_path):
+    rc, out = run_lint(tmp_path, "def f(:\n")
+    assert rc == 1 and "E999" in out
