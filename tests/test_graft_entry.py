@@ -55,7 +55,12 @@ def test_dryrun_self_provisions_in_driver_environment():
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_NUM_CPU_DEVICES",
                         "_GOFR_DRYRUN_CHILD")}
-    budget = float(os.environ.get("GOFR_DRYRUN_BUDGET_S", "90")) + 30
+    # generous margin over the in-process budget test (which owns the
+    # honest timing contract): the child pays interpreter boot + imports
+    # + the self-provision re-exec, and a loaded box (parallel suite,
+    # CPU contention) stretches all three — this variant verifies the
+    # SELF-PROVISIONING, not the speed
+    budget = float(os.environ.get("GOFR_DRYRUN_BUDGET_S", "90")) + 210
     r = subprocess.run(
         [sys.executable, "-c",
          "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
