@@ -29,6 +29,7 @@ from .http.middleware import (
     apikey_auth_middleware,
     basic_auth_middleware,
     cors_middleware,
+    inflight_middleware,
     logging_middleware,
     metrics_middleware,
     oauth_middleware,
@@ -87,8 +88,11 @@ class App:
         self._running = threading.Event()
 
         # Middleware chain in reference order (http/router.go:19-24):
-        # Tracer -> Logging(+recovery) -> CORS -> Metrics [-> auth]
+        # Tracer -> Logging(+recovery) -> CORS -> Metrics [-> auth];
+        # the in-flight registry sits right after Tracer so /debug/requests
+        # entries carry the request's trace id for its whole lifetime.
         self.router.use(tracer_middleware(self.container.tracer))
+        self.router.use(inflight_middleware(self.container.observe.requests))
         self.router.use(logging_middleware(self.logger))
         self.router.use(cors_middleware())
         self.router.use(metrics_middleware(self.container.metrics))
@@ -234,6 +238,12 @@ class App:
             w.write(self.container.metrics.render_prometheus().encode())
 
         r.add("GET", "/metrics", metrics_handler)
+        # /debug introspection pages live beside /metrics: same port,
+        # same network policy (observe/debug.py — requests, events,
+        # vars, pprof)
+        from .observe.debug import install_debug_routes
+
+        install_debug_routes(r, self)
         return r
 
     # -- lifecycle (reference gofr.go:108-164 Run) ---------------------------

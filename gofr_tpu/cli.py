@@ -98,14 +98,15 @@ def run_cmd(app, argv: Iterable[str] | None = None) -> int:
         try:
             data = handler(ctx)
         except Exception as e:
-            print(str(e), file=sys.stderr)
+            print(str(e), file=sys.stderr)  # noqa: T201 — command output
             return 1
         if data is not None:
-            print(data if isinstance(data, str) else _render(data))
+            print(data if isinstance(data, str)  # noqa: T201 — command output
+                  else _render(data))
         return 0
 
     if app._cmd_routes:
-        print("No Command Found!", file=sys.stderr)
+        print("No Command Found!", file=sys.stderr)  # noqa: T201 — command output
         _print_help(app)
     return 1
 
@@ -121,4 +122,4 @@ def _render(data: Any) -> str:
 
 def _print_help(app) -> None:
     for pattern, _h, desc in app._cmd_routes:
-        print(f"  {pattern:<30} {desc}", file=sys.stderr)
+        print(f"  {pattern:<30} {desc}", file=sys.stderr)  # noqa: T201 — command output

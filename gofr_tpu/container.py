@@ -31,6 +31,14 @@ class Container:
         self.metrics = gmetrics.Manager(logger=self.logger)
         gmetrics.register_framework_metrics(self.metrics)
         self.tracer = tracing.tracer_from_config(self.config, self.app_name)
+        # Inference flight recorder + in-flight registry (observe/):
+        # always on, shared by HTTP middleware and the TPU datasource,
+        # rendered by the /debug pages on the metrics server.
+        from .observe import Observe
+
+        self.observe = Observe(
+            metrics=self.metrics, tracer=self.tracer,
+            max_events=self.config.get_int("DEBUG_EVENT_BUFFER", 2048))
 
         # Datasources — wired from config, graceful degradation throughout
         self.redis = None
@@ -72,7 +80,8 @@ class Container:
             try:
                 from .tpu import new_engine_from_config
 
-                self.tpu = new_engine_from_config(cfg, log, self.metrics)
+                self.tpu = new_engine_from_config(cfg, log, self.metrics,
+                                                  observe=self.observe)
             except Exception as e:
                 log.error({"event": "tpu engine init failed", "error": repr(e)})
 

@@ -124,6 +124,27 @@ def cors_middleware(allowed_origin: str = "*",
     return mw
 
 
+def inflight_middleware(registry) -> Middleware:
+    """Register every request in the in-flight registry for the lifetime
+    of its handler, so /debug/requests can answer "what is this server
+    doing right now?" (x/net/trace style). Runs inside the tracer
+    middleware so the entry carries the request's trace id."""
+    from .. import tracing
+
+    def mw(next_h: Handler) -> Handler:
+        def wrapped(req: Request, w: ResponseWriter) -> None:
+            span = tracing.current_span()
+            entry = registry.add(
+                "http", f"{req.method} {req.path}",
+                span.trace_id if span else "", stage="handler")
+            try:
+                next_h(req, w)
+            finally:
+                registry.remove(entry)
+        return wrapped
+    return mw
+
+
 def metrics_middleware(metrics) -> Middleware:
     def mw(next_h: Handler) -> Handler:
         def wrapped(req: Request, w: ResponseWriter) -> None:

@@ -235,6 +235,17 @@ REDIS_BUCKETS_US = (50, 75, 100, 125, 150, 200, 300, 500, 750, 1000, 2000, 3000)
 # second-scale sharded executions):
 TPU_BUCKETS = (0.0005, 0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.15, 0.2,
                0.3, 0.5, 0.75, 1, 2, 5, 10, 30)
+# TTFT spans admission wait + one prefill dispatch: ms-scale when a slot
+# is free and the shape is warm, seconds under queueing or a first-shape
+# compile — so the range is wide with extra resolution in 10ms-1s where
+# the serving SLO lives:
+TTFT_BUCKETS = (0.002, 0.005, 0.01, 0.02, 0.035, 0.05, 0.075, 0.1, 0.15,
+                0.25, 0.4, 0.6, 1, 1.5, 2.5, 5, 10, 30, 60)
+# Inter-token gaps cluster at decode-step cadence (sub-ms to tens of ms
+# on hardware; hundreds of ms on the CPU backend) and spike when a chunk
+# lattice or compile interleaves — fine buckets below 100ms, coarse above:
+ITL_BUCKETS = (0.0005, 0.001, 0.002, 0.004, 0.008, 0.015, 0.03, 0.05, 0.1,
+               0.2, 0.4, 0.8, 1.5, 3, 10)
 
 
 def register_framework_metrics(m: Manager) -> None:
@@ -277,6 +288,23 @@ def register_framework_metrics(m: Manager) -> None:
     m.new_counter("app_tpu_prefix_cache_hits_total",
                   "generation admissions that restored a cached prompt-prefix KV row")
     m.new_gauge("app_tpu_devices", "number of visible TPU devices")
+    m.new_counter("app_tpu_paged_evictions_total",
+                  "streams truncated early by paged KV pool exhaustion")
+
+    # serving-path telemetry (gofr_tpu/observe: the inference flight
+    # recorder's metric face)
+    m.new_histogram("app_tpu_ttft_duration",
+                    "time from generate() submit to first token in seconds",
+                    TTFT_BUCKETS)
+    m.new_histogram("app_tpu_inter_token_duration",
+                    "gap between consecutive delivered tokens in seconds",
+                    ITL_BUCKETS)
+    m.new_gauge("app_tpu_tokens_per_second",
+                "decode throughput of the most recently finished stream")
+    m.new_gauge("app_tpu_queue_depth",
+                "requests waiting for a generation slot or a coalesced batch")
+    m.new_gauge("app_tpu_active_sequences",
+                "generation slots currently holding a live stream")
 
 
 def update_system_metrics(m: Manager) -> None:

@@ -1,0 +1,38 @@
+"""Inference flight recorder + ``/debug`` introspection subsystem.
+
+The always-on observability layer for the serving stack (PAPER.md layer
+map row "Observability", extended TPU-side): an in-flight request
+registry, a bounded ring buffer of request lifecycle events, a
+wall-clock sampling profiler, and the ``/debug`` pages that render them.
+One ``Observe`` object lives on the Container and is threaded through
+HTTP middleware and the TPU engines.
+"""
+
+from __future__ import annotations
+
+from .profiler import collect_profile, render_collapsed, sample_once
+from .recorder import FlightRecorder
+from .registry import InflightRequest, RequestRegistry
+
+__all__ = [
+    "Observe",
+    "FlightRecorder",
+    "InflightRequest",
+    "RequestRegistry",
+    "collect_profile",
+    "render_collapsed",
+    "sample_once",
+]
+
+
+class Observe:
+    """The container's observability bundle: request registry + flight
+    recorder + the tracer the serving stack emits stage spans through.
+    Always constructed (the recorder is bounded and the registry is
+    O(active requests)) — observability is not opt-in."""
+
+    def __init__(self, metrics=None, tracer=None, max_events: int = 2048):
+        self.requests = RequestRegistry()
+        self.recorder = FlightRecorder(capacity=max_events)
+        self.metrics = metrics
+        self.tracer = tracer

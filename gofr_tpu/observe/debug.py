@@ -1,0 +1,228 @@
+"""The ``/debug`` introspection router, mounted on the metrics server.
+
+The Python/TPU-native take on Go's ``net/http/pprof`` +
+``golang.org/x/net/trace`` pages, answering "what is this server doing
+right now?" without attaching a debugger:
+
+  /debug                      index
+  /debug/requests             in-flight request table (x/net/trace style;
+                              ?format=json for machines)
+  /debug/events               flight-recorder ring buffer (JSON;
+                              ?n= ?event= ?request_id=)
+  /debug/vars                 config + device topology + engine/batcher
+                              state as JSON (expvar style)
+  /debug/pprof/profile        wall-clock sampling profile, collapsed-stack
+                              output (?seconds=N&hz=H, flamegraph-ready)
+
+Mounted on the METRICS port, not the app port, for the same reason the
+reference keeps /metrics there: debug surfaces stay off the public
+listener and inherit whatever network policy already protects scrapes.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import sys
+import threading
+
+from . import profiler
+
+# keys whose values never leave the process (config dumps are one of the
+# classic credential-leak vectors; match generously)
+_REDACT_MARKERS = ("PASSWORD", "SECRET", "TOKEN", "KEY", "CREDENTIAL", "AUTH")
+
+MAX_PROFILE_SECONDS = 30.0
+MAX_PROFILE_HZ = 1000.0
+
+# Single-flight: one profile at a time per process. N concurrent
+# samplers would multiply GIL contention against the serving loop N-fold
+# for up to 30 s each — concurrent callers get 409, not a pile-up.
+_profile_lock = threading.Lock()
+
+
+def _redact_config(cfg) -> dict:
+    """Best-effort dump of the app's config view, secrets masked.
+
+    Config is a two-method protocol, not an enumerable store — dump the
+    sources we know how to see (MapConfig.values, EnvConfig's .env file
+    vars) rather than the whole process environment. For keys that ARE
+    known, report the value the app actually resolves (EnvConfig lets
+    the process env override the file — the page must show the live
+    value, not the shadowed one)."""
+    raw: dict[str, str] = {}
+    raw.update(getattr(cfg, "_file_vars", None) or {})
+    raw.update(getattr(cfg, "values", None) or {})
+    for k in raw:
+        try:
+            live = cfg.get(k)
+        except Exception:
+            continue
+        if live is not None:
+            raw[k] = live
+    out = {}
+    for k, v in sorted(raw.items()):
+        if any(m in k.upper() for m in _REDACT_MARKERS):
+            out[k] = "<redacted>"
+        else:
+            out[k] = v
+    return out
+
+
+def _device_topology() -> dict:
+    try:
+        import jax
+
+        devs = jax.devices()
+        out: dict = {
+            "platform": devs[0].platform,
+            "device_kind": devs[0].device_kind,
+            "devices": len(devs),
+            "process_count": jax.process_count(),
+        }
+        try:
+            stats = devs[0].memory_stats()
+            if stats:
+                out["hbm_bytes_in_use"] = stats.get("bytes_in_use")
+                out["hbm_bytes_limit"] = stats.get("bytes_limit")
+        except Exception:
+            pass
+        return out
+    except Exception as e:  # jax absent or backend init failed
+        return {"error": repr(e)}
+
+
+def _json(w, payload, status: int = 200) -> None:
+    w.status = status
+    w.set_header("Content-Type", "application/json")
+    w.write(json.dumps(payload, default=str).encode())
+
+
+def _html(w, title: str, body: str) -> None:
+    w.set_header("Content-Type", "text/html; charset=utf-8")
+    w.write((
+        "<!doctype html><html><head><title>" + html.escape(title)
+        + "</title><style>body{font-family:monospace;margin:1.5em}"
+        "table{border-collapse:collapse}td,th{border:1px solid #999;"
+        "padding:2px 8px;text-align:left}th{background:#eee}</style>"
+        "</head><body>" + body + "</body></html>").encode())
+
+
+def install_debug_routes(router, app) -> None:
+    """Register the /debug pages on ``router`` (the metrics router).
+
+    ``app`` is the App: config, container, and (via the container) the
+    observe state and TPU engine are all reachable from it."""
+    observe = app.container.observe
+
+    def index(req, w) -> None:
+        _html(w, "debug", (
+            "<h2>gofr_tpu debug</h2><ul>"
+            '<li><a href="/debug/requests">/debug/requests</a>'
+            " — in-flight requests</li>"
+            '<li><a href="/debug/events">/debug/events</a>'
+            " — flight recorder</li>"
+            '<li><a href="/debug/vars">/debug/vars</a>'
+            " — config, topology, engine state</li>"
+            '<li><a href="/debug/pprof/profile?seconds=1">'
+            "/debug/pprof/profile</a> — wall-clock sampling profile</li>"
+            '<li><a href="/metrics">/metrics</a> — Prometheus</li></ul>'))
+
+    def requests_page(req, w) -> None:
+        snap = observe.requests.snapshot()
+        if req.param("format") == "json":
+            _json(w, {"active": snap, "count": len(snap),
+                      "total_started": observe.requests.total_started})
+            return
+        rows = "".join(
+            "<tr><td>{id}</td><td>{kind}</td><td>{name}</td>"
+            "<td>{stage}</td><td>{age:.3f}s</td><td>{tokens}</td>"
+            "<td>{trace}</td></tr>".format(
+                id=e["id"], kind=html.escape(e["kind"]),
+                name=html.escape(e["name"]), stage=html.escape(e["stage"]),
+                age=e["age_s"], tokens=e["tokens"],
+                trace=html.escape(e["trace_id"] or "-"))
+            for e in snap)
+        _html(w, "in-flight requests", (
+            f"<h2>{len(snap)} in-flight request(s)</h2>"
+            "<table><tr><th>id</th><th>kind</th><th>name</th><th>stage</th>"
+            "<th>age</th><th>tokens</th><th>trace id</th></tr>"
+            + rows + "</table>"))
+
+    def events_page(req, w) -> None:
+        try:
+            limit = int(req.param("n", "256"))
+        except ValueError:
+            limit = 256
+        request_id: "int | None" = None
+        if req.param("request_id"):
+            try:
+                request_id = int(req.param("request_id"))
+            except ValueError:
+                return _json(w, {"error": "request_id must be an int"}, 400)
+        events = observe.recorder.events(
+            limit=limit, event=req.param("event") or None,
+            request_id=request_id)
+        _json(w, {"events": events, **observe.recorder.stats()})
+
+    def vars_page(req, w) -> None:
+        payload: dict = {
+            "app": {
+                "name": app.container.app_name,
+                "version": app.container.app_version,
+                "http_port": app.http_port,
+                "metrics_port": app.metrics_port,
+                "threads": threading.active_count(),
+                "python": sys.version.split()[0],
+            },
+            "config": _redact_config(app.config),
+            "devices": _device_topology(),
+            "inflight": len(observe.requests),
+            "recorder": observe.recorder.stats(),
+        }
+        tpu = app.container.tpu
+        if tpu is not None:
+            engine: dict = {
+                "model": tpu.model_name,
+                "programs": sorted(getattr(tpu, "_programs", {})),
+                "batchers": {
+                    name: {"queue_depth": b.queue_depth(),
+                           "max_batch": b.max_batch,
+                           "max_delay": b.max_delay}
+                    for name, b in getattr(tpu, "_batchers", {}).items()},
+            }
+            if tpu.generator is not None:
+                engine["generator"] = tpu.generator.stats()
+            payload["tpu"] = engine
+        _json(w, payload)
+
+    def profile_page(req, w) -> None:
+        try:
+            seconds = float(req.param("seconds", "1"))
+            hz = float(req.param("hz", "100"))
+        except ValueError:
+            return _json(w, {"error": "seconds/hz must be numbers"}, 400)
+        if seconds < 0 or seconds > MAX_PROFILE_SECONDS:
+            return _json(
+                w, {"error": f"seconds must be in [0, {MAX_PROFILE_SECONDS}]"},
+                400)
+        if not 0 < hz <= MAX_PROFILE_HZ:
+            # an unbounded rate would turn the sampler's sleep into a
+            # busy-spin that holds the GIL for the whole window
+            return _json(w, {"error": f"hz must be in (0, {MAX_PROFILE_HZ}]"},
+                         400)
+        if not _profile_lock.acquire(blocking=False):
+            return _json(w, {"error": "a profile is already running"}, 409)
+        try:
+            counts = profiler.collect_profile(seconds=seconds, hz=hz)
+        finally:
+            _profile_lock.release()
+        w.set_header("Content-Type", "text/plain; charset=utf-8")
+        w.set_header("X-Profile-Samples", str(sum(counts.values())))
+        w.write(profiler.render_collapsed(counts).encode())
+
+    router.add("GET", "/debug", index)
+    router.add("GET", "/debug/requests", requests_page)
+    router.add("GET", "/debug/events", events_page)
+    router.add("GET", "/debug/vars", vars_page)
+    router.add("GET", "/debug/pprof/profile", profile_page)

@@ -49,6 +49,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .pallas_compat import CompilerParams
+
 from .attention import NEG_INF, decode_attention_appended
 
 _LANES = 128
@@ -193,7 +195,7 @@ def _flash_decode_cache(q, k_cache, v_cache, lengths, k_scale, v_scale,
             jax.ShapeDtypeStruct((b, h, _LANES), jnp.float32),
             jax.ShapeDtypeStruct((b, h, _LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(lengths.astype(jnp.int32), q_bd, k_cache, v_cache, ks_t, vs_t)

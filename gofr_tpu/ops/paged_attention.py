@@ -44,6 +44,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .pallas_compat import CompilerParams
+
 from .attention import NEG_INF, decode_attention_appended
 from .flash_decode import _LANES, _decode_kernel
 
@@ -117,7 +119,7 @@ def _paged_decode_cache(q, k_pool, v_pool, table, lengths, k_scale, v_scale,
             jax.ShapeDtypeStruct((b, h, _LANES), jnp.float32),
             jax.ShapeDtypeStruct((b, h, _LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(lengths.astype(jnp.int32), table.astype(jnp.int32),

@@ -1,0 +1,14 @@
+"""Cross-version Pallas TPU aliases.
+
+jax renamed ``pltpu.TPUCompilerParams`` to ``pltpu.CompilerParams``;
+resolve the name once here, locally, instead of monkeypatching the
+upstream module (which would silently change behavior for any other
+code importing pallas in the same process).
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as _pltpu
+
+CompilerParams = getattr(_pltpu, "CompilerParams", None) \
+    or _pltpu.TPUCompilerParams

@@ -103,7 +103,8 @@ def _parse_mesh(spec: str | None):
     return make_mesh(**axes)
 
 
-def new_engine_from_config(cfg, logger=None, metrics=None) -> TPUEngine:
+def new_engine_from_config(cfg, logger=None, metrics=None,
+                           observe=None) -> TPUEngine:
     from ..models import BERT_CONFIGS, LLAMA_CONFIGS, VIT_CONFIGS
 
     name = (cfg.get("TPU_MODEL") or "tiny").strip()
@@ -113,7 +114,7 @@ def new_engine_from_config(cfg, logger=None, metrics=None) -> TPUEngine:
     seq_buckets = _csv_ints(cfg.get("TPU_SEQ_BUCKETS"), DEFAULT_SEQ_BUCKETS)
 
     engine = TPUEngine(logger=logger, metrics=metrics, max_delay=max_delay,
-                       mesh=mesh, model_name=name)
+                       mesh=mesh, model_name=name, observe=observe)
 
     weights = cfg.get("TPU_WEIGHTS")
     quant = (cfg.get("TPU_QUANT") or "").lower() == "int8"
@@ -170,7 +171,8 @@ def new_engine_from_config(cfg, logger=None, metrics=None) -> TPUEngine:
         prompt_b = tuple(b for b in seq_buckets if b < max_seq) or (max_seq // 2,)
         engine.generator = GenerationEngine(
             mc, params, slots=slots, max_seq=max_seq, prompt_buckets=prompt_b,
-            logger=logger, metrics=metrics, mesh=mesh, kv_dtype=kv_dtype,
+            logger=logger, metrics=metrics, observe=observe, mesh=mesh,
+            kv_dtype=kv_dtype,
             decode_block=cfg.get_int("TPU_DECODE_BLOCK", 4),
             admit_window_ms=cfg.get_float("TPU_ADMIT_WINDOW_MS", 2.0),
             prefix_cache_slots=cfg.get_int("TPU_PREFIX_CACHE", 0),

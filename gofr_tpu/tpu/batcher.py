@@ -52,7 +52,8 @@ class CoalescingBatcher:
     def __init__(self, runner: Callable[[list], Sequence], max_batch: int,
                  max_delay: float = 0.005, name: str = "batcher",
                  on_dispatch: Callable[[int, float], None] | None = None,
-                 use_native: bool = True):
+                 use_native: bool = True,
+                 on_queue_depth: Callable[[int], None] | None = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.runner = runner
@@ -60,6 +61,9 @@ class CoalescingBatcher:
         self.max_delay = max_delay
         self.name = name
         self.on_dispatch = on_dispatch  # (batch_size, oldest_wait_s) -> None
+        # (queued_items,) -> None: fired on enqueue and after each batch
+        # take, so a queue-depth gauge tracks the wait line in real time
+        self.on_queue_depth = on_queue_depth
         self._queue: list[BatchItem] = []
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
@@ -84,6 +88,17 @@ class CoalescingBatcher:
             name=f"gofr-{name}", daemon=True)
         self._thread.start()
 
+    def queue_depth(self) -> int:
+        """Items waiting for (or inside) a dispatch right now."""
+        return len(self._items) if self._native is not None else len(self._queue)
+
+    def _report_depth(self) -> None:
+        if self.on_queue_depth is not None:
+            try:
+                self.on_queue_depth(self.queue_depth())
+            except Exception:
+                pass  # telemetry must never take the batcher down
+
     # -- producer side -------------------------------------------------------
     def submit(self, payload: Any, timeout: float | None = None) -> Any:
         """Block until the batched result for ``payload`` is ready."""
@@ -104,6 +119,7 @@ class CoalescingBatcher:
                     raise BatcherClosed(f"{self.name} is closed")
                 self._queue.append(item)
                 self._nonempty.notify()
+        self._report_depth()
         if not item.done.wait(timeout):
             item.error = TimeoutError(f"{self.name}: no result in {timeout}s")
             raise item.error
@@ -154,6 +170,7 @@ class CoalescingBatcher:
             batch = self._take_batch()
             if batch is None:
                 return
+            self._report_depth()
             self._run_one(batch, time.monotonic() - batch[0].enqueued_at)
 
     def _native_loop(self) -> None:
@@ -163,6 +180,7 @@ class CoalescingBatcher:
                 return
             with self._lock:
                 batch = [self._items.pop(i) for i in ids if i in self._items]
+            self._report_depth()
             if batch:
                 self._run_one(batch, oldest_wait)
 

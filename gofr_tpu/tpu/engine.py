@@ -79,9 +79,10 @@ class TPUEngine:
     """
 
     def __init__(self, logger=None, metrics=None, max_delay: float = 0.004,
-                 mesh=None, model_name: str = ""):
+                 mesh=None, model_name: str = "", observe=None):
         self.logger = logger
         self.metrics = metrics
+        self.observe = observe  # Observe bundle (registry + flight recorder)
         self.max_delay = max_delay
         self.mesh = mesh
         self.model_name = model_name
@@ -114,11 +115,21 @@ class TPUEngine:
             self._batchers[name] = CoalescingBatcher(
                 runner=lambda items, p=prog: self._run_batch(p, items),
                 max_batch=prog.max_batch, max_delay=self.max_delay,
-                name=f"tpu-{name}", on_dispatch=self._dispatch_metrics(prog))
+                name=f"tpu-{name}", on_dispatch=self._dispatch_metrics(prog),
+                on_queue_depth=self._depth_gauge(name))
         if self.logger is not None:
             self.logger.info({"event": "tpu program registered", "program": name,
                               "kind": kind, "batch_buckets": list(prog.batch_buckets)})
         return prog
+
+    def _depth_gauge(self, program: str):
+        if self.metrics is None:
+            return None
+
+        def hook(depth: int) -> None:
+            self.metrics.set_gauge("app_tpu_queue_depth", float(depth),
+                                   program=program)
+        return hook
 
     def _dispatch_metrics(self, prog: Program):
         def hook(batch_size: int, oldest_wait: float) -> None:
@@ -185,15 +196,37 @@ class TPUEngine:
                            f"{sorted(self._programs)}")
         self._validate_item(self._programs[program], item)
         t0 = time.monotonic()
+        entry = None
+        if self.observe is not None:
+            from .. import tracing
+
+            span = tracing.current_span()
+            entry = self.observe.requests.add(
+                "predict", program, span.trace_id if span else "",
+                stage="batch-wait")
+        failed = None
         try:
             return batcher.submit(item, timeout=timeout)
+        except BaseException as e:
+            failed = e
+            raise
         finally:
+            dur = time.monotonic() - t0
+            if self.observe is not None:
+                self.observe.requests.remove(entry)
+                if failed is not None:
+                    # no request_id: that field is the generation-stream
+                    # counter's namespace; a registry-entry id here would
+                    # collide with it on /debug/events filters
+                    self.observe.recorder.record(
+                        "predict_failed",
+                        trace_id=entry.trace_id, program=program,
+                        duration_s=round(dur, 6), error=repr(failed))
             if self.metrics is not None:
                 self.metrics.increment_counter("app_tpu_requests_total",
                                                program=program)
                 self.metrics.record_histogram("app_tpu_predict_duration",
-                                              time.monotonic() - t0,
-                                              program=program)
+                                              dur, program=program)
 
     def predict_batch(self, program: str, items: list) -> list:
         """Direct batched execution, bypassing the coalescing queue (for

@@ -116,6 +116,14 @@ class GenStream:
         # client attribute its observed TTFT to admission wait vs
         # prefill vs delivery wake-up (tools/ttft_probe.py).
         self.trace: dict[str, float] = {}
+        # flight-recorder state (set by generate() when the engine has an
+        # Observe bundle): the request's W3C trace context — inherited
+        # from the submitting thread's span or minted fresh — and its
+        # in-flight registry entry
+        self.traceparent: str | None = None
+        self.trace_id: str = ""
+        self.obs_entry = None
+        self.failed: str | None = None  # set by the loop's error handler
 
     def __iter__(self) -> "Iterator[int] | Iterator[tuple[int, float]]":
         while True:
@@ -169,12 +177,13 @@ class _Inflight:
 
 
 class _Slot:
-    __slots__ = ("request", "remaining", "generated")
+    __slots__ = ("request", "remaining", "generated", "last_token_t")
 
     def __init__(self):
         self.request: _Request | None = None
         self.remaining = 0
         self.generated = 0
+        self.last_token_t = 0.0  # monotonic time of the last delivery
 
     @property
     def free(self) -> bool:
@@ -185,7 +194,8 @@ class GenerationEngine:
     def __init__(self, cfg: ModelConfig, params: Any, *, slots: int = 8,
                  max_seq: int | None = None,
                  prompt_buckets: tuple[int, ...] = (32, 64, 128, 256, 512),
-                 logger=None, metrics=None, seed: int = 0, mesh=None,
+                 logger=None, metrics=None, observe=None, seed: int = 0,
+                 mesh=None,
                  kv_dtype=None, decode_block: int = 4,
                  admit_window_ms: float = 2.0,
                  prefix_cache_slots: int = 0,
@@ -300,6 +310,8 @@ class GenerationEngine:
                                       or self.prompt_buckets[-1])
         self.logger = logger
         self.metrics = metrics
+        # flight recorder + in-flight registry + stage spans (observe/)
+        self._observe = observe
         self.mesh = mesh
         self.rope_tables = llama.get_rope_tables(cfg, self.max_seq)
 
@@ -803,17 +815,46 @@ class GenerationEngine:
                     "TPU_PAGED_BLOCK)"))
                 stream._q.put(None)
                 return stream
-        with self._admission_lock:
-            if self._closed:
-                raise GenerationError("generation engine is closed")
-            if self._draining:
-                # drain() sets the flag under this lock; without this
-                # re-check a racing generate() could slip a request in
-                # after the drain snapshot and silently extend the window
-                raise GenerationError("generation engine is draining")
-            self._pending.put(_Request(stream, prompt, max_new_tokens,
-                                       temperature, top_k, eos_id,
-                                       adapter=int(adapter)))
+        if self._observe is not None:
+            from .. import tracing
+
+            span = tracing.current_span()
+            if span is not None:  # inherit the submitter's trace context
+                stream.traceparent = span.traceparent()
+                stream.trace_id = span.trace_id
+            else:  # mint a trace id so the stage spans still correlate;
+                # no traceparent — they export as roots of that trace
+                # rather than children of a span nobody ever emits
+                stream.trace_id = tracing._new_trace_id()
+            # detail.request_id is the FLIGHT-RECORDER key: registry
+            # entry ids and stream request ids are separate counters, so
+            # /debug/requests must surface the one /debug/events filters
+            # by, or cross-referencing the two pages silently lies
+            stream.obs_entry = self._observe.requests.add(
+                "generate", "generate", stream.trace_id, stage="queued",
+                detail={"request_id": stream.request_id,
+                        "prompt_len": len(prompt),
+                        "max_new": max_new_tokens})
+            self._observe.recorder.record(
+                "submitted", request_id=stream.request_id,
+                trace_id=stream.trace_id, prompt_len=len(prompt),
+                max_new=max_new_tokens)
+        try:
+            with self._admission_lock:
+                if self._closed:
+                    raise GenerationError("generation engine is closed")
+                if self._draining:
+                    # drain() sets the flag under this lock; without this
+                    # re-check a racing generate() could slip a request in
+                    # after the drain snapshot and silently extend the window
+                    raise GenerationError("generation engine is draining")
+                self._pending.put(_Request(stream, prompt, max_new_tokens,
+                                           temperature, top_k, eos_id,
+                                           adapter=int(adapter)))
+        except BaseException:
+            self._obs_end(stream, "failed", error="rejected at admission")
+            raise
+        self._obs_gauges()
         self._work.set()
         return stream
 
@@ -1077,6 +1118,8 @@ class GenerationEngine:
             if slot.request is not None:
                 slot.request.stream._q.put(GenerationError("engine closed"))
                 slot.request.stream._q.put(None)
+                self._obs_end(slot.request.stream, "failed",
+                              error="engine closed")
                 slot.request = None
         while True:
             try:
@@ -1085,6 +1128,7 @@ class GenerationEngine:
                 break
             req.stream._q.put(GenerationError("engine closed"))
             req.stream._q.put(None)
+            self._obs_end(req.stream, "failed", error="engine closed")
 
     # -- the serving loop ----------------------------------------------------
     def _warm_last3(self):
@@ -1158,6 +1202,7 @@ class GenerationEngine:
                     return started
                 if req.stream.cancelled.is_set():
                     req.stream._q.put(None)
+                    self._obs_end(req.stream, "cancelled", tokens=0)
                     continue
                 blocks = None
                 if self._paged:
@@ -1496,10 +1541,68 @@ class GenerationEngine:
         self._pool = self._pool_store_jit(self._pool, self.cache,
                                           jnp.int32(row), jnp.int32(idx))
 
+    # -- flight-recorder plumbing (all no-ops without an Observe bundle) -----
+    def _obs_end(self, stream: GenStream, event: str, **fields) -> None:
+        """Remove the request's registry entry and record its terminal
+        lifecycle event (finished/failed/cancelled)."""
+        if self._observe is None:
+            return
+        self._observe.requests.remove(stream.obs_entry)
+        self._observe.recorder.record(event, request_id=stream.request_id,
+                                      trace_id=stream.trace_id, **fields)
+
+    def _obs_stage(self, stream: GenStream, stage: str) -> None:
+        if stream.obs_entry is not None:
+            stream.obs_entry.stage = stage
+
+    def _obs_span(self, name: str, start_s: float, end_s: float,
+                  stream: GenStream, attrs: dict | None = None) -> None:
+        """Export one per-stage serving span (admit wait / prefill /
+        decode), parented by the request's inbound trace context."""
+        obs = self._observe
+        if obs is None or obs.tracer is None:
+            return
+        try:
+            obs.tracer.record_span(name, start_s, end_s,
+                                   traceparent=stream.traceparent,
+                                   trace_id=stream.trace_id or None,
+                                   attributes=attrs)
+        except Exception:
+            pass  # telemetry must never take the serving loop down
+
+    def _record_itl(self, slot: _Slot, n: int) -> None:
+        """Record ``n`` inter-token-latency samples for a slot about to
+        receive ``n`` tokens from one reaped dispatch: the block interval
+        (time since the slot's previous delivery) amortized per token.
+        This is the DEVICE cadence a steady-state client observes, not
+        the microsecond host-loop gaps within one burst delivery."""
+        if self.metrics is None or n <= 0 or slot.last_token_t == 0.0:
+            return
+        gap = (time.monotonic() - slot.last_token_t) / n
+        for _ in range(n):
+            self.metrics.record_histogram("app_tpu_inter_token_duration",
+                                          gap, program="generate")
+
+    def _obs_gauges(self) -> None:
+        """Refresh the live-load gauges after admission/retirement."""
+        if self.metrics is None:
+            return
+        self.metrics.set_gauge("app_tpu_active_sequences",
+                               float(self._active.sum()))
+        self.metrics.set_gauge("app_tpu_queue_depth",
+                               float(self._pending.qsize()),
+                               program="generate")
+
     def _start(self, idx: int, slot: _Slot, req: _Request,
                blocks: "tuple | None" = None) -> None:
         t0 = time.monotonic()
         req.stream.trace["admit"] = t0
+        self._obs_stage(req.stream, "prefill")
+        if self._observe is not None:
+            self._observe.recorder.record(
+                "admitted", request_id=req.stream.request_id,
+                trace_id=req.stream.trace_id, slot=idx,
+                wait_s=round(t0 - req.enqueued_at, 6))
         try:
             if self._paged:
                 shared, m, fresh = blocks
@@ -1527,8 +1630,15 @@ class GenerationEngine:
                 self._alloc.free(shared + fresh)
             req.stream._q.put(GenerationError(f"prefill failed: {e!r}"))
             req.stream._q.put(None)
+            self._obs_end(req.stream, "failed", stage="prefill",
+                          error=repr(e))
             raise
-        req.stream.trace["prefill_done"] = time.monotonic()
+        prefill_done = time.monotonic()
+        req.stream.trace["prefill_done"] = prefill_done
+        self._obs_span("tpu.admit-wait", req.enqueued_at, t0, req.stream,
+                       {"slot": idx})
+        self._obs_span("tpu.prefill", t0, prefill_done, req.stream,
+                       {"slot": idx, "prompt_len": len(req.prompt)})
         self._prefix_store(idx, req)
         if self._spec_k:
             self._hist_set(idx, req.prompt)
@@ -1550,6 +1660,7 @@ class GenerationEngine:
             self._active[idx] = True
             self._host_wins[idx] = True
             self._touch("active", "last_tokens", "host_wins")
+        self._obs_gauges()
 
     def _deliver(self, idx: int, slot: _Slot, token: int,
                  lp: float | None = None) -> None:
@@ -1558,14 +1669,32 @@ class GenerationEngine:
         if req.stream.cancelled.is_set():
             self._retire(idx, slot)
             return
+        now = time.monotonic()
         if slot.generated == 0:  # first token: prefill_done -> first_put
             # is the prefix-store cost (a device row copy when an entry
             # is stored) — attributed separately from delivery wake-up
-            req.stream.trace["first_put"] = time.monotonic()
+            req.stream.trace["first_put"] = now
+            ttft = now - req.stream.trace["submit"]
+            if self.metrics is not None:
+                self.metrics.record_histogram("app_tpu_ttft_duration", ttft,
+                                              program="generate")
+            self._obs_stage(req.stream, "decode")
+            if self._observe is not None:
+                self._observe.recorder.record(
+                    "first_token", request_id=req.stream.request_id,
+                    trace_id=req.stream.trace_id, slot=idx,
+                    ttft_s=round(ttft, 6))
+        # inter-token latency is recorded at the REAP level (_record_itl),
+        # not here: a fused decode block delivers its K tokens back-to-back
+        # in one host loop, and per-delivery gaps would report microsecond
+        # burst artifacts instead of device cadence
+        slot.last_token_t = now
         req.stream._q.put((token, lp) if req.logprobs else token)
         slot.generated += 1
         slot.remaining -= 1
         self.total_tokens += 1
+        if req.stream.obs_entry is not None:
+            req.stream.obs_entry.tokens = slot.generated
         if self.metrics is not None:
             self.metrics.increment_counter("app_tpu_tokens_generated_total")
         at_eos = req.eos_id is not None and (
@@ -1577,6 +1706,31 @@ class GenerationEngine:
             self._retire(idx, slot)
 
     def _retire(self, idx: int, slot: _Slot) -> None:
+        stream = slot.request.stream
+        now = time.monotonic()
+        first = stream.trace.get("first_put")
+        decode_s = (now - first) if first is not None else 0.0
+        tps = slot.generated / decode_s if decode_s > 0 else 0.0
+        if self.metrics is not None and slot.generated > 1:
+            # throughput needs at least one inter-token interval
+            self.metrics.set_gauge("app_tpu_tokens_per_second", tps,
+                                   program="generate")
+        if first is not None and slot.generated > 0:
+            self._obs_span("tpu.decode", first, now, stream,
+                           {"slot": idx, "tokens": slot.generated})
+        event = ("failed" if stream.failed is not None
+                 else "cancelled" if stream.cancelled.is_set()
+                 else "finished")
+        fields = {"slot": idx, "tokens": slot.generated,
+                  "duration_s": round(now - stream.trace["submit"], 6),
+                  # throughput needs at least one inter-token interval —
+                  # a 1-token stream's first_put->retire gap is
+                  # microseconds and would report ~1e6 tok/s
+                  "tokens_per_s": (round(tps, 3)
+                                   if slot.generated > 1 else None)}
+        if stream.failed is not None:
+            fields["error"] = stream.failed
+        self._obs_end(stream, event, **fields)
         slot.request.stream._q.put(None)
         slot.request = None
         self._active[idx] = False
@@ -1594,6 +1748,7 @@ class GenerationEngine:
             self._table[idx, :] = 0
             self._cursors[idx] = 0
             self._touch("table")
+        self._obs_gauges()
 
     def _loop(self) -> None:
         while not self._closed:
@@ -1647,6 +1802,7 @@ class GenerationEngine:
                         self._prefix_idx.clear()
                 for idx, slot in enumerate(self._slots):
                     if slot.request is not None:
+                        slot.request.stream.failed = repr(e)
                         slot.request.stream._q.put(err)
                         self._retire(idx, slot)
                 try:
@@ -1715,6 +1871,7 @@ class GenerationEngine:
                             break
                         req.stream._q.put(down_err)
                         req.stream._q.put(None)
+                        self._obs_end(req.stream, "failed", error=self.down)
                     return
 
     def _admit_inflight(self, inflight: _Inflight) -> None:
@@ -1840,6 +1997,7 @@ class GenerationEngine:
         for idx, slot in enumerate(self._slots):
             if not snap_active[idx] or slot.request is not snap_reqs[idx]:
                 continue
+            self._record_itl(slot, emit_l[idx])
             for k in range(emit_l[idx]):
                 if not self._active[idx]:
                     break  # retired mid-window (EOS/budget/cancel)
@@ -1903,6 +2061,10 @@ class GenerationEngine:
         # bulk-convert once: per-element int()/float() on numpy scalars
         # costs real milliseconds per reap at high slot counts
         toks_l, lps_l = toks_np.tolist(), lps_np.tolist()
+        for idx, slot in enumerate(self._slots):
+            if snap_active[idx] and self._active[idx] \
+                    and slot.request is snap_reqs[idx]:
+                self._record_itl(slot, len(toks_l))
         for k in range(len(toks_l)):
             trow, lrow = toks_l[k], lps_l[k]
             for idx, slot in enumerate(self._slots):

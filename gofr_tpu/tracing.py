@@ -83,8 +83,13 @@ def parse_traceparent(header: str | None) -> tuple[str, str] | None:
     if len(trace_id) != 32 or len(span_id) != 16:
         return None
     try:
-        int(trace_id, 16), int(span_id, 16)
+        tid, sid = int(trace_id, 16), int(span_id, 16)
     except ValueError:
+        return None
+    if tid == 0 or sid == 0:
+        # W3C Trace Context: all-zero trace-id/parent-id are invalid
+        # values; propagating them would stitch unrelated requests into
+        # one "trace 000..0". Treat as absent — start a fresh trace.
         return None
     return trace_id, span_id
 
@@ -141,6 +146,45 @@ class Tracer:
         finally:
             s.end()
 
+    def record_span(
+        self,
+        name: str,
+        start_monotonic: float,
+        end_monotonic: float,
+        *,
+        traceparent: str | None = None,
+        trace_id: str | None = None,
+        attributes: dict[str, Any] | None = None,
+    ) -> Span:
+        """Export a span for an interval measured elsewhere (serving-loop
+        stage timings: admit wait, prefill, decode). Unlike start_span
+        this never touches the contextvar stack — the serving loop is
+        one thread multiplexing every request, so "current span" is
+        meaningless there — and the span arrives already finished.
+
+        ``trace_id`` correlates spans without claiming a parent: when no
+        valid ``traceparent`` exists, the span joins that trace as a
+        root instead of pointing at a phantom parent span id."""
+        ctx = parse_traceparent(traceparent)
+        if ctx is not None:
+            trace_id, parent_id = ctx
+        else:
+            trace_id, parent_id = trace_id or _new_trace_id(), None
+        now_mono, now_epoch = time.monotonic(), time.time()
+        span = Span(
+            name=name,
+            trace_id=trace_id,
+            span_id=_new_span_id(),
+            parent_id=parent_id,
+            start_ns=int(start_monotonic * 1e9),
+            start_epoch_us=int((now_epoch - (now_mono - start_monotonic)) * 1e6),
+            attributes=dict(attributes or {}),
+        )
+        span.end_ns = int(end_monotonic * 1e9)
+        if self.exporter is not None:
+            self.exporter.export(span, self.service_name)
+        return span
+
 
 class SpanExporter:
     def export(self, span: Span, service_name: str) -> None:  # pragma: no cover
@@ -172,6 +216,7 @@ class ZipkinExporter(SpanExporter):
         self._buf: list[dict] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        self._wake = threading.Event()  # full batch -> flush thread, now
         self._thread = threading.Thread(target=self._loop, daemon=True, name="zipkin-exporter")
         self._thread.start()
 
@@ -193,10 +238,16 @@ class ZipkinExporter(SpanExporter):
             if len(self._buf) >= self.batch_size:
                 flush_now = True
         if flush_now:
-            self._flush()
+            # hand the POST to the flush thread instead of doing it here:
+            # export() is called from request handlers AND the generation
+            # serving loop, and a slow collector must never block either
+            # (a 2 s urlopen on the loop thread would stall every stream)
+            self._wake.set()
 
     def _loop(self) -> None:
-        while not self._stop.wait(self.flush_interval):
+        while not self._stop.is_set():
+            self._wake.wait(self.flush_interval)
+            self._wake.clear()
             self._flush()
 
     def _flush(self) -> None:
@@ -215,7 +266,12 @@ class ZipkinExporter(SpanExporter):
             pass  # tracing must never take the app down
 
     def shutdown(self) -> None:
+        """Final flush on graceful shutdown. Joining the flush thread
+        matters: without it a clean exit could tear the interpreter down
+        mid-POST and silently drop the last batch of spans."""
         self._stop.set()
+        self._wake.set()  # unblock the interval wait immediately
+        self._thread.join(timeout=5.0)
         self._flush()
 
 

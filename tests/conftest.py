@@ -12,10 +12,22 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# The jax_num_cpu_devices config knob only exists on newer JAX; on older
+# releases (e.g. 0.4.37) the XLA flag is the only pre-initialization way
+# to fan the host platform out to 8 virtual devices. Set it BEFORE any
+# backend use (the asserts below are the first) so either path yields the
+# same 8-device CPU mesh.
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8").strip()
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # older JAX: the XLA_FLAGS fallback above covers it
 # Tests validate numerics: use exact f32 matmuls. Production keeps the
 # platform default (bf16 passes on the MXU), which is what we want on TPU.
 jax.config.update("jax_default_matmul_precision", "float32")
@@ -24,10 +36,16 @@ jax.config.update("jax_default_matmul_precision", "float32")
 # across modules (and across the judge's repeated suite runs); the disk
 # cache turns those into loads. Keyed by backend+topology+program, so
 # the virtual 8-device CPU mesh caches independently of TPU runs.
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                                 "/tmp/gofr_jax_test_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# DISABLED on jax 0.4.x: its executable (de)serialization intermittently
+# corrupts the glibc heap on the CPU backend ("corrupted double-linked
+# list" / segfaults at random later points — reproducibly bisected to
+# the cache via tests/test_paged.py::test_paged_engine_warmup_and_drain,
+# which is 6/6 clean cacheless and ~50% fatal cached).
+if jax.__version_info__ >= (0, 5):
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                     "/tmp/gofr_jax_test_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 assert jax.devices()[0].platform == "cpu", "tests must run on the CPU backend"
 assert len(jax.devices()) == 8, "tests expect a virtual 8-device CPU mesh"
@@ -50,6 +68,37 @@ def _release_compiled_executables_between_modules():
     module anyway."""
     yield
     import gc
+    import threading
+    import time
+
+    # Clear ONLY under real map-count pressure: on boxes with an
+    # effectively unlimited vm.max_map_count the guard buys nothing,
+    # while jax.clear_caches() itself is the hazard — on jaxlib 0.4.x
+    # it segfaults nondeterministically inside weakref-cache clearing
+    # after engine-heavy modules (observed reliably after test_paged,
+    # test_examples). Where the cap is real (the 65530 box this guard
+    # was written for) the 50% threshold still fires long before mmap
+    # starts failing inside the compiler.
+    try:
+        with open("/proc/self/maps") as f:
+            n_maps = sum(1 for _ in f)
+        with open("/proc/sys/vm/max_map_count") as f:
+            cap = int(f.read())
+    except OSError:
+        n_maps, cap = 0, 1 << 31
+    if n_maps < 0.5 * cap:
+        return
+
+    # A gofr-tpu-gen loop thread may still be winding down INSIDE a
+    # device dispatch (engine close() joins with a 10 s timeout; a chunk
+    # compile can exceed it). clear_caches() would free the executable
+    # out from under that running dispatch — drain those threads first,
+    # compile-sized bound, like pytest_sessionfinish below.
+    deadline = time.monotonic() + 120.0
+    while time.monotonic() < deadline and any(
+            t.name == "gofr-tpu-gen" and t.is_alive()
+            for t in threading.enumerate()):
+        time.sleep(0.2)
 
     jax.clear_caches()
     gc.collect()

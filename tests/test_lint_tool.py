@@ -81,3 +81,42 @@ def test_reexport_and_dunder_all_exempt(tmp_path):
 def test_syntax_error_reported_not_crash(tmp_path):
     rc, out = run_lint(tmp_path, "def f(:\n")
     assert rc == 1 and "E999" in out
+
+
+def run_lint_at(path, source: str):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(source)
+    p = subprocess.run([sys.executable, str(LINT), str(path)],
+                       capture_output=True, text=True)
+    return p.returncode, p.stdout
+
+
+def test_bare_print_in_framework_is_t201(tmp_path):
+    # all framework output must go through glog so every line carries
+    # trace correlation — bare print() inside gofr_tpu/ is a finding
+    rc, out = run_lint_at(tmp_path / "gofr_tpu" / "mod.py",
+                          'print("debugging")\n')
+    assert rc == 1 and "T201" in out
+
+
+def test_print_outside_framework_is_allowed(tmp_path):
+    # tests/tools/examples print freely; the rule is scoped to gofr_tpu/
+    rc, out = run_lint(tmp_path, 'print("fine here")\n')
+    assert "T201" not in out, out
+
+
+def test_print_with_noqa_is_exempt(tmp_path):
+    # CLI command output (the command's product, not logging) opts out
+    # per line — the escape hatch gofr_tpu/cli.py uses
+    rc, out = run_lint_at(tmp_path / "gofr_tpu" / "cli_like.py",
+                          'import sys\n\nprint("out", file=sys.stderr)'
+                          '  # noqa: T201\n')
+    assert "T201" not in out, out
+
+
+def test_noqa_inside_string_literal_does_not_exempt(tmp_path):
+    # a '#' inside the print's string argument is not a comment; only a
+    # real noqa comment token may grant the exemption
+    rc, out = run_lint_at(tmp_path / "gofr_tpu" / "sneaky.py",
+                          'print("see # noqa: T201 in docs")\n')
+    assert rc == 1 and "T201" in out

@@ -58,6 +58,113 @@ def test_histogram_buckets_cumulative():
     assert "lat_sum 55.55" in text
 
 
+def test_label_value_escaping_roundtrip():
+    # exposition format 0.0.4: label values escape backslash and quote;
+    # a conformant scraper unescaping the rendered line must recover the
+    # exact recorded value
+    import re
+
+    m = Manager()
+    m.new_counter("esc")
+    tricky = 'a\\b"c\\\\d'
+    m.increment_counter("esc", path=tricky)
+    text = m.render_prometheus()
+    line = next(l for l in text.splitlines() if l.startswith("esc{"))
+    match = re.fullmatch(r'esc\{path="((?:[^"\\]|\\.)*)"\} 1\.0', line)
+    assert match, f"malformed exposition line: {line!r}"
+    unescaped = re.sub(r"\\(.)", r"\1", match.group(1))
+    assert unescaped == tricky
+
+
+def _assert_histogram_monotone(text: str, name: str):
+    import re
+
+    buckets = []
+    inf = count = None
+    for line in text.splitlines():
+        m_b = re.match(rf'{name}_bucket\{{le="([^"]+)"\}} (\d+)', line)
+        if m_b:
+            if m_b.group(1) == "+Inf":
+                inf = int(m_b.group(2))
+            else:
+                buckets.append((float(m_b.group(1)), int(m_b.group(2))))
+        elif line.startswith(f"{name}_count"):
+            count = int(line.split()[-1])
+    assert buckets and inf is not None and count is not None
+    for (_, a), (_, b) in zip(buckets, buckets[1:]):
+        assert a <= b, f"bucket counts not monotone in: {text}"
+    assert buckets[-1][1] <= inf == count
+
+
+def _hammer_histogram_while_scraping(m: Manager):
+    import threading
+
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            for v in (0.05, 0.5, 5.0, 50.0):
+                m.record_histogram("lat", v)
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            _assert_histogram_monotone(m.render_prometheus(), "lat")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    # quiesced: every write fully applied, totals self-consistent
+    _assert_histogram_monotone(m.render_prometheus(), "lat")
+
+
+def test_histogram_concurrent_scrape_monotone_native():
+    from gofr_tpu.native import available
+
+    if not available():
+        import pytest as _pytest
+
+        _pytest.skip("native runtime unavailable")
+    m = Manager()
+    m.new_histogram("lat", "latency", buckets=[0.1, 1.0, 10.0])
+    _hammer_histogram_while_scraping(m)
+
+
+def test_histogram_concurrent_scrape_monotone_pure_python(monkeypatch):
+    import gofr_tpu.native as native
+
+    monkeypatch.setattr(native, "available", lambda: False)
+    m = Manager()
+    m.new_histogram("lat", "latency", buckets=[0.1, 1.0, 10.0])
+    _hammer_histogram_while_scraping(m)
+    # the fallback representation really was the locked python list
+    assert all(type(v) is list for v in m._metrics["lat"].series.values())
+
+
+def test_trace_ids_stitched_into_structured_log_lines():
+    # every structured (JSON) log line emitted inside a span must carry
+    # the span's trace/span ids — the log<->trace correlation the whole
+    # observability story hangs on
+    import io
+    import json as _json
+
+    from gofr_tpu.glog import Logger, LogLevel
+    from gofr_tpu.tracing import Tracer
+
+    buf = io.StringIO()
+    log = Logger(level=LogLevel.INFO, out=buf, err=buf, pretty=False)
+    t = Tracer("svc")
+    with t.span("unit-of-work") as span:
+        log.info({"event": "inside"})
+    log.info({"event": "outside"})
+    inside, outside = [_json.loads(l) for l in buf.getvalue().splitlines()]
+    assert inside["trace_id"] == span.trace_id
+    assert inside["span_id"] == span.span_id
+    assert "trace_id" not in outside
+
+
 def test_framework_metrics_register_and_system_update():
     m = Manager()
     register_framework_metrics(m)

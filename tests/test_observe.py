@@ -1,0 +1,281 @@
+"""Tests for gofr_tpu/observe — the inference flight recorder and the
+/debug introspection pages, unit-level and through the full App
+(HTTP -> batcher -> generator) on the CPU backend."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gofr_tpu import App
+from gofr_tpu.config import MapConfig
+from gofr_tpu.observe import FlightRecorder, RequestRegistry
+from gofr_tpu.observe.profiler import collect_profile, render_collapsed
+
+
+def _get(port, path, timeout=10):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+# -- registry ---------------------------------------------------------------
+
+def test_registry_add_update_remove():
+    reg = RequestRegistry()
+    a = reg.add("http", "GET /x", "ab" * 16, stage="handler")
+    b = reg.add("generate", "generate", stage="queued",
+                detail={"prompt_len": 7})
+    assert len(reg) == 2 and reg.total_started == 2
+    b.stage = "decode"
+    b.tokens = 5
+    snap = reg.snapshot()
+    assert [e["name"] for e in snap] == ["GET /x", "generate"]  # oldest first
+    gen = snap[1]
+    assert gen["stage"] == "decode" and gen["tokens"] == 5
+    assert gen["detail"] == {"prompt_len": 7}
+    assert gen["age_s"] >= 0
+    assert snap[0]["trace_id"] == "ab" * 16
+    reg.remove(a)
+    reg.remove(a)  # idempotent
+    reg.remove(None)  # tolerated
+    assert len(reg) == 1
+    reg.remove(b)
+    assert reg.snapshot() == []
+
+
+# -- flight recorder --------------------------------------------------------
+
+def test_recorder_ring_buffer_and_filters():
+    rec = FlightRecorder(capacity=4)
+    for i in range(6):
+        rec.record("submitted", request_id=i, prompt_len=i * 10)
+    rec.record("finished", request_id=5, tokens=3)
+    events = rec.events()
+    assert len(events) == 4  # bounded: oldest fell off
+    assert rec.stats() == {"capacity": 4, "buffered": 4,
+                           "total_recorded": 7, "dropped": 3}
+    assert [e["seq"] for e in events] == sorted(e["seq"] for e in events)
+    assert rec.events(event="finished")[0]["tokens"] == 3
+    assert all(e["request_id"] == 5 for e in rec.events(request_id=5))
+    assert len(rec.events(limit=2)) == 2
+    assert rec.events(since_seq=events[-1]["seq"]) == []
+
+
+def test_recorder_rejects_bad_capacity():
+    with pytest.raises(ValueError):
+        FlightRecorder(capacity=0)
+
+
+# -- profiler ---------------------------------------------------------------
+
+def test_profiler_collapsed_stacks_capture_a_named_thread():
+    marker = threading.Event()
+
+    def parked_in_wait_for_profiler():
+        marker.wait(10.0)
+
+    t = threading.Thread(target=parked_in_wait_for_profiler,
+                         name="observe-test-parked")
+    t.start()
+    try:
+        counts = collect_profile(seconds=0.25, hz=200)
+    finally:
+        marker.set()
+        t.join()
+    text = render_collapsed(counts)
+    assert "observe-test-parked;" in text
+    assert "parked_in_wait_for_profiler" in text
+    line = next(l for l in text.splitlines()
+                if l.startswith("observe-test-parked;"))
+    stack, count = line.rsplit(" ", 1)
+    assert int(count) >= 1
+    # root-first: the thread entry point precedes the leaf wait frame
+    assert stack.index("parked_in_wait_for_profiler") < stack.index("wait")
+
+
+# -- /debug pages on a plain app (no TPU) -----------------------------------
+
+@pytest.fixture
+def app():
+    a = App(MapConfig({"HTTP_PORT": "0", "METRICS_PORT": "0",
+                       "APP_NAME": "observe-test",
+                       "API_SECRET_TOKEN": "hush"}))
+    yield a
+    if a._running.is_set():
+        a.stop()
+
+
+def test_debug_requests_shows_inflight_http_request(app):
+    release = threading.Event()
+
+    @app.get("/slow")
+    def slow(ctx):
+        release.wait(30.0)
+        return "done"
+
+    app.run(block=False)
+    t = threading.Thread(target=lambda: _get(app.http_port, "/slow", 60))
+    t.start()
+    try:
+        entry = None
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and entry is None:
+            _, body, _ = _get(app.metrics_port, "/debug/requests?format=json")
+            active = json.loads(body)["active"]
+            entry = next((e for e in active if e["name"] == "GET /slow"), None)
+            time.sleep(0.02)
+        assert entry is not None, "in-flight request never appeared"
+        assert entry["kind"] == "http" and entry["stage"] == "handler"
+        assert len(entry["trace_id"]) == 32  # stitched from the tracer span
+        assert entry["age_s"] >= 0
+        # the HTML rendering carries the same rows
+        _, html_body, headers = _get(app.metrics_port, "/debug/requests")
+        assert "text/html" in headers["Content-Type"]
+        assert b"GET /slow" in html_body
+    finally:
+        release.set()
+        t.join(timeout=30)
+    # after completion the table drains
+    _, body, _ = _get(app.metrics_port, "/debug/requests?format=json")
+    assert all(e["name"] != "GET /slow"
+               for e in json.loads(body)["active"])
+
+
+def test_debug_vars_redacts_secrets_and_reports_topology(app):
+    app.run(block=False)
+    _, body, _ = _get(app.metrics_port, "/debug/vars")
+    payload = json.loads(body)
+    assert payload["app"]["name"] == "observe-test"
+    assert payload["config"]["API_SECRET_TOKEN"] == "<redacted>"
+    assert payload["devices"]["platform"] == "cpu"
+    assert payload["devices"]["devices"] == 8
+    assert payload["recorder"]["capacity"] == 2048
+
+
+def test_debug_index_and_pprof_profile(app):
+    app.run(block=False)
+    status, body, _ = _get(app.metrics_port, "/debug")
+    assert status == 200 and b"/debug/pprof/profile" in body
+    status, body, headers = _get(app.metrics_port,
+                                 "/debug/pprof/profile?seconds=0.2&hz=200")
+    assert status == 200
+    assert "text/plain" in headers["Content-Type"]
+    assert int(headers["X-Profile-Samples"]) > 0
+    # collapsed-stack lines: "frame;frame;... count"
+    first = body.decode().splitlines()[0]
+    stack, count = first.rsplit(" ", 1)
+    assert ";" in stack and int(count) >= 1
+    # guard rails on the knobs
+    status, _, _ = _get(app.metrics_port, "/debug/pprof/profile?seconds=9999")
+    assert status == 400
+    status, _, _ = _get(app.metrics_port, "/debug/pprof/profile?seconds=nan2")
+    assert status == 400
+    # an unbounded sample rate would busy-spin the GIL for the window
+    status, _, _ = _get(app.metrics_port,
+                        "/debug/pprof/profile?seconds=1&hz=1000000000")
+    assert status == 400
+
+
+def test_debug_events_bad_request_id_is_400(app):
+    app.run(block=False)
+    status, _, _ = _get(app.metrics_port, "/debug/events?request_id=xyz")
+    assert status == 400
+
+
+# -- acceptance: the full serving path on the CPU backend -------------------
+
+def test_full_app_generation_flight_recorder_and_telemetry():
+    """Drive HTTP -> batcher -> generator end to end: /debug/requests
+    must show the in-flight generation (stage + age + trace id) WHILE it
+    runs, and /metrics must expose non-empty TTFT and inter-token
+    histograms after it completes (ISSUE acceptance criteria)."""
+    app = App(MapConfig({"HTTP_PORT": "0", "METRICS_PORT": "0",
+                         "TPU_MODEL": "tiny", "TPU_MAX_SEQ": "128",
+                         "TPU_SLOTS": "2", "TPU_SEQ_BUCKETS": "8,16"}))
+
+    @app.get("/gen")
+    def gen(ctx):
+        return {"tokens": ctx.tpu.generate(
+            [1, 2, 3], max_new_tokens=100).tokens()}
+
+    app.run(block=False)
+    try:
+        results = []
+
+        def client():
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{app.http_port}/gen",
+                    timeout=300) as r:
+                results.append(json.loads(r.read()))
+
+        t = threading.Thread(target=client)
+        t.start()
+        gen_entry = http_entry = None
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline and gen_entry is None:
+            _, body, _ = _get(app.metrics_port, "/debug/requests?format=json")
+            active = json.loads(body)["active"]
+            gen_entry = next((e for e in active if e["kind"] == "generate"),
+                             None)
+            http_entry = next((e for e in active if e["kind"] == "http"),
+                              http_entry)
+            time.sleep(0.02)
+        assert gen_entry is not None, "generation never showed in-flight"
+        assert gen_entry["stage"] in ("queued", "prefill", "decode")
+        assert gen_entry["age_s"] >= 0
+        assert len(gen_entry["trace_id"]) == 32
+        # generate() inherited the HTTP request's trace context
+        assert http_entry is not None and http_entry["name"] == "GET /gen"
+        assert gen_entry["trace_id"] == http_entry["trace_id"]
+        t.join(timeout=300)
+        assert not t.is_alive()
+        assert len(results[0]["data"]["tokens"]) == 100
+
+        # -- /metrics: non-empty serving histograms --------------------------
+        _, body, _ = _get(app.metrics_port, "/metrics")
+        text = body.decode()
+
+        def series_count(name):
+            line = next(l for l in text.splitlines()
+                        if l.startswith(f'{name}_count{{program="generate"}}'))
+            return int(float(line.split()[-1]))
+
+        assert series_count("app_tpu_ttft_duration") >= 1
+        assert series_count("app_tpu_inter_token_duration") >= 99
+        assert 'app_tpu_active_sequences 0.0' in text  # drained
+        assert 'app_tpu_queue_depth{program="generate"} 0.0' in text
+        tps = next(l for l in text.splitlines()
+                   if l.startswith("app_tpu_tokens_per_second"))
+        assert float(tps.split()[-1]) > 0
+
+        # -- /debug/events: the request's full lifecycle ----------------------
+        rid = gen_entry["id"]
+        _, body, _ = _get(app.metrics_port, "/debug/events")
+        events = json.loads(body)["events"]
+        mine = [e for e in events
+                if e.get("trace_id") == gen_entry["trace_id"]]
+        kinds = [e["event"] for e in mine]
+        for expected in ("submitted", "admitted", "first_token", "finished"):
+            assert expected in kinds, f"missing {expected} in {kinds}"
+        finished = next(e for e in mine if e["event"] == "finished")
+        assert finished["tokens"] == 100
+        assert finished["duration_s"] > 0
+        first_token = next(e for e in mine if e["event"] == "first_token")
+        assert first_token["ttft_s"] > 0
+        del rid
+
+        # -- /debug/vars: engine + generator state ----------------------------
+        _, body, _ = _get(app.metrics_port, "/debug/vars")
+        payload = json.loads(body)
+        assert payload["tpu"]["model"] == "tiny"
+        assert payload["tpu"]["generator"]["total_requests"] >= 1
+        assert "score" in payload["tpu"]["batchers"]
+    finally:
+        app.stop()

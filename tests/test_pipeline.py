@@ -11,6 +11,16 @@ import pytest
 from gofr_tpu import parallel
 from gofr_tpu.models import LLAMA_CONFIGS
 
+# The pp conveyor requires partial-auto shard_map (manual over pp/sp,
+# auto elsewhere). Pre-0.4.35 jax only has the experimental API, whose
+# auto= mode cannot lower axis_index inside the manual region on this
+# backend (UNIMPLEMENTED: PartitionId under SPMD) — the execution tests
+# can only run where the capability exists. Config validation is pure
+# host logic and stays unconditional.
+requires_partial_auto = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="partial-auto shard_map unavailable on this jax")
+
 CFG = LLAMA_CONFIGS["tiny"].with_(n_layers=4, max_seq=32)
 
 
@@ -22,6 +32,7 @@ def _data(b=8, s=32):
     return tokens, lengths
 
 
+@requires_partial_auto
 def test_pp_loss_matches_dense_step():
     opt = parallel.default_optimizer(lr=1e-3, warmup=1, total_steps=10)
     tokens, lengths = _data()
@@ -48,6 +59,7 @@ def test_pp_loss_matches_dense_step():
     assert spec[0] == "pp" and spec[-1] == "tp"
 
 
+@requires_partial_auto
 def test_pp_step_learns_and_remat_matches():
     opt = parallel.default_optimizer(lr=1e-2, warmup=1, total_steps=20)
     tokens, lengths = _data()
@@ -63,6 +75,7 @@ def test_pp_step_learns_and_remat_matches():
     assert losses[-1] < losses[0], losses
 
 
+@requires_partial_auto
 def test_pp_sp_ring_conveyor_matches_dense_step():
     """pp x sp: sequence-sharded stages with RING attention inside the
     conveyor (the ring's ppermutes over sp compose with the conveyor's
@@ -92,6 +105,7 @@ def test_pp_sp_ring_conveyor_matches_dense_step():
     assert np.isfinite(float(m2["loss"]))
 
 
+@requires_partial_auto
 def test_pp_composes_with_ep_dense_moe_and_matches_aux():
     """3-axis composition pp x ep x dp on a dense-dispatch MoE: expert
     dim over ep, layer dim over pp, batch over (dp, ep). Loss AND the

@@ -1,4 +1,5 @@
-from gofr_tpu.tracing import InMemoryExporter, Tracer, current_span, parse_traceparent
+from gofr_tpu.tracing import (InMemoryExporter, Tracer, ZipkinExporter,
+                              current_span, parse_traceparent)
 
 
 def test_traceparent_parse():
@@ -6,6 +7,20 @@ def test_traceparent_parse():
     assert parse_traceparent("garbage") is None
     assert parse_traceparent(None) is None
     assert parse_traceparent("00-short-bad-01") is None
+
+
+def test_traceparent_rejects_all_zero_ids():
+    # W3C Trace Context: all-zero trace-id / parent-id are defined
+    # invalid — a malformed inbound header must start a FRESH trace, not
+    # stitch every such request into "trace 000..0"
+    assert parse_traceparent("00-" + "0" * 32 + "-" + "b" * 16 + "-01") is None
+    assert parse_traceparent("00-" + "a" * 32 + "-" + "0" * 16 + "-01") is None
+    t = Tracer("svc")
+    s = t.start_span("inbound", traceparent="00-" + "0" * 32 + "-" + "0" * 16 + "-01")
+    try:
+        assert s.trace_id != "0" * 32 and s.parent_id is None
+    finally:
+        s.end()
 
 
 def test_span_nesting_and_export():
@@ -29,3 +44,44 @@ def test_remote_parent_via_traceparent():
     assert s.trace_id == "1" * 32
     assert s.parent_id == "2" * 16
     s.end()
+
+
+def test_record_span_exports_interval_without_context_stack():
+    # the serving loop measures stages itself (one thread multiplexes
+    # every request) — record_span must export the interval as-is and
+    # never touch the current-span contextvar
+    exp = InMemoryExporter()
+    t = Tracer("svc", exporter=exp)
+    parent = "00-" + "a" * 32 + "-" + "b" * 16 + "-01"
+    s = t.record_span("tpu.prefill", 10.0, 10.25, traceparent=parent,
+                      attributes={"slot": 3})
+    assert current_span() is None
+    assert s.trace_id == "a" * 32 and s.parent_id == "b" * 16
+    assert abs(s.duration_us - 250_000) < 1000
+    assert exp.spans == [s]
+    assert s.attributes == {"slot": 3}
+
+
+def test_zipkin_shutdown_joins_thread_and_flushes(monkeypatch):
+    import urllib.request
+
+    posted = []
+
+    def fake_urlopen(req, timeout=None):
+        import io
+        import json
+
+        posted.extend(json.loads(req.data))
+        return io.BytesIO(b"")
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    # huge batch + interval: nothing flushes until shutdown
+    exp = ZipkinExporter("tracer.invalid", batch_size=1000,
+                         flush_interval=3600.0)
+    t = Tracer("svc", exporter=exp)
+    with t.span("buffered"):
+        pass
+    assert posted == []  # still buffered
+    exp.shutdown()
+    assert [z["name"] for z in posted] == ["buffered"]
+    assert not exp._thread.is_alive()  # clean exits must not strand it

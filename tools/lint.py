@@ -18,6 +18,9 @@ highest-signal subset via the stdlib `ast` module:
   F601  duplicate literal key in a dict display
   F541  f-string without any placeholder
   W291  trailing whitespace / W191 tab indentation
+  T201  bare `print(` inside gofr_tpu/ — framework output must go
+        through glog so every line carries trace correlation; CLI
+        command output may opt out with `# noqa: T201`
 
 Usage: python tools/lint.py [paths...]   (default: the repo)
 Exit code 1 when any finding is reported.
@@ -54,15 +57,17 @@ def _is_mutable_default(node: ast.expr) -> bool:
 
 class Checker(ast.NodeVisitor):
     def __init__(self, path: str, tree: ast.AST, is_init: bool,
-                 source: str):
+                 source: str, in_framework: bool = False):
         self.path = path
         self.is_init = is_init
+        self.in_framework = in_framework  # file lives under gofr_tpu/
         self.findings: list[Finding] = []
         self.imported: dict[str, int] = {}       # name -> lineno
         self.used: set[str] = set()
         self.dunder_all: set[str] = set()
         self._toplevel_defs: dict[str, int] = {}
         self._source = source
+        self._comments: dict[int, str] | None = None  # built on first _noqa
         self.visit(tree)
 
     def add(self, node, code, msg):
@@ -142,6 +147,40 @@ class Checker(ast.NodeVisitor):
         self._check_redef(node)
         self.generic_visit(node)
 
+    def _comment_on(self, lineno: int) -> str:
+        """The actual comment token on ``lineno`` (tokenize, not a '#'
+        scan — a '#' inside a string literal is not a comment and must
+        not grant exemptions)."""
+        if self._comments is None:
+            import io
+            import tokenize
+
+            self._comments = {}
+            try:
+                for tok in tokenize.generate_tokens(
+                        io.StringIO(self._source).readline):
+                    if tok.type == tokenize.COMMENT:
+                        self._comments[tok.start[0]] = tok.string
+            except (tokenize.TokenError, IndentationError, SyntaxError):
+                pass
+        return self._comments.get(lineno, "")
+
+    def _noqa(self, node, code: str) -> bool:
+        comment = self._comment_on(node.lineno)
+        return "noqa" in comment and code in comment
+
+    def visit_Call(self, node):
+        # T201: framework code must log through glog (trace-correlated
+        # structured lines), never print to raw stdout/stderr. CLI
+        # command OUTPUT — the command's product, not logging — opts
+        # out per line with `# noqa: T201`.
+        if self.in_framework and isinstance(node.func, ast.Name) \
+                and node.func.id == "print" and not self._noqa(node, "T201"):
+            self.add(node, "T201",
+                     "bare print() in framework code; use glog (or "
+                     "`# noqa: T201` for CLI command output)")
+        self.generic_visit(node)
+
     # -- misc -------------------------------------------------------------
     def visit_Compare(self, node):
         for op, comp in zip(node.ops, node.comparators):
@@ -207,6 +246,18 @@ class Checker(ast.NodeVisitor):
                     self.path, line, "F401", f"unused import {name!r}"))
 
 
+def _in_framework(path: Path) -> bool:
+    """Is this file part of the gofr_tpu PACKAGE (T201 scope)? Anchor at
+    the enclosing project root (nearest pyproject.toml ancestor) so a
+    checkout directory itself named gofr_tpu — the natural clone name —
+    does not classify tests/tools/examples as framework code."""
+    p = path.resolve()
+    for anc in p.parents:
+        if (anc / "pyproject.toml").is_file():
+            return "gofr_tpu" in p.relative_to(anc).parts
+    return "gofr_tpu" in p.parts
+
+
 def lint_file(path: Path) -> list[Finding]:
     src = path.read_text(encoding="utf-8", errors="replace")
     rel = str(path)
@@ -214,7 +265,8 @@ def lint_file(path: Path) -> list[Finding]:
         tree = ast.parse(src, filename=rel)
     except SyntaxError as e:
         return [Finding(rel, e.lineno or 0, "E999", f"syntax error: {e.msg}")]
-    c = Checker(rel, tree, path.name == "__init__.py", src)
+    c = Checker(rel, tree, path.name == "__init__.py", src,
+                in_framework=_in_framework(path))
     c.finish()
     for i, line in enumerate(src.splitlines(), 1):
         if len(line) > MAX_LINE:
