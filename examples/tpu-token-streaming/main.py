@@ -12,11 +12,17 @@ _sys.path.append(_os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
                                "..", ".."))
 
 from gofr_tpu import App
-from gofr_tpu.grpcx import GRPCService
+from gofr_tpu.grpcx import GRPCService, ServerStream
 
 app = App()  # configs/.env selects the llama model + sharding
 
 llm = GRPCService("llm.Generation")
+
+
+def _token_msg(item):
+    if isinstance(item, tuple):
+        return {"token": item[0], "logprob": item[1]}
+    return {"token": item}
 
 
 @llm.server_stream("Generate")
@@ -27,11 +33,10 @@ def generate_grpc(ctx, req):
                               top_k=req.get("top_k", 0),
                               eos_id=req.get("eos_id"),
                               logprobs=req.get("logprobs", False))
-    for item in stream:
-        if isinstance(item, tuple):
-            yield {"token": item[0], "logprob": item[1]}
-        else:
-            yield {"token": item}
+    # ServerStream = zero-handoff delivery: each token is serialized and
+    # written by the serving loop itself, no handler-thread wakeup on
+    # the first-token (TTFT) path
+    return ServerStream(stream, _token_msg)
 
 
 @llm.bidi_stream("Chat")
@@ -63,7 +68,9 @@ def generate_http(ctx):
                               max_new_tokens=body.get("max_new_tokens", 64),
                               temperature=body.get("temperature", 0.0),
                               top_k=body.get("top_k", 0))
-    ctx.stream((json.dumps({"token": t}) + "\n").encode() for t in stream)
+    # push-capable source: chunks leave on the serving-loop thread
+    ctx.stream(stream.map(
+        lambda t: (json.dumps({"token": t}) + "\n").encode()))
     return None
 
 

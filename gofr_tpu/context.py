@@ -74,11 +74,19 @@ class Context:
         """Write an iterable of ``bytes`` chunks as a live chunked response.
 
             ctx.stream(json.dumps(x).encode() + b"\\n" for x in items)
-        """
+
+        A push-capable source (``GenStream.map(encode)``) takes the
+        zero-handoff fast path: each chunk is written by the PRODUCING
+        thread (the TPU serving loop) without waking this handler
+        thread — the same first-token latency fix as the gRPC
+        ``ServerStream`` path."""
         if self._responder is None:
             raise RuntimeError("streaming is only available on HTTP requests")
         w = self._responder.writer
         w.set_header("Content-Type", content_type)
+        if hasattr(chunks, "set_sink"):
+            w.stream_from(chunks)
+            return
         for chunk in chunks:
             w.write_chunk(chunk)
 

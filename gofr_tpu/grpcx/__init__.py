@@ -14,15 +14,17 @@ Public surface:
 """
 
 from .client import BidiCall, GRPCChannel, dial
+from .http2 import TransportOptions
 from .server import GRPCServer
 from .service import (CANCELLED, DEADLINE_EXCEEDED, GRPCContext, GRPCError,
                       GRPCService, INTERNAL, INVALID_ARGUMENT, JSONCodec,
                       NOT_FOUND, OK, ProtoCodec, RESOURCE_EXHAUSTED,
-                      STATUS_NAMES, UNAUTHENTICATED, UNAVAILABLE,
-                      UNIMPLEMENTED, UNKNOWN)
+                      STATUS_NAMES, ServerStream, UNAUTHENTICATED,
+                      UNAVAILABLE, UNIMPLEMENTED, UNKNOWN)
 
 __all__ = [
-    "BidiCall", "GRPCChannel", "dial", "GRPCServer",
+    "BidiCall", "GRPCChannel", "dial", "GRPCServer", "ServerStream",
+    "TransportOptions",
     "GRPCContext", "GRPCError", "GRPCService", "JSONCodec", "ProtoCodec",
     "STATUS_NAMES", "OK", "CANCELLED", "UNKNOWN", "INVALID_ARGUMENT",
     "DEADLINE_EXCEEDED", "NOT_FOUND", "RESOURCE_EXHAUSTED", "UNIMPLEMENTED",

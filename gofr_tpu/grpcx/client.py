@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import queue
 import socket
-import struct
 import threading
 import urllib.parse
 
@@ -30,7 +29,7 @@ def _q_get(q: queue.Queue, timeout: float | None):
 
 class _Call:
     __slots__ = ("sid", "q", "headers", "trailers", "send_window", "buffer",
-                 "done")
+                 "done", "recv_debt")
 
     def __init__(self, sid: int, initial_window: int):
         self.sid = sid
@@ -40,12 +39,15 @@ class _Call:
         self.send_window = h2.FlowWindow(initial_window)
         self.buffer = bytearray()
         self.done = threading.Event()
+        self.recv_debt = 0  # bytes received since the last WINDOW_UPDATE
 
 
 class GRPCChannel:
     """h2c (prior-knowledge) gRPC channel to host:port."""
 
-    def __init__(self, host: str, port: int, connect_timeout: float = 5.0):
+    def __init__(self, host: str, port: int, connect_timeout: float = 5.0,
+                 options: "h2.TransportOptions | None" = None):
+        self.options = options or h2.TransportOptions()
         self.target = f"{host}:{port}"
         self.sock = socket.create_connection((host, port), connect_timeout)
         # create_connection leaves connect_timeout as the PER-READ timeout;
@@ -56,8 +58,8 @@ class GRPCChannel:
         # carried by grpc-timeout, not the socket.
         self.sock.settimeout(None)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self.io = h2.FrameIO(self.sock)
-        self.encoder = Encoder()
+        self.io = h2.FrameIO(self.sock, vectored=self.options.vectored)
+        self.encoder = Encoder(memo=self.options.hpack_memo)
         self.decoder = Decoder()
         self._enc_lock = threading.Lock()
         self.conn_window = h2.FlowWindow(h2.DEFAULT_WINDOW)
@@ -67,9 +69,10 @@ class GRPCChannel:
         self._next_sid = 1
         self._closed = False
         self._error: Exception | None = None
+        self._replenisher = h2.WindowReplenisher(self.io,
+                                                 self.options.lazy_window)
 
-        with self.io._wlock:
-            self.sock.sendall(h2.CLIENT_PREFACE)
+        self.io.send_raw(h2.CLIENT_PREFACE)
         self.io.send_frame(h2.SETTINGS, 0, 0, h2.encode_settings({
             h2.SETTINGS_HEADER_TABLE_SIZE: 4096,
             h2.SETTINGS_MAX_FRAME_SIZE: h2.DEFAULT_MAX_FRAME,
@@ -194,10 +197,9 @@ class GRPCChannel:
     def _on_data(self, f: h2.Frame) -> None:
         call = self._calls.get(f.stream_id)
         if f.payload:
-            n = struct.pack(">I", len(f.payload))
-            self.io.send_frame(h2.WINDOW_UPDATE, 0, 0, n)
-            if call is not None and not f.flags & h2.FLAG_END_STREAM:
-                self.io.send_frame(h2.WINDOW_UPDATE, 0, f.stream_id, n)
+            self._replenisher.on_data(
+                call, f.stream_id, len(f.payload),
+                not f.flags & h2.FLAG_END_STREAM)
         if call is None:
             return
         call.buffer.extend(h2.strip_padding(f))
@@ -212,14 +214,8 @@ class GRPCChannel:
             self._finish_call(call)
 
     # -- calls ---------------------------------------------------------------
-    def _open_call(self, method: str, timeout: float | None,
-                   metadata=None) -> _Call:
-        """Allocate a stream and send HEADERS (no END_STREAM): the request
-        side stays open for streaming sends."""
-        if self._closed:
-            raise svc.GRPCError(svc.UNAVAILABLE,
-                                f"channel closed: {self._error!r}")
-        host, _, _ = self.target.partition(":")
+    def _request_headers(self, method: str, timeout: float | None,
+                         metadata=None) -> list[tuple[str, str]]:
         headers = [(":method", "POST"), (":scheme", "http"),
                    (":path", method), (":authority", self.target),
                    ("content-type", "application/grpc"),
@@ -228,6 +224,16 @@ class GRPCChannel:
             headers.append(("grpc-timeout", f"{int(timeout * 1000)}m"))
         for k, v in (metadata or {}).items():
             headers.append((k.lower(), v))
+        return headers
+
+    def _open_call(self, method: str, timeout: float | None,
+                   metadata=None) -> _Call:
+        """Allocate a stream and send HEADERS (no END_STREAM): the request
+        side stays open for streaming sends."""
+        if self._closed:
+            raise svc.GRPCError(svc.UNAVAILABLE,
+                                f"channel closed: {self._error!r}")
+        headers = self._request_headers(method, timeout, metadata)
         # Stream ids must reach the server strictly increasing (RFC 9113
         # §5.1.1): allocate the id and emit HEADERS under one lock so
         # concurrent calls can't reorder. DATA may interleave freely after.
@@ -249,7 +255,7 @@ class GRPCChannel:
                       end: bool, timeout: float | None) -> None:
         """One gRPC length-prefixed message as flow-controlled DATA;
         ``end=True`` half-closes the request side with the final frame."""
-        data = b"\x00" + len(payload).to_bytes(4, "big") + payload
+        data = svc.grpc_frame(payload)
         view = memoryview(data)
         while view:
             want = min(len(view), self.io.peer_max_frame)
@@ -265,6 +271,32 @@ class GRPCChannel:
 
     def _start_call(self, method: str, payload: bytes,
                     timeout: float | None, metadata=None) -> _Call:
+        """Open a one-message request (unary / server-stream): on the
+        fast path the WHOLE request — HEADERS + DATA + END_STREAM —
+        leaves in ONE vectored write (one syscall, one packet, one
+        server-reader wakeup) instead of three back-to-back. Falls back
+        to open+send when the message needs multiple frames or the
+        windows lack instant credit."""
+        data = svc.grpc_frame(payload)
+        if (self.options.vectored and not self._closed
+                and len(data) <= self.io.peer_max_frame
+                and self.conn_window.try_consume(len(data))):
+            headers = self._request_headers(method, timeout, metadata)
+            with self._lock:
+                sid = self._next_sid
+                self._next_sid += 2
+                call = _Call(sid, self.peer_initial_window)
+                if not call.send_window.try_consume(len(data)):
+                    # a tiny INITIAL_WINDOW_SIZE: refund and fall back
+                    self.conn_window.credit(len(data))
+                else:
+                    self._calls[sid] = call
+                    with self._enc_lock:
+                        block = self.encoder.encode(headers)
+                    self.io.send_frames([
+                        (h2.HEADERS, h2.FLAG_END_HEADERS, sid, block),
+                        (h2.DATA, h2.FLAG_END_STREAM, sid, data)])
+                    return call
         call = self._open_call(method, timeout, metadata)
         self._send_message(call, payload, end=True, timeout=timeout)
         return call

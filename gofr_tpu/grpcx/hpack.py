@@ -85,6 +85,13 @@ for _i, (_n, _v) in enumerate(STATIC_TABLE):
 
 _ENTRY_OVERHEAD = 32  # RFC 7541 §4.1
 
+# memo-cache size caps: header vocabularies are tiny in practice (method
+# paths, status codes, content types); the caps only bound a pathological
+# all-unique workload, where caching is pointless anyway
+_STR_CACHE_MAX = 1024
+_STR_CACHE_VALUE_MAX = 256
+_FRAGMENT_CACHE_MAX = 2048
+
 
 class HPACKError(Exception):
     pass
@@ -203,28 +210,51 @@ def decode_string(data: bytes, pos: int) -> tuple[bytes, int]:
 # -- dynamic table ------------------------------------------------------------
 
 class _DynamicTable:
+    """Eviction-ordered dynamic table with an O(1) reverse index.
+
+    ``entries[i]`` holds the entry inserted ``i`` insertions ago (newest
+    first, per §2.3.2). The reverse index maps (name, value) and name to
+    the newest matching insertion's ABSOLUTE id (a monotonically growing
+    counter), so ``find`` never re-walks the list: the entry's current
+    position is ``_base - abs_id`` regardless of how many inserts and
+    evictions happened since. Mappings are dropped at eviction only when
+    they still point at the evicted insertion (a newer duplicate wins)."""
+
     def __init__(self, max_size: int = 4096):
         self.entries: list[tuple[bytes, bytes]] = []
         self.size = 0
         self.max_size = max_size
         self.cap = max_size  # protocol ceiling (SETTINGS_HEADER_TABLE_SIZE)
+        self._base = 0                # total insertions ever
+        self._pair_abs: dict[tuple[bytes, bytes], int] = {}
+        self._name_abs: dict[bytes, int] = {}
+
+    def _pop_last(self) -> None:
+        abs_id = self._base - (len(self.entries) - 1)
+        en, ev = self.entries.pop()
+        self.size -= len(en) + len(ev) + _ENTRY_OVERHEAD
+        if self._pair_abs.get((en, ev)) == abs_id:
+            del self._pair_abs[(en, ev)]
+        if self._name_abs.get(en) == abs_id:
+            del self._name_abs[en]
 
     def add(self, name: bytes, value: bytes) -> None:
         need = len(name) + len(value) + _ENTRY_OVERHEAD
         while self.entries and self.size + need > self.max_size:
-            en, ev = self.entries.pop()
-            self.size -= len(en) + len(ev) + _ENTRY_OVERHEAD
+            self._pop_last()
         if need <= self.max_size:
+            self._base += 1
             self.entries.insert(0, (name, value))
             self.size += need
+            self._pair_abs[(name, value)] = self._base
+            self._name_abs[name] = self._base
 
     def resize(self, new_max: int) -> None:
         if new_max > self.cap:
             raise HPACKError(f"table size {new_max} above ceiling {self.cap}")
         self.max_size = new_max
         while self.entries and self.size > self.max_size:
-            en, ev = self.entries.pop()
-            self.size -= len(en) + len(ev) + _ENTRY_OVERHEAD
+            self._pop_last()
 
     def get(self, index: int) -> tuple[bytes, bytes]:
         # index is 1-based over static + dynamic (§2.3.3)
@@ -236,19 +266,22 @@ class _DynamicTable:
         raise HPACKError(f"invalid index {index}")
 
     def find(self, name: bytes, value: bytes) -> tuple[int, bool]:
-        """-> (index, exact). index 0 = not found."""
+        """-> (index, exact). index 0 = not found. Preference order
+        (static exact, dynamic exact, static name, dynamic name) and
+        newest-duplicate-wins match the linear scan this replaced, so
+        encoded blocks are byte-identical."""
         exact = _STATIC_FULL.get((name, value))
         if exact:
             return exact, True
-        for i, (n, v) in enumerate(self.entries):
-            if n == name and v == value:
-                return len(STATIC_TABLE) + 1 + i, True
+        abs_id = self._pair_abs.get((name, value))
+        if abs_id is not None:
+            return len(STATIC_TABLE) + 1 + (self._base - abs_id), True
         name_idx = _STATIC_NAME.get(name)
         if name_idx:
             return name_idx, False
-        for i, (n, _) in enumerate(self.entries):
-            if n == name:
-                return len(STATIC_TABLE) + 1 + i, False
+        abs_id = self._name_abs.get(name)
+        if abs_id is not None:
+            return len(STATIC_TABLE) + 1 + (self._base - abs_id), False
         return 0, False
 
 
@@ -258,13 +291,96 @@ def _norm(h: "str | bytes") -> bytes:
     return h.encode("ascii") if isinstance(h, str) else h
 
 
+_NAME_NORM: dict = {}
+
+
+def _norm_name(h: "str | bytes") -> bytes:
+    """``_norm(h).lower()`` with a small memo — header NAMES draw from a
+    tiny vocabulary and the per-call encode+lower allocations showed up
+    in the transport profile."""
+    v = _NAME_NORM.get(h)
+    if v is None:
+        v = _norm(h).lower()
+        if len(_NAME_NORM) < _STR_CACHE_MAX:
+            _NAME_NORM[h] = v
+    return v
+
+
+# (name, value) -> precomputed §6.1 indexed bytes for every static-exact
+# entry. Static indices never move, so these are valid under ANY dynamic
+# table state — the unconditionally-safe half of the encode cache.
+_STATIC_EXACT_BYTES = {entry: bytes(encode_int(i + 1, 7, 0x80))
+                       for i, entry in enumerate(STATIC_TABLE)}
+
+# (name, value) -> stateless block fragment (see encode_stateless)
+_STATELESS_FRAGMENTS: dict = {}
+
+
+def encode_stateless(headers) -> bytes:
+    """Encode a header block that neither reads nor writes ANY dynamic
+    table state: static-exact fields as §6.1 indexed, everything else as
+    §6.2.2 literal-without-indexing (static name index when one exists).
+
+    Such a block is valid at any point in a connection's lifetime and
+    leaves the peer's decoder table untouched, so it can be pre-encoded
+    ONCE PER SERVER (response headers, trailer templates) and written
+    from any thread without holding the connection's encoder lock — the
+    HPACK half of the first-token fast path. Fragments memoize per
+    (name, value): the dynamic-table-safe encode cache."""
+    out = bytearray()
+    for name, value in headers:
+        name, value = _norm_name(name), _norm(value)
+        key = (name, value)
+        frag = _STATELESS_FRAGMENTS.get(key)
+        if frag is None:
+            frag = _STATIC_EXACT_BYTES.get(key)
+            if frag is None:
+                nidx = _STATIC_NAME.get(name, 0)
+                buf = encode_int(nidx, 4, 0x00)
+                if not nidx:
+                    buf.extend(encode_string(name))
+                buf.extend(encode_string(value))
+                frag = bytes(buf)
+            # memoize only short values: grpc-message trailers carry
+            # per-request error text — high-cardinality, arbitrary
+            # length — which would pin memory AND crowd out the hot
+            # pairs; clear-on-full (not stop-on-full) keeps the cache
+            # live for new legitimate pairs after churn
+            if len(value) <= _STR_CACHE_VALUE_MAX:
+                if len(_STATELESS_FRAGMENTS) >= _FRAGMENT_CACHE_MAX:
+                    _STATELESS_FRAGMENTS.clear()
+                _STATELESS_FRAGMENTS[key] = frag
+        out += frag
+    return bytes(out)
+
+
 class Encoder:
-    def __init__(self, max_table_size: int = 4096):
+    def __init__(self, max_table_size: int = 4096, memo: bool = True):
         self.table = _DynamicTable(max_table_size)
         self.huffman = True
         self.indexing = True
+        # memo=False disables the string-encode cache (the legacy arm of
+        # tools/transport_bench.py); output bytes are identical either way
+        self.memo = memo
+        self._str_cache: dict = {}
         self._pending_size_update: int | None = None
         self._pending_size_min: int | None = None
+
+    def _estr(self, data: bytes) -> "bytes | bytearray":
+        """encode_string with a memo: the Huffman bit-packing loop is the
+        dominant per-header cost, and header strings repeat heavily
+        (paths, content types, status codes). Pure-function cache, so
+        cached and uncached output are byte-identical."""
+        if not self.memo or len(data) > _STR_CACHE_VALUE_MAX:
+            return encode_string(data, self.huffman)
+        key = (data, self.huffman)
+        out = self._str_cache.get(key)
+        if out is None:
+            if len(self._str_cache) >= _STR_CACHE_MAX:
+                self._str_cache.clear()
+            out = bytes(encode_string(data, self.huffman))
+            self._str_cache[key] = out
+        return out
 
     def set_max_table_size(self, size: int) -> None:
         """Apply the peer's SETTINGS_HEADER_TABLE_SIZE: shrink our encoding
@@ -290,24 +406,27 @@ class Encoder:
             self._pending_size_update = None
             self._pending_size_min = None
         for name, value in headers:
-            name, value = _norm(name).lower(), _norm(value)
+            name, value = _norm_name(name), _norm(value)
             idx, exact = self.table.find(name, value)
             if exact:
-                out.extend(encode_int(idx, 7, 0x80))  # §6.1 indexed
+                if idx <= len(STATIC_TABLE):
+                    out += _STATIC_EXACT_BYTES[(name, value)]
+                else:
+                    out.extend(encode_int(idx, 7, 0x80))  # §6.1 indexed
             elif not self.indexing:
                 out.extend(encode_int(idx, 4, 0x00))  # §6.2.2 (idx may be 0)
                 if not idx:
-                    out.extend(encode_string(name, self.huffman))
-                out.extend(encode_string(value, self.huffman))
+                    out.extend(self._estr(name))
+                out.extend(self._estr(value))
             elif idx:
                 # §6.2.1 literal with incremental indexing, indexed name
                 out.extend(encode_int(idx, 6, 0x40))
-                out.extend(encode_string(value, self.huffman))
+                out.extend(self._estr(value))
                 self.table.add(name, value)
             else:
                 out.extend(encode_int(0, 6, 0x40))  # new name
-                out.extend(encode_string(name, self.huffman))
-                out.extend(encode_string(value, self.huffman))
+                out.extend(self._estr(name))
+                out.extend(self._estr(value))
                 self.table.add(name, value)
         return bytes(out)
 

@@ -17,6 +17,8 @@ import socket
 import struct
 import threading
 
+from ..wire import SocketWriter
+
 # frame types (§6)
 DATA = 0x0
 HEADERS = 0x1
@@ -58,6 +60,47 @@ CLIENT_PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
 DEFAULT_WINDOW = 65535
 DEFAULT_MAX_FRAME = 16384
 MAX_WINDOW = (1 << 31) - 1
+
+# lazy receive-window replenish threshold: WINDOW_UPDATEs batch until a
+# quarter of the default window is consumed instead of going out per
+# DATA frame — the dominant per-token syscall on the streaming path
+WINDOW_REPLENISH = DEFAULT_WINDOW // 4
+
+
+class TransportOptions:
+    """Feature switches for the transport fast path.
+
+    The default construction enables everything; ``legacy()`` pins the
+    pre-fast-path wire behavior and is the "before" arm measured by
+    tools/transport_bench.py (and the fallback if a fast-path lever
+    ever needs to be ruled out in production).
+
+      hpack_memo    — encode caches + pre-encoded stateless server
+                      blocks (hpack.encode_stateless)
+      vectored      — sendmsg frame writes with nonblocking backlog
+                      (wire.SocketWriter fast path)
+      lazy_window   — batch WINDOW_UPDATE replenish at WINDOW_REPLENISH
+                      instead of two eager frames per DATA frame
+      zero_handoff  — deliver server-stream messages on the producing
+                      thread (ServerStream + GenStream sink); effective
+                      only with ``vectored`` on, because the sink's
+                      writes must be nonblocking — the server ignores it
+                      otherwise
+    """
+
+    __slots__ = ("hpack_memo", "vectored", "lazy_window", "zero_handoff")
+
+    def __init__(self, hpack_memo: bool = True, vectored: bool = True,
+                 lazy_window: bool = True, zero_handoff: bool = True):
+        self.hpack_memo = hpack_memo
+        self.vectored = vectored
+        self.lazy_window = lazy_window
+        self.zero_handoff = zero_handoff
+
+    @classmethod
+    def legacy(cls) -> "TransportOptions":
+        return cls(hpack_memo=False, vectored=False, lazy_window=False,
+                   zero_handoff=False)
 
 
 class ConnectionError_(Exception):
@@ -109,15 +152,25 @@ def decode_settings(payload: bytes) -> dict[int, int]:
 
 
 class FrameIO:
-    """Thread-safe framed socket: one reader thread, many writer threads."""
+    """Thread-safe framed socket: one reader thread, many writer threads.
 
-    def __init__(self, sock: socket.socket, max_frame: int = DEFAULT_MAX_FRAME):
+    Writes go through a wire.SocketWriter: one vectored syscall carries
+    any number of frames, and ``block=False`` sends never stall the
+    caller (bytes park in the writer's ordered backlog under contention
+    or a full socket buffer — the zero-handoff delivery path relies on
+    this). ``vectored=False`` pins the legacy one-sendall-per-call
+    behavior for A/B measurement."""
+
+    def __init__(self, sock: socket.socket, max_frame: int = DEFAULT_MAX_FRAME,
+                 vectored: bool = True):
         self.sock = sock
         self.max_frame = max_frame          # what we accept (our SETTINGS)
         self.peer_max_frame = DEFAULT_MAX_FRAME  # what the peer accepts
         self._rbuf = b""
-        self._wlock = threading.Lock()
-        self._closed = False
+        self.writer = SocketWriter(sock)
+        self.vectored = vectored
+        self.frames_sent = 0
+        self.coalesced_header_data = 0  # writes carrying HEADERS+DATA together
 
     # -- reads (single reader thread) ----------------------------------------
     def _read_exact(self, n: int) -> bytes:
@@ -150,31 +203,88 @@ class FrameIO:
                    payload: bytes = b"") -> None:
         self.send_frames([(type_, flags, stream_id, payload)])
 
-    def send_frames(self, frames) -> None:
-        """Write one or more frames in ONE sendall — the first-token
-        fast path coalesces the response HEADERS and the first DATA
-        frame so a streaming client sees one packet (one syscall, one
-        wakeup) instead of two back-to-back."""
-        buf = bytearray()
+    def send_frames(self, frames, block: bool = True) -> bool:
+        """Write one or more frames in ONE vectored write — the
+        first-token fast path coalesces the response HEADERS and the
+        first DATA frame so a streaming client sees one packet (one
+        syscall, one wakeup) instead of two back-to-back; fused decode
+        blocks batch their DATA frames the same way. ``block=False``
+        commits the bytes without ever stalling the caller (see
+        SocketWriter); returns False when they were parked in the
+        backlog, in which case the caller must arrange a later flush."""
+        bufs = []
+        saw_headers = False
         for type_, flags, stream_id, payload in frames:
             if len(payload) > self.peer_max_frame:
                 raise ConnectionError_(FRAME_SIZE_ERROR,
                                        "frame too large for peer")
-            buf += (len(payload).to_bytes(3, "big") + bytes((type_, flags))
-                    + stream_id.to_bytes(4, "big") + payload)
-        with self._wlock:
-            if self._closed:
-                raise EOFError("connection closed")
-            self.sock.sendall(buf)
+            bufs.append(len(payload).to_bytes(3, "big") + bytes((type_, flags))
+                        + stream_id.to_bytes(4, "big"))
+            if payload:
+                bufs.append(payload)
+            if type_ == HEADERS:
+                saw_headers = True
+            elif type_ == DATA and saw_headers:
+                self.coalesced_header_data += 1
+                saw_headers = False
+        self.frames_sent += len(frames)
+        if self.vectored:
+            return self.writer.write(bufs, block=block)
+        # legacy wire path: one joined sendall per call, always
+        # blocking (the pre-fast-path behavior, kept for A/B)
+        return self.writer.write(b"".join(bufs), block=True)
+
+    def send_raw(self, data: bytes) -> None:
+        """Raw blocking write outside the framing (client preface)."""
+        self.writer.write(data, block=True)
+
+    def flush(self) -> None:
+        self.writer.flush()
 
     def close(self) -> None:
-        with self._wlock:
-            self._closed = True
-        try:
-            self.sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        self.sock.close()
+        self.writer.close()
+
+
+class WindowReplenisher:
+    """Receive-window replenish policy, shared by the server connection
+    and the client channel so the two sides of the own wire can never
+    drift apart.
+
+    The fast path batches debt until WINDOW_REPLENISH and ships the
+    connection + stream updates in ONE write — the eager policy cost
+    two syscalls per received DATA frame, the dominant per-token
+    syscall on a streaming path. ``holder`` is the per-stream state
+    carrying ``recv_debt`` (server ``_Stream`` / client ``_Call``), or
+    None when the stream is already gone (connection-level accounting
+    still applies)."""
+
+    __slots__ = ("io", "lazy", "_debt")
+
+    def __init__(self, io: "FrameIO", lazy: bool):
+        self.io = io
+        self.lazy = lazy
+        self._debt = 0  # connection-level consumed-but-unannounced bytes
+
+    def on_data(self, holder, sid: int, n: int, stream_open: bool) -> None:
+        if not self.lazy:
+            packed = struct.pack(">I", n)
+            self.io.send_frame(WINDOW_UPDATE, 0, 0, packed)
+            if holder is not None and stream_open:
+                self.io.send_frame(WINDOW_UPDATE, 0, sid, packed)
+            return
+        ups = []
+        self._debt += n
+        if self._debt >= WINDOW_REPLENISH:
+            ups.append((WINDOW_UPDATE, 0, 0, struct.pack(">I", self._debt)))
+            self._debt = 0
+        if holder is not None and stream_open:
+            holder.recv_debt += n
+            if holder.recv_debt >= WINDOW_REPLENISH:
+                ups.append((WINDOW_UPDATE, 0, sid,
+                            struct.pack(">I", holder.recv_debt)))
+                holder.recv_debt = 0
+        if ups:
+            self.io.send_frames(ups)
 
 
 class FlowWindow:
@@ -196,6 +306,16 @@ class FlowWindow:
             take = min(want, self.value)
             self.value -= take
             return take
+
+    def try_consume(self, want: int) -> bool:
+        """All-or-nothing nonblocking claim — the zero-handoff fast path
+        takes a whole message's credit or falls back to the worker
+        thread (which can afford to block in ``consume``)."""
+        with self._cond:
+            if self._dead or self.value < want:
+                return False
+            self.value -= want
+            return True
 
     def credit(self, n: int) -> None:
         with self._cond:

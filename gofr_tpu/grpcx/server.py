@@ -24,7 +24,9 @@ import urllib.parse
 
 from . import http2 as h2
 from . import service as svc
-from .hpack import Decoder, Encoder
+from .hpack import Decoder, Encoder, encode_stateless
+from .. import wire
+from ..wire import Outbox
 
 _GRPC_CONTENT_TYPES = ("application/grpc",)
 _TIMEOUT_UNITS = {"H": 3600.0, "M": 60.0, "S": 1.0, "m": 1e-3, "u": 1e-6, "n": 1e-9}
@@ -41,7 +43,8 @@ def parse_grpc_timeout(val: str | None) -> float | None:
 
 class _Stream:
     __slots__ = ("id", "headers", "recv_q", "buffer", "send_window",
-                 "cancelled", "end_received", "headers_sent", "worker")
+                 "cancelled", "end_received", "headers_sent", "worker",
+                 "recv_debt")
 
     def __init__(self, sid: int, headers: dict[str, str], initial_window: int):
         self.id = sid
@@ -53,17 +56,21 @@ class _Stream:
         self.end_received = False
         self.headers_sent = False
         self.worker: threading.Thread | None = None
+        self.recv_debt = 0  # bytes received since the last WINDOW_UPDATE
 
 
 class _Connection:
     """One accepted socket: owns the frame loop and all stream state."""
 
     def __init__(self, sock: socket.socket, addr, server: "GRPCServer"):
-        self.io = h2.FrameIO(sock)
+        self.options = server.options
+        self.io = h2.FrameIO(sock, vectored=self.options.vectored)
         self.addr = addr
         self.server = server
-        self.encoder = Encoder()
+        self.encoder = Encoder(memo=self.options.hpack_memo)
         self.decoder = Decoder()
+        self._replenisher = h2.WindowReplenisher(self.io,
+                                                 self.options.lazy_window)
         self._enc_lock = threading.Lock()
         self.conn_window = h2.FlowWindow(h2.DEFAULT_WINDOW)
         self.peer_initial_window = h2.DEFAULT_WINDOW
@@ -217,8 +224,8 @@ class _Connection:
         if st is None:
             # closed/unknown stream: still account connection flow control
             if f.payload:
-                self.io.send_frame(h2.WINDOW_UPDATE, 0, 0,
-                                   struct.pack(">I", len(f.payload)))
+                self._replenisher.on_data(None, f.stream_id,
+                                          len(f.payload), False)
             return
         data = h2.strip_padding(f)
         st.buffer.extend(data)
@@ -239,10 +246,8 @@ class _Connection:
             st.recv_q.put(None)
         # replenish receive windows (we buffer in-process, never stall reads)
         if f.payload:
-            n = struct.pack(">I", len(f.payload))
-            self.io.send_frame(h2.WINDOW_UPDATE, 0, 0, n)
-            if not st.end_received:
-                self.io.send_frame(h2.WINDOW_UPDATE, 0, f.stream_id, n)
+            self._replenisher.on_data(st, st.id, len(f.payload),
+                                      not st.end_received)
 
     def _on_window_update(self, f: h2.Frame) -> None:
         if len(f.payload) != 4:
@@ -268,23 +273,34 @@ class _Connection:
 
     # -- stream sends (called from worker threads) ---------------------------
     def send_headers(self, st: _Stream, headers, end_stream: bool = False) -> None:
+        flags = h2.FLAG_END_HEADERS | (h2.FLAG_END_STREAM if end_stream else 0)
+        if self.options.hpack_memo:
+            # stateless block (static-exact + literal-without-indexing):
+            # touches no dynamic table, so there is no ordering
+            # constraint with other encodes and no lock to hold
+            self.io.send_frame(h2.HEADERS, flags, st.id,
+                               encode_stateless(headers))
+            return
         # HPACK is stateful: blocks must hit the wire in encode order, so
         # the send stays under the encoder lock.
         with self._enc_lock:
             block = self.encoder.encode(headers)
-            flags = h2.FLAG_END_HEADERS | (h2.FLAG_END_STREAM if end_stream else 0)
             self.io.send_frame(h2.HEADERS, flags, st.id, block)
 
     def send_message(self, st: _Stream, payload: bytes,
-                     headers=None) -> None:
+                     headers=None, stages: "dict | None" = None) -> None:
         """One gRPC length-prefixed message as flow-controlled DATA.
 
         ``headers``: response headers to coalesce with the FIRST data
         frame in a single socket write — the first-token fast path for
         streaming RPCs (one packet on the wire instead of HEADERS then
         DATA; saves a syscall and a client-reader wakeup on the latency
-        path the BASELINE gRPC-TTFT target measures)."""
-        data = b"\x00" + len(payload).to_bytes(4, "big") + payload
+        path the BASELINE gRPC-TTFT target measures).
+
+        ``stages``: optional dict the coalesced HEADERS+DATA send fills
+        with monotonic stamps (enc0/enc1/write0/write1) — the source of
+        the grpc.hpack / grpc.frame-write TTFT decomposition spans."""
+        data = svc.grpc_frame(payload)
         view = memoryview(data)
         while view:
             if st.cancelled.is_set():
@@ -295,11 +311,23 @@ class _Connection:
             if n < n_stream:  # refund stream credit the connection couldn't cover
                 st.send_window.credit(n_stream - n)
             if headers is not None:
-                with self._enc_lock:  # HPACK is stateful: encode+send in order
-                    block = self.encoder.encode(headers)
+                t_enc0 = time.monotonic()
+                if self.options.hpack_memo:
+                    block = self.server.resp_block(headers)
+                    t_enc1 = time.monotonic()
                     self.io.send_frames([
                         (h2.HEADERS, h2.FLAG_END_HEADERS, st.id, block),
                         (h2.DATA, 0, st.id, bytes(view[:n]))])
+                else:
+                    with self._enc_lock:  # stateful: encode+send in order
+                        block = self.encoder.encode(headers)
+                        t_enc1 = time.monotonic()
+                        self.io.send_frames([
+                            (h2.HEADERS, h2.FLAG_END_HEADERS, st.id, block),
+                            (h2.DATA, 0, st.id, bytes(view[:n]))])
+                if stages is not None:
+                    stages.update(enc0=t_enc0, enc1=t_enc1, write0=t_enc1,
+                                  write1=time.monotonic())
                 # flag only AFTER the frames hit the wire: an earlier
                 # flow-control timeout/cancel must leave headers_sent
                 # False so _finish still emits a full trailers-only
@@ -315,22 +343,186 @@ class _Connection:
             self.streams.pop(st.id, None)
 
 
+class _PushSender:
+    """One stream's zero-handoff delivery state (GRPCServer._serve_push).
+
+    All response DATA for the stream flows through ONE wire.Outbox in
+    FIFO order, drained by whichever thread is available:
+
+      - the producing thread (the engine serving loop, via the
+        GenStream sink) appends and pumps NONBLOCKING — flow-control
+        credit is claimed with try_consume and bytes leave through the
+        writer's MSG_DONTWAIT path, so token delivery can never stall
+        behind a slow client;
+      - on any obstacle (no credit, oversized message, serialize
+        failure, deadline, cancel) the sender DOWNGRADES permanently:
+        later items go back to the stream queue and the RPC's worker
+        thread serves them with the blocking path. Latency is already
+        lost at that point; ordering never is, because every DATA byte
+        passes through the outbox.
+    """
+
+    __slots__ = ("server", "conn", "st", "codec", "map_fn", "source",
+                 "deadline", "outbox", "downgraded", "_spans_done")
+
+    def __init__(self, server: "GRPCServer", conn: _Connection, st: _Stream,
+                 codec, map_fn, source, deadline: float | None):
+        self.server = server
+        self.conn = conn
+        self.st = st
+        self.codec = codec
+        self.map_fn = map_fn
+        self.source = source
+        self.deadline = deadline
+        self.outbox = Outbox(self._drain)
+        self.downgraded = False
+        self._spans_done = False
+
+    # -- producing thread ----------------------------------------------------
+    def sink(self, item) -> bool:
+        if self.downgraded or self.st.cancelled.is_set():
+            return False
+        if self.deadline is not None and time.monotonic() > self.deadline:
+            self.downgraded = True  # the worker raises DEADLINE_EXCEEDED
+            return False
+        try:
+            payload = self.codec.serialize(self.map_fn(item))
+        except Exception:
+            self.downgraded = True
+            return False
+        if len(payload) + 5 > self.conn.io.peer_max_frame:
+            self.downgraded = True  # multi-frame message: worker path
+            return False
+        self.outbox.append(payload)
+        try:
+            self.outbox.pump(block=False)
+        except Exception:
+            self.downgraded = True
+            self._wake_worker()  # committed bytes need a flusher
+            return True
+        if self.outbox.stalled:
+            self.downgraded = True
+            # the stalled item has NO other waker: the worker is parked
+            # in q.get and the next token may be a decode block away —
+            # without this the first byte waits for the second token
+            self._wake_worker()
+        return True
+
+    def _wake_worker(self) -> None:
+        w = getattr(self.source, "wake", None)
+        if w is not None:
+            w()
+
+    # -- worker thread -------------------------------------------------------
+    def send(self, item) -> None:
+        self.outbox.append(self.codec.serialize(self.map_fn(item)))
+        self.outbox.pump(block=True)
+
+    def finish(self) -> None:
+        self.outbox.pump(block=True)
+        # a deferred nonblocking write may have parked bytes in the
+        # WRITER's backlog (one layer below the outbox) — drain that too
+        self.conn.io.flush()
+
+    # -- outbox drain (single flusher at a time; see wire.Outbox) ------------
+    def _drain(self, batch, block: bool) -> int:
+        conn, st = self.conn, self.st
+        if block:
+            for payload in batch:
+                got = time.monotonic()
+                if st.headers_sent:
+                    conn.send_message(st, payload)
+                else:
+                    stages: dict = {}
+                    conn.send_message(st, payload,
+                                      headers=_response_headers(),
+                                      stages=stages)
+                    self._spans(got, stages)
+            return len(batch)
+        frames = []
+        stages = {}
+        got = time.monotonic()
+        n = 0
+        for payload in batch:
+            if st.cancelled.is_set():
+                break
+            data = svc.grpc_frame(payload)
+            if len(data) > conn.io.peer_max_frame:
+                break  # the worker sends it multi-frame
+            if not st.send_window.try_consume(len(data)):
+                break
+            if not conn.conn_window.try_consume(len(data)):
+                st.send_window.credit(len(data))
+                break
+            if not st.headers_sent:
+                if not conn.options.hpack_memo:
+                    # stateful HPACK requires encode->wire atomicity
+                    # under the encoder lock; leave the first message to
+                    # the worker's send_message, which holds it properly
+                    st.send_window.credit(len(data))
+                    conn.conn_window.credit(len(data))
+                    break
+                stages["enc0"] = time.monotonic()
+                block_b = self.server.resp_block(_response_headers())
+                stages["enc1"] = time.monotonic()
+                frames.append((h2.HEADERS, h2.FLAG_END_HEADERS, st.id,
+                               block_b))
+                st.headers_sent = True
+            frames.append((h2.DATA, 0, st.id, data))
+            n += 1
+        if frames:
+            t0 = time.monotonic()
+            on_wire = conn.io.send_frames(frames, block=False)
+            if "enc0" in stages:
+                stages["write0"], stages["write1"] = t0, time.monotonic()
+                self._spans(got, stages)
+            if not on_wire:
+                # bytes parked in the writer backlog (socket full /
+                # write lock contended): same no-waker hazard as an
+                # outbox stall one layer up — the backlog would sit
+                # until the NEXT write on the connection. Downgrade and
+                # wake the worker, whose finish() flushes the writer.
+                self.downgraded = True
+                self._wake_worker()
+        return n
+
+    def _spans(self, got: float, stages: dict) -> None:
+        if self._spans_done:
+            return
+        self._spans_done = True
+        self.server._first_send_spans(self.st, self.source, got, stages)
+
+
 class GRPCServer:
     """Accept loop + RPC dispatch with recovery/logging/tracing interceptors
     (reference grpc.go:22-26 chain order)."""
 
-    def __init__(self, services, port: int, container=None):
+    def __init__(self, services, port: int, container=None,
+                 options: "h2.TransportOptions | None" = None):
         self.services: dict[str, svc.GRPCService] = {
             s.name: s for s in services}
         self.port = port
         self.container = container
         self.logger = container.logger if container is not None else None
         self.tracer = getattr(container, "tracer", None)
+        self.options = options or h2.TransportOptions()
+        # the static response header block, pre-encoded ONCE per server:
+        # stateless (see hpack.encode_stateless), so it is valid on
+        # every connection at any point in its lifetime
+        self._resp_block = encode_stateless(_RESPONSE_HEADERS)
         self._sock: socket.socket | None = None
         self._conns: set[_Connection] = set()
         self._conns_lock = threading.Lock()
         self._accept_thread: threading.Thread | None = None
         self._stopping = False
+
+    def resp_block(self, headers) -> bytes:
+        """Pre-encoded stateless block for the standard response
+        headers; arbitrary header lists fall through to
+        encode_stateless (whose per-pair fragments memoize)."""
+        if tuple(headers) == _RESPONSE_HEADERS:
+            return self._resp_block
+        return encode_stateless(headers)
 
     # -- lifecycle (reference grpc.go:31-46 Run) -----------------------------
     def start(self) -> None:
@@ -496,19 +688,102 @@ class GRPCServer:
             result = method.handler(ctx, request)
 
         if method.server_streaming:
-            for item in result:
-                check_alive()
-                payload = method.response_codec.serialize(item)
-                # coalesced HEADERS+DATA: one write for the first token;
-                # send_message flips headers_sent once they're on the wire
-                conn.send_message(st, payload,
-                                  headers=None if st.headers_sent
-                                  else _response_headers())
+            try:
+                # zero-handoff requires the vectored writer: its sink
+                # writes MUST be nonblocking (the legacy wire path would
+                # park the producing engine thread on a slow client)
+                if (conn.options.zero_handoff and conn.options.vectored
+                        and isinstance(result, svc.ServerStream)
+                        and hasattr(result.source, "set_sink")):
+                    self._serve_push(conn, st, method, result, check_alive,
+                                     deadline)
+                else:
+                    self._serve_iter(conn, st, method, result, check_alive)
+            finally:
+                # ServerStream.close cancels the source (slot release);
+                # plain generators get their normal close
+                close = getattr(result, "close", None)
+                if close is not None:
+                    close()
         else:
             check_alive()
             payload = method.response_codec.serialize(result)
             conn.send_message(st, payload, headers=_response_headers())
         return svc.OK, ""
+
+    def _serve_iter(self, conn: _Connection, st: _Stream, method, result,
+                    check_alive) -> None:
+        """Pull-based server streaming: iterate the handler's generator
+        on this worker thread (the pre-fast-path shape, still used for
+        plain generator handlers and when zero_handoff is off)."""
+        for item in result:
+            check_alive()
+            payload = method.response_codec.serialize(item)
+            # coalesced HEADERS+DATA: one write for the first token;
+            # send_message flips headers_sent once they're on the wire
+            if st.headers_sent:
+                conn.send_message(st, payload)
+            else:
+                got = time.monotonic()
+                stages: dict = {}
+                conn.send_message(st, payload, headers=_response_headers(),
+                                  stages=stages)
+                self._first_send_spans(st, result, got, stages)
+
+    def _serve_push(self, conn: _Connection, st: _Stream, method, result,
+                    check_alive, deadline) -> None:
+        """Zero-handoff server streaming: the producing thread delivers
+        serialized messages straight into the connection's write
+        scheduler — first-token bytes go from the engine's _deliver to
+        the socket without waking this worker. The worker only clears
+        backpressure stalls, serves fallback items, and owns
+        end-of-stream (trailers follow in _finish)."""
+        src = result.source
+        sender = _PushSender(self, conn, st, method.response_codec,
+                             result.map_fn, src, deadline)
+        src.set_sink(sender.sink)
+        try:
+            for item in src:  # items the sink declined + end-of-stream
+                check_alive()
+                if item is wire.WAKE:
+                    sender.finish()  # flush a stalled outbox (sink woke us)
+                    continue
+                sender.send(item)
+            check_alive()
+            sender.finish()
+        finally:
+            # detach BEFORE trailers: a sink firing after END_STREAM
+            # would corrupt the stream
+            clear = getattr(src, "clear_sink", None)
+            if clear is not None:
+                clear()
+
+    def _first_send_spans(self, st: _Stream, source, got: float,
+                          stages: dict) -> None:
+        """TTFT decomposition spans for the FIRST streamed message:
+        grpc.handoff (producer _deliver -> transport), grpc.hpack
+        (header block encode) and grpc.frame-write (the coalesced
+        HEADERS+DATA write). Exported once per stream; bench.py's TTFT
+        section and tools/transport_bench.py aggregate them."""
+        tracer = self.tracer
+        if tracer is None:
+            return
+        tp = st.headers.get("traceparent")
+        trace = getattr(source, "trace", None)
+        if isinstance(trace, dict):
+            first_put = trace.get("first_put")
+            if first_put is not None and first_put <= got:
+                tracer.record_span("grpc.handoff", first_put, got,
+                                   traceparent=tp,
+                                   attributes={"stream": st.id})
+        if "enc0" in stages:
+            tracer.record_span("grpc.hpack", stages["enc0"], stages["enc1"],
+                               traceparent=tp,
+                               attributes={"stream": st.id})
+        if "write0" in stages:
+            tracer.record_span("grpc.frame-write", stages["write0"],
+                               stages["write1"], traceparent=tp,
+                               attributes={"stream": st.id})
 
     def _finish(self, conn: _Connection, st: _Stream, status: int,
                 message: str) -> None:
@@ -527,5 +802,8 @@ class GRPCServer:
             conn.close_stream(st)
 
 
+_RESPONSE_HEADERS = ((":status", "200"), ("content-type", "application/grpc"))
+
+
 def _response_headers() -> list[tuple[str, str]]:
-    return [(":status", "200"), ("content-type", "application/grpc")]
+    return list(_RESPONSE_HEADERS)

@@ -42,6 +42,14 @@ STATUS_NAMES = {
 }
 
 
+def grpc_frame(payload: bytes) -> bytes:
+    """gRPC length-prefixed message framing (RFC: compressed-flag byte,
+    always 0 here, + u32 big-endian length). THE single definition —
+    both transports' fast and fallback send paths must stay
+    byte-compatible."""
+    return b"\x00" + len(payload).to_bytes(4, "big") + payload
+
+
 class GRPCError(Exception):
     """Raise from a handler to return a specific gRPC status."""
 
@@ -89,6 +97,50 @@ class Method:
         self.response_codec = response_codec
         self.server_streaming = server_streaming
         self.client_streaming = client_streaming
+
+
+class ServerStream:
+    """Server-streaming response wrapper that unlocks the transport's
+    zero-handoff fast path.
+
+    ``source`` is a push-capable stream — anything with the
+    ``set_sink``/iterator protocol of ``gofr_tpu.wire.PushStream``
+    (``GenStream`` qualifies) — and ``map_fn`` turns each item into the
+    response message::
+
+        @llm.server_stream("Generate")
+        def generate(ctx, req):
+            s = ctx.tpu.generate(req["tokens"], max_new_tokens=64)
+            return ServerStream(s, lambda tok: {"token": tok})
+
+    With a ServerStream the transport serializes and writes each token
+    ON THE PRODUCING THREAD (no worker wakeup between the engine's
+    ``_deliver`` and the socket); a plain generator handler keeps the
+    classic pull path. Iterating a ServerStream degrades gracefully to
+    the mapped items, so the same handler works when zero-handoff is
+    disabled. ``close()`` is called by the transport when the RPC ends
+    and cancels the source, releasing whatever it holds (engine slot)."""
+
+    __slots__ = ("source", "map_fn")
+
+    def __init__(self, source, map_fn: "Callable | None" = None):
+        self.source = source
+        self.map_fn = map_fn or (lambda item: item)
+
+    def __iter__(self):
+        for item in self.source:
+            yield self.map_fn(item)
+
+    def close(self) -> None:
+        cancel = getattr(self.source, "cancel", None)
+        if cancel is not None:
+            cancel()
+
+    @property
+    def trace(self):
+        """Delivery stamps of the source (GenStream sets first_put) —
+        feeds the transport's grpc.handoff span."""
+        return getattr(self.source, "trace", None)
 
 
 class GRPCContext:

@@ -40,6 +40,16 @@ class ResponseWriter:
         self._streaming = True
         self._chunks.append(data)
 
+    def stream_from(self, source) -> None:
+        """Drain a chunk source (any iterable of bytes). The live HTTP
+        server replaces this per-request with a zero-handoff writer
+        that lets a push-capable source (``GenStream.map(...)``, see
+        gofr_tpu.wire.PushStream) deliver chunks on the producing
+        thread; this default just iterates, which keeps handler tests
+        and non-streaming servers working unchanged."""
+        for chunk in source:
+            self.write_chunk(bytes(chunk))
+
 
 class Raw:
     """Bypass the envelope: serialize ``data`` as-is

@@ -12,9 +12,14 @@ from __future__ import annotations
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..wire import SocketWriter, WAKE
 from .request import Request
 from .responder import ResponseWriter
 from .router import Router
+
+
+def _chunk(data: bytes) -> bytes:
+    return b"%x\r\n" % len(data) + data + b"\r\n"
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -39,28 +44,85 @@ class _Handler(BaseHTTPRequestHandler):
         )
         w = ResponseWriter()
         stream_started = threading.Event()
+        # streaming writes bypass wfile: one SocketWriter per request
+        # carries status+headers+first chunk in a single vectored write
+        # and lets a zero-handoff sink park bytes nonblocking (wfile is
+        # an unbuffered per-write sendall)
+        raw: list[SocketWriter] = []
 
-        if hasattr(self.server, "_gofr_streaming_hook"):
-            pass  # reserved
+        def _writer() -> SocketWriter:
+            if not raw:
+                raw.append(SocketWriter(self.connection))
+            return raw[0]
+
+        def _stream_head() -> bytes:
+            """Status line + headers, assembled by hand so they can ride
+            in the same syscall as the first chunk (BaseHTTPRequestHandler
+            flushes its header buffer on end_headers)."""
+            phrase = self.responses.get(w.status, ("", ""))[0]
+            head = [f"{self.protocol_version} {w.status} {phrase}",
+                    f"Server: {self.version_string()}",
+                    f"Date: {self.date_time_string()}"]
+            head += [f"{k}: {v}" for k, v in w.headers.items()]
+            head.append("Transfer-Encoding: chunked")
+            return ("\r\n".join(head) + "\r\n\r\n").encode("latin-1")
+
+        def _emit_chunk(data: bytes, block: bool) -> bool:
+            if not stream_started.is_set():
+                stream_started.set()
+                # headers + first chunk: ONE write, one packet on the
+                # wire — the HTTP mirror of the gRPC HEADERS+DATA
+                # coalescing on the first-token path
+                return _writer().write([_stream_head(), _chunk(data)],
+                                       block=block)
+            return _writer().write(_chunk(data), block=block)
 
         try:
             # streaming: if a handler writes chunks, flush them live
             original_write_chunk = w.write_chunk
+            original_stream_from = w.stream_from
 
             def live_chunk(data: bytes) -> None:
-                if not stream_started.is_set():
-                    stream_started.set()
-                    self.send_response(w.status)
-                    for k, v in w.headers.items():
-                        self.send_header(k, v)
-                    self.send_header("Transfer-Encoding", "chunked")
-                    self.end_headers()
-                self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
-                self.wfile.flush()
+                _emit_chunk(data, block=True)
+
+            def live_stream_from(source) -> None:
+                """Zero-handoff chunk streaming: a push-capable source
+                (GenStream.map(...)) delivers each chunk on the
+                PRODUCING thread via a nonblocking sink; this handler
+                thread only waits for end-of-stream and flushes."""
+                w._streaming = True
+
+                wake = getattr(source, "wake", None)
+
+                def sink(data: bytes) -> bool:
+                    if not _emit_chunk(bytes(data), block=False) \
+                            and wake is not None:
+                        # bytes parked in the writer backlog have no
+                        # other waker until the next chunk — rouse this
+                        # handler thread to flush them
+                        wake()
+                    return True
+
+                set_sink = getattr(source, "set_sink", None)
+                if set_sink is not None:
+                    set_sink(sink)
+                try:
+                    for chunk in source:  # declined items + end detection
+                        if chunk is WAKE:
+                            _writer().flush()  # drain sink-parked bytes
+                            continue
+                        live_chunk(bytes(chunk))
+                finally:
+                    clear = getattr(source, "clear_sink", None)
+                    if clear is not None:
+                        clear()
+                _writer().flush()  # drain bytes the sink parked
 
             w.write_chunk = live_chunk  # type: ignore[method-assign]
+            w.stream_from = live_stream_from  # type: ignore[method-assign]
             self.router(req, w)
             w.write_chunk = original_write_chunk  # type: ignore[method-assign]
+            w.stream_from = original_stream_from  # type: ignore[method-assign]
         except (BrokenPipeError, ConnectionResetError):
             return
         except Exception as e:  # router middleware should have caught this
@@ -73,8 +135,8 @@ class _Handler(BaseHTTPRequestHandler):
 
         try:
             if stream_started.is_set():
-                self.wfile.write(b"0\r\n\r\n")
-                self.wfile.flush()
+                # blocking terminal chunk: drains any sink backlog first
+                _writer().write(b"0\r\n\r\n", block=True)
                 return
             self.send_response(w.status)
             for k, v in w.headers.items():

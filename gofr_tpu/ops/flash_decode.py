@@ -294,6 +294,14 @@ def decode_attention_auto(q, k_cache, v_cache, k_new, v_new, lengths,
     ``block_s`` defaults from GOFR_FLASH_BLOCK_S (128): larger blocks
     amortize per-grid-step overhead, at (block_s/S)-granular DMA skip."""
     explicit = False
+    if block_s is not None and block_s <= 0:
+        # explicit caller value, same ZeroDivision hazard as the env
+        # path below (smax % block_s inside _kernel_gate) — clamp to
+        # the default rather than crash, and say so once
+        _warn_block_s_once(
+            "invalid", f"block_s={block_s!r} is not a positive integer; "
+            "using the default block_s=128")
+        block_s = 128
     if block_s is None:
         import os
 

@@ -33,7 +33,7 @@ import os
 import queue
 import threading
 import time
-from typing import Any, Iterator
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +41,7 @@ import numpy as np
 
 from ..models import llama
 from ..models.common import ModelConfig
+from ..wire import PushStream
 from .batcher import pad_bucket
 
 _REQ_IDS = itertools.count(1)
@@ -99,14 +100,20 @@ class GenerationError(RuntimeError):
     pass
 
 
-class GenStream:
-    """Iterator over generated token ids; ``cancel()`` releases the slot."""
+class GenStream(PushStream):
+    """Iterator over generated token ids; ``cancel()`` releases the slot.
+
+    A PushStream: transports may register a zero-handoff sink
+    (``set_sink``) so the serving loop's ``_deliver`` hands each token
+    straight to the connection writer instead of waking a consumer
+    thread — the first-token fast path of the gRPC/HTTP streamers.
+    ``stream.map(fn)`` adapts tokens to messages/chunks for either."""
 
     def __init__(self, request_id: int, engine: "GenerationEngine",
                  logprobs: bool = False):
+        super().__init__()  # _q + sink state (wire.PushStream)
         self.request_id = request_id
         self._engine = engine
-        self._q: queue.Queue = queue.Queue()
         self.cancelled = threading.Event()
         self.prompt_len = 0
         self.logprobs = logprobs  # items are (token, logprob) tuples
@@ -124,15 +131,6 @@ class GenStream:
         self.trace_id: str = ""
         self.obs_entry = None
         self.failed: str | None = None  # set by the loop's error handler
-
-    def __iter__(self) -> "Iterator[int] | Iterator[tuple[int, float]]":
-        while True:
-            item = self._q.get()
-            if item is None:
-                return
-            if isinstance(item, BaseException):
-                raise item
-            yield item
 
     def tokens(self) -> list[int]:
         """Drain the whole stream (blocking) into a list of ids
@@ -1689,7 +1687,10 @@ class GenerationEngine:
         # in one host loop, and per-delivery gaps would report microsecond
         # burst artifacts instead of device cadence
         slot.last_token_t = now
-        req.stream._q.put((token, lp) if req.logprobs else token)
+        # _push: straight into a registered transport sink (zero-handoff
+        # delivery — bytes leave on THIS thread, nonblocking) or the
+        # stream queue for iterator consumers
+        req.stream._push((token, lp) if req.logprobs else token)
         slot.generated += 1
         slot.remaining -= 1
         self.total_tokens += 1
@@ -1731,7 +1732,7 @@ class GenerationEngine:
         if stream.failed is not None:
             fields["error"] = stream.failed
         self._obs_end(stream, event, **fields)
-        slot.request.stream._q.put(None)
+        slot.request.stream._push(None)
         slot.request = None
         self._active[idx] = False
         self._temps[idx] = 0.0

@@ -115,3 +115,21 @@ def test_block_s_env_invalid_value_warns(monkeypatch):
     want = decode_attention_appended(q, k, v, k_new, v_new, lens, sk, sv)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_explicit_nonpositive_block_s_is_clamped(monkeypatch):
+    """An EXPLICIT caller block_s <= 0 must clamp to the default instead
+    of reaching the smax % block_s ZeroDivisionError inside the kernel
+    gate (ADVICE r5 #3) — the env var was guarded, the argument wasn't."""
+    from gofr_tpu.ops import flash_decode as fd
+
+    q, k, v, k_new, v_new, sk, sv = _mk(jax.random.PRNGKey(5), True)
+    lens = jnp.asarray([10, 20, 30], jnp.int32)
+    want = decode_attention_appended(q, k, v, k_new, v_new, lens, sk, sv)
+    for bad in (0, -3):
+        monkeypatch.setattr(fd, "_block_s_warned", set())
+        with pytest.warns(RuntimeWarning, match="not a positive"):
+            got = decode_attention_auto(q, k, v, k_new, v_new, lens,
+                                        sk, sv, block_s=bad)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)

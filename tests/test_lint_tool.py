@@ -120,3 +120,33 @@ def test_noqa_inside_string_literal_does_not_exempt(tmp_path):
     rc, out = run_lint_at(tmp_path / "gofr_tpu" / "sneaky.py",
                           'print("see # noqa: T201 in docs")\n')
     assert rc == 1 and "T201" in out
+
+
+def test_format_spec_names_count_for_f401(tmp_path):
+    # a name used ONLY inside a nested format spec (f"{x:{width}}") is a
+    # real usage — F401 must see it (ADVICE r5 #4); F541 stays muted for
+    # the spec's placeholder-less JoinedStr
+    src = ("from shutil import get_terminal_size as width_of\n\n"
+           "x = 1.5\n"
+           "y = f\"{x:{width_of()[0]}}\"\n")
+    rc, out = run_lint(tmp_path, src)
+    assert "F401" not in out, out
+    assert "F541" not in out, out
+
+    # pin the AST-level recording too: the end-to-end run above is also
+    # saved by the word-boundary text fallback, which must stay a last
+    # resort, not the mechanism
+    import ast
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("lint_tool_mod", LINT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    checker = mod.Checker("case.py", ast.parse(src), False, src)
+    assert "width_of" in checker.used
+
+
+def test_real_f541_still_flagged_next_to_format_specs(tmp_path):
+    src = ('x = 2\na = f"{x:{x}}"\nb = f"static"\n')
+    rc, out = run_lint(tmp_path, src)
+    assert rc == 1 and out.count("F541") == 1 and ":3:" in out

@@ -68,6 +68,7 @@ class Checker(ast.NodeVisitor):
         self._toplevel_defs: dict[str, int] = {}
         self._source = source
         self._comments: dict[int, str] | None = None  # built on first _noqa
+        self._in_format_spec = False
         self.visit(tree)
 
     def add(self, node, code, msg):
@@ -216,15 +217,25 @@ class Checker(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_JoinedStr(self, node):
-        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+        # F541 is suppressed inside a format spec: `{x:.2f}` parses as a
+        # nested placeholder-less JoinedStr there, which is not an
+        # f-string the author wrote
+        if not self._in_format_spec and \
+                not any(isinstance(v, ast.FormattedValue) for v in node.values):
             self.add(node, "F541", "f-string without placeholders")
         self.generic_visit(node)
 
     def visit_FormattedValue(self, node):
-        # do NOT recurse into format_spec: `{x:.2f}` parses as a nested
-        # placeholder-less JoinedStr there, which is not an f-string the
-        # author wrote
         self.visit(node.value)
+        if node.format_spec is not None:
+            # names inside nested format specs (f"{x:{width}}") are real
+            # usages — F401 must see them; only the F541 check is muted
+            prev = self._in_format_spec
+            self._in_format_spec = True
+            try:
+                self.visit(node.format_spec)
+            finally:
+                self._in_format_spec = prev
 
     # -- finish -----------------------------------------------------------
     def finish(self):
