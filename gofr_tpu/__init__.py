@@ -31,11 +31,13 @@ from .errors import (
     EntityNotFound,
     InternalServerError,
 )
+from .errors import DeadlineExceeded, ServiceUnavailable, TooManyRequests
 from .config import Config, EnvConfig, MapConfig
 from .glog import Logger, LogLevel, new_logger
 from .context import Context
 from .container import Container
 from .app import App, new_app, new_cmd
+from .resilience import AdmissionGate, Deadline, current_deadline, deadline_scope
 
 __all__ = [
     "__version__",
@@ -59,4 +61,11 @@ __all__ = [
     "NotFound",
     "EntityNotFound",
     "InternalServerError",
+    "ServiceUnavailable",
+    "TooManyRequests",
+    "DeadlineExceeded",
+    "AdmissionGate",
+    "Deadline",
+    "current_deadline",
+    "deadline_scope",
 ]

@@ -29,6 +29,8 @@ from .http.middleware import (
     apikey_auth_middleware,
     basic_auth_middleware,
     cors_middleware,
+    deadline_middleware,
+    drain_middleware,
     inflight_middleware,
     logging_middleware,
     metrics_middleware,
@@ -86,14 +88,24 @@ class App:
         self.subscription_manager = SubscriptionManager(self.container)
         self._cmd_routes: list[tuple] = []
         self._running = threading.Event()
+        # graceful-drain readiness: flipped FIRST in stop(grace_s>0) so
+        # load balancers stop routing before the engine stops serving
+        self._draining = False
+        self._drain_retry_after: float | None = None
 
         # Middleware chain in reference order (http/router.go:19-24):
         # Tracer -> Logging(+recovery) -> CORS -> Metrics [-> auth];
         # the in-flight registry sits right after Tracer so /debug/requests
         # entries carry the request's trace id for its whole lifetime.
+        # The drain gate runs OUTERMOST (a draining server rejects in
+        # microseconds, before any span/log work); the deadline scope
+        # sits inside logging so 504s are logged with their real status.
+        self.router.use(drain_middleware(lambda: self._draining,
+                                         lambda: self._drain_retry_after))
         self.router.use(tracer_middleware(self.container.tracer))
         self.router.use(inflight_middleware(self.container.observe.requests))
         self.router.use(logging_middleware(self.logger))
+        self.router.use(deadline_middleware())
         self.router.use(cors_middleware())
         self.router.use(metrics_middleware(self.container.metrics))
 
@@ -281,15 +293,25 @@ class App:
                 self.stop()
 
     def stop(self, grace_s: float = 0.0) -> None:
-        """Stop the app. ``grace_s > 0`` drains first, k8s-style: pub/sub
-        consumption stops (no new work generated), the TPU generation
-        engine refuses new requests but finishes every in-flight stream
-        (up to the grace window) WHILE the HTTP/gRPC listeners stay up —
+        """Stop the app. ``grace_s > 0`` drains first, k8s-style, and the
+        FIRST act of the grace window is flipping readiness: HTTP
+        ``/.well-known/health`` answers 503 + Retry-After and gRPC
+        health reports NOT_SERVING, so load balancers stop routing
+        BEFORE the engine stops serving. New requests then get
+        503/UNAVAILABLE + Retry-After while pub/sub consumption stops,
+        and the TPU generation engine finishes every in-flight stream
+        (up to the grace window) WITH the HTTP/gRPC listeners still up —
         clients receive complete streams over their live connections —
         then everything tears down. The reference stops its servers with
         Go's graceful http.Server.Shutdown; streaming engines need the
-        engine-level drain on top."""
+        readiness flip + engine-level drain on top."""
         if grace_s > 0:
+            self._drain_retry_after = grace_s
+            self._draining = True  # HTTP readiness: health 503, new -> 503
+            if self._grpc_server is not None:
+                self._grpc_server.start_draining(retry_after=grace_s)
+            self.logger.info({"event": "drain started: readiness down",
+                              "grace_s": grace_s})
             self.subscription_manager.stop()
             tpu = getattr(self.container, "tpu", None)
             gen = getattr(tpu, "generator", None)

@@ -68,6 +68,17 @@ class Context:
             return self.request.header(key, default)
         return default
 
+    @property
+    def deadline(self):
+        """The request's resilience.Deadline (parsed from
+        ``X-Request-Timeout`` / gRPC ``grpc-timeout`` by the transport),
+        or None. Ambient: ``ctx.tpu.predict``/``generate`` honor it
+        without being passed it explicitly; read it here to budget your
+        own work (``ctx.deadline.remaining()``)."""
+        from .resilience import current_deadline
+
+        return current_deadline()
+
     # -- streaming (no reference equivalent: the reference has no HTTP
     # streaming path; needed for token streaming over chunked responses) ----
     def stream(self, chunks, content_type: str = "application/x-ndjson") -> None:

@@ -10,6 +10,16 @@ unexpected exceptions become 500s in the recovery middleware
 
 from __future__ import annotations
 
+import math
+
+
+def format_retry_after(seconds: float) -> str:
+    """The one Retry-After wire formatter (HTTP header, gRPC trailer,
+    drain responses): delta-seconds per RFC 9110 §10.2.3, ceiling so a
+    90.4 s estimate never under-advises as 90, floored at 1 because 0
+    invites an instant retry storm."""
+    return str(max(1, math.ceil(seconds)))
+
 
 class GofrError(Exception):
     """Base class for all framework errors."""
@@ -73,6 +83,36 @@ class InternalServerError(HTTPError):
 
 class ServiceUnavailable(HTTPError):
     status_code = 503
+
+
+class TooManyRequests(HTTPError):
+    """Shed by an admission gate (resilience.AdmissionGate): the queue is
+    over its configured bound, so the request fails FAST instead of
+    joining a line that would blow its own latency budget. Carries the
+    gate's wait estimate as ``Retry-After`` (the responder emits
+    ``headers``; the gRPC transport maps 429 -> RESOURCE_EXHAUSTED)."""
+
+    status_code = 429
+
+    def __init__(self, message: str = "", retry_after: float | None = None):
+        super().__init__(message or "too many requests")
+        self.retry_after = retry_after
+        self.headers: dict[str, str] = {}
+        if retry_after is not None:
+            self.headers["Retry-After"] = format_retry_after(retry_after)
+
+
+class DeadlineExceeded(HTTPError):
+    """The caller's deadline (gRPC ``grpc-timeout`` / HTTP
+    ``X-Request-Timeout``) expired before the work completed — including
+    while still queued, in which case the dispatcher dropped the item
+    without ever executing it (resilience.md). 504 on HTTP; the gRPC
+    transport maps it to DEADLINE_EXCEEDED."""
+
+    status_code = 504
+
+    def __init__(self, message: str = "deadline exceeded"):
+        super().__init__(message)
 
 
 class CircuitOpenError(ServiceUnavailable):

@@ -25,7 +25,8 @@ import urllib.parse
 from . import http2 as h2
 from . import service as svc
 from .hpack import Decoder, Encoder, encode_stateless
-from .. import wire
+from .. import chaos, wire
+from ..resilience import Deadline, deadline_scope
 from ..wire import Outbox
 
 _GRPC_CONTENT_TYPES = ("application/grpc",)
@@ -501,6 +502,10 @@ class GRPCServer:
                  options: "h2.TransportOptions | None" = None):
         self.services: dict[str, svc.GRPCService] = {
             s.name: s for s in services}
+        self._draining = False
+        self._drain_retry_after: float | None = None
+        if "grpc.health.v1.Health" not in self.services:
+            self._install_health_service()
         self.port = port
         self.container = container
         self.logger = container.logger if container is not None else None
@@ -515,6 +520,30 @@ class GRPCServer:
         self._conns_lock = threading.Lock()
         self._accept_thread: threading.Thread | None = None
         self._stopping = False
+
+    def _install_health_service(self) -> None:
+        """Built-in readiness service (grpc.health.v1 shape, JSON codec):
+        load balancers poll Check and see NOT_SERVING the moment a
+        graceful drain starts — BEFORE the engine stops taking work —
+        so routing moves away while in-flight streams finish."""
+        health = svc.GRPCService("grpc.health.v1.Health")
+
+        def check(ctx, req):
+            return {"status": "NOT_SERVING" if self._draining else "SERVING"}
+
+        health.unary("Check", check)
+        self.services[health.name] = health
+
+    def start_draining(self, retry_after: float | None = None) -> None:
+        """Flip readiness for a graceful drain: health reports
+        NOT_SERVING and NEW RPCs are refused with UNAVAILABLE (+
+        retry-after trailer) while streams already dispatched run to
+        completion over their live connections."""
+        self._draining = True
+        self._drain_retry_after = retry_after
+        if self.logger is not None:
+            self.logger.info({"event": "grpc server draining",
+                              "retry_after_s": retry_after})
 
     def resp_block(self, headers) -> bytes:
         """Pre-encoded stateless block for the standard response
@@ -587,25 +616,37 @@ class GRPCServer:
         path = st.headers.get(":path", "")
         start = time.monotonic()
         status, message = svc.OK, ""
+        retry_after: float | None = None
         span = None
         if self.tracer is not None:
             span = self.tracer.start_span(
                 f"grpc{path}", traceparent=st.headers.get("traceparent"),
                 attributes={"rpc.system": "grpc", "rpc.method": path})
         try:
+            chaos.fire(chaos.GRPC_STREAM)
             status, message = self._invoke(conn, st, path)
         except svc.GRPCError as e:
             status, message = e.code, e.message
+            retry_after = getattr(e, "retry_after", None)
         except (EOFError, OSError, TimeoutError) as e:
             status, message = svc.UNAVAILABLE, f"transport: {e!r}"
         except Exception as e:  # noqa: BLE001 — recovery interceptor
-            status, message = svc.INTERNAL, "internal error"
-            if self.logger is not None:
-                self.logger.error({"event": "grpc panic recovered",
-                                   "method": path, "error": repr(e),
-                                   "traceback": traceback.format_exc(limit=8)})
+            if hasattr(e, "status_code"):
+                # framework HTTPError: one status vocabulary across
+                # transports (DeadlineExceeded -> DEADLINE_EXCEEDED,
+                # TooManyRequests shed -> RESOURCE_EXHAUSTED + retry-after)
+                ge = svc.from_http_error(e)
+                status, message = ge.code, ge.message
+                retry_after = getattr(e, "retry_after", None)
+            else:
+                status, message = svc.INTERNAL, "internal error"
+                if self.logger is not None:
+                    self.logger.error({
+                        "event": "grpc panic recovered",
+                        "method": path, "error": repr(e),
+                        "traceback": traceback.format_exc(limit=8)})
         finally:
-            self._finish(conn, st, status, message)
+            self._finish(conn, st, status, message, retry_after=retry_after)
             if span is not None:
                 span.set_attribute("rpc.grpc.status_code", status)
                 span.end()
@@ -632,6 +673,14 @@ class GRPCServer:
         if method is None:
             raise svc.GRPCError(svc.UNIMPLEMENTED,
                                 f"unknown method {path!r}")
+        if self._draining and service_name != "grpc.health.v1.Health":
+            # readiness flipped first (App.stop grace window): streams
+            # already dispatched finish; NEW ones are refused fast with
+            # a retry hint. Health stays reachable so pollers observe
+            # NOT_SERVING rather than a vanished endpoint.
+            e = svc.GRPCError(svc.UNAVAILABLE, "server draining")
+            e.retry_after = self._drain_retry_after
+            raise e
 
         timeout = parse_grpc_timeout(st.headers.get("grpc-timeout"))
         deadline = time.monotonic() + timeout if timeout else None
@@ -665,50 +714,58 @@ class GRPCServer:
                 raise svc.GRPCError(svc.INVALID_ARGUMENT,
                                     f"bad request: {e!r}") from None
 
-        if method.client_streaming:
-            # handler receives a lazy iterator over the request stream; it
-            # ends at the client's half-close (END_STREAM), errors surface
-            # in-loop, and cancellation/deadline are re-checked per message
-            def request_iter():
-                while True:
-                    check_alive()
-                    msg = one_message()
-                    if msg is None:
-                        return
-                    yield msg
+        # the wire deadline becomes AMBIENT for the handler thread:
+        # ctx.tpu.predict / generate pick it up without per-call
+        # plumbing, so expired work is dropped before the device sees it
+        with deadline_scope(Deadline(deadline) if deadline is not None
+                            else None):
+            if method.client_streaming:
+                # handler receives a lazy iterator over the request
+                # stream; it ends at the client's half-close
+                # (END_STREAM), errors surface in-loop, and
+                # cancellation/deadline are re-checked per message
+                def request_iter():
+                    while True:
+                        check_alive()
+                        msg = one_message()
+                        if msg is None:
+                            return
+                        yield msg
 
-            check_alive()
-            result = method.handler(ctx, request_iter())
-        else:
-            request = one_message()
-            if request is None:
-                raise svc.GRPCError(svc.INVALID_ARGUMENT,
-                                    "no request message")
-            check_alive()
-            result = method.handler(ctx, request)
+                check_alive()
+                result = method.handler(ctx, request_iter())
+            else:
+                request = one_message()
+                if request is None:
+                    raise svc.GRPCError(svc.INVALID_ARGUMENT,
+                                        "no request message")
+                check_alive()
+                result = method.handler(ctx, request)
 
-        if method.server_streaming:
-            try:
-                # zero-handoff requires the vectored writer: its sink
-                # writes MUST be nonblocking (the legacy wire path would
-                # park the producing engine thread on a slow client)
-                if (conn.options.zero_handoff and conn.options.vectored
-                        and isinstance(result, svc.ServerStream)
-                        and hasattr(result.source, "set_sink")):
-                    self._serve_push(conn, st, method, result, check_alive,
-                                     deadline)
-                else:
-                    self._serve_iter(conn, st, method, result, check_alive)
-            finally:
-                # ServerStream.close cancels the source (slot release);
-                # plain generators get their normal close
-                close = getattr(result, "close", None)
-                if close is not None:
-                    close()
-        else:
-            check_alive()
-            payload = method.response_codec.serialize(result)
-            conn.send_message(st, payload, headers=_response_headers())
+            if method.server_streaming:
+                try:
+                    # zero-handoff requires the vectored writer: its sink
+                    # writes MUST be nonblocking (the legacy wire path
+                    # would park the producing engine thread on a slow
+                    # client)
+                    if (conn.options.zero_handoff and conn.options.vectored
+                            and isinstance(result, svc.ServerStream)
+                            and hasattr(result.source, "set_sink")):
+                        self._serve_push(conn, st, method, result,
+                                         check_alive, deadline)
+                    else:
+                        self._serve_iter(conn, st, method, result,
+                                         check_alive)
+                finally:
+                    # ServerStream.close cancels the source (slot
+                    # release); plain generators get their normal close
+                    close = getattr(result, "close", None)
+                    if close is not None:
+                        close()
+            else:
+                check_alive()
+                payload = method.response_codec.serialize(result)
+                conn.send_message(st, payload, headers=_response_headers())
         return svc.OK, ""
 
     def _serve_iter(self, conn: _Connection, st: _Stream, method, result,
@@ -786,12 +843,19 @@ class GRPCServer:
                                attributes={"stream": st.id})
 
     def _finish(self, conn: _Connection, st: _Stream, status: int,
-                message: str) -> None:
+                message: str, retry_after: float | None = None) -> None:
         try:
             trailers = [("grpc-status", str(status))]
             if message:
                 trailers.append(("grpc-message",
                                  urllib.parse.quote(message, safe=" ")))
+            if retry_after is not None:
+                # shed/drain backpressure hint the client-side retry
+                # policy reads before computing its own backoff
+                from ..errors import format_retry_after
+
+                trailers.append(("retry-after",
+                                 format_retry_after(retry_after)))
             if not st.headers_sent:
                 # trailers-only response
                 trailers = _response_headers() + trailers

@@ -28,6 +28,7 @@ UNKNOWN = 2
 INVALID_ARGUMENT = 3
 DEADLINE_EXCEEDED = 4
 NOT_FOUND = 5
+PERMISSION_DENIED = 7
 RESOURCE_EXHAUSTED = 8
 UNIMPLEMENTED = 12
 INTERNAL = 13
@@ -36,10 +37,34 @@ UNAUTHENTICATED = 16
 
 STATUS_NAMES = {
     0: "OK", 1: "CANCELLED", 2: "UNKNOWN", 3: "INVALID_ARGUMENT",
-    4: "DEADLINE_EXCEEDED", 5: "NOT_FOUND", 8: "RESOURCE_EXHAUSTED",
-    12: "UNIMPLEMENTED", 13: "INTERNAL", 14: "UNAVAILABLE",
-    16: "UNAUTHENTICATED",
+    4: "DEADLINE_EXCEEDED", 5: "NOT_FOUND", 7: "PERMISSION_DENIED",
+    8: "RESOURCE_EXHAUSTED", 12: "UNIMPLEMENTED", 13: "INTERNAL",
+    14: "UNAVAILABLE", 16: "UNAUTHENTICATED",
 }
+
+# One status vocabulary across both transports: a framework error raised
+# with an HTTP status (errors.HTTPError subclasses — DeadlineExceeded 504,
+# TooManyRequests 429, ServiceUnavailable 503, ...) maps to the
+# equivalent gRPC code, so ``ctx.tpu.predict`` raising past its deadline
+# is DEADLINE_EXCEEDED on gRPC and 504 on HTTP from the same exception.
+HTTP_TO_GRPC_STATUS = {
+    400: INVALID_ARGUMENT,
+    401: UNAUTHENTICATED,
+    403: PERMISSION_DENIED,
+    404: NOT_FOUND,
+    408: DEADLINE_EXCEEDED,
+    429: RESOURCE_EXHAUSTED,
+    499: CANCELLED,
+    501: UNIMPLEMENTED,
+    503: UNAVAILABLE,
+    504: DEADLINE_EXCEEDED,
+}
+
+
+def from_http_error(e: BaseException) -> "GRPCError":
+    """Bridge an errors.HTTPError-shaped exception into a GRPCError."""
+    code = HTTP_TO_GRPC_STATUS.get(getattr(e, "status_code", 500), INTERNAL)
+    return GRPCError(code, str(e) or STATUS_NAMES.get(code, str(code)))
 
 
 def grpc_frame(payload: bytes) -> bytes:

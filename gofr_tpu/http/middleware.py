@@ -19,7 +19,8 @@ import threading
 import time
 from typing import Callable, Iterable
 
-from ..errors import HTTPError
+from ..errors import HTTPError, format_retry_after
+from ..resilience import Deadline, deadline_scope, parse_http_timeout
 from .request import Request
 from .responder import ResponseWriter
 from .router import Handler, Middleware
@@ -118,6 +119,46 @@ def cors_middleware(allowed_origin: str = "*",
             w.set_header("Access-Control-Allow-Methods", allowed_methods)
             if req.method == "OPTIONS":
                 w.status = 200
+                return
+            next_h(req, w)
+        return wrapped
+    return mw
+
+
+def deadline_middleware(header: str = "X-Request-Timeout") -> Middleware:
+    """Parse the request's timeout header into an AMBIENT deadline
+    (resilience.deadline_scope) for the handler's thread — the HTTP
+    mirror of gRPC's ``grpc-timeout``. Downstream, ``ctx.tpu.predict``
+    and ``generate`` cap their waits to the remaining budget and the
+    dispatcher drops the item unexecuted if it expires while queued
+    (-> 504 with ``app_tpu_expired_dropped_total`` incremented)."""
+    def mw(next_h: Handler) -> Handler:
+        def wrapped(req: Request, w: ResponseWriter) -> None:
+            timeout = parse_http_timeout(req.header(header))
+            if timeout is None:
+                return next_h(req, w)
+            with deadline_scope(Deadline.after(timeout)):
+                next_h(req, w)
+        return wrapped
+    return mw
+
+
+def drain_middleware(is_draining: Callable[[], bool],
+                     retry_after: Callable[[], float | None]) -> Middleware:
+    """Readiness gate for graceful shutdown: once the app starts
+    draining, NEW requests get 503 + Retry-After immediately (load
+    balancers stop routing; clients back off) while requests already
+    inside a handler run to completion on their own threads. The
+    liveness probe stays 200 — the process is healthy, just leaving."""
+    def mw(next_h: Handler) -> Handler:
+        def wrapped(req: Request, w: ResponseWriter) -> None:
+            if is_draining() and req.path != "/.well-known/alive":
+                w.status = 503
+                ra = retry_after()
+                if ra is not None:
+                    w.set_header("Retry-After", format_retry_after(ra))
+                w.set_header("Content-Type", "application/json")
+                w.write(b'{"error":{"message":"server draining"}}')
                 return
             next_h(req, w)
         return wrapped

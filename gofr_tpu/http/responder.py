@@ -102,6 +102,11 @@ class Responder:
             detail = (error.to_dict() if isinstance(error, HTTPError)
                       else {"message": str(error) or "internal server error"})
             w.status = status
+            # errors may carry response headers (TooManyRequests ->
+            # Retry-After; drain -> Retry-After): honest backpressure
+            # the client-side retry policy reads
+            for k, v in getattr(error, "headers", {}).items():
+                w.set_header(k, str(v))
             w.set_header("Content-Type", "application/json")
             w.write(json.dumps({"error": detail}, default=str).encode())
             return

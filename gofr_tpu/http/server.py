@@ -12,6 +12,7 @@ from __future__ import annotations
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .. import chaos
 from ..wire import SocketWriter, WAKE
 from .request import Request
 from .responder import ResponseWriter
@@ -33,6 +34,7 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _handle(self) -> None:
+        chaos.fire(chaos.HTTP_REQUEST)
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
         req = Request(

@@ -291,6 +291,20 @@ def register_framework_metrics(m: Manager) -> None:
     m.new_counter("app_tpu_paged_evictions_total",
                   "streams truncated early by paged KV pool exhaustion")
 
+    # overload-safety family (gofr_tpu/resilience: deadlines, admission
+    # control, brownout — see docs/advanced-guide/resilience.md)
+    m.new_counter("app_tpu_expired_dropped_total",
+                  "queued requests dropped at dispatch because the caller's "
+                  "deadline expired (never executed)")
+    m.new_counter("app_tpu_shed_total",
+                  "requests rejected early by the admission gate "
+                  "(429/RESOURCE_EXHAUSTED with Retry-After)")
+    m.new_counter("app_tpu_brownout_capped_total",
+                  "generation requests whose max_new_tokens was capped by "
+                  "the brownout band")
+    m.new_gauge("app_tpu_brownout_active",
+                "1 while the admission gate's brownout band is engaged")
+
     # serving-path telemetry (gofr_tpu/observe: the inference flight
     # recorder's metric face)
     m.new_histogram("app_tpu_ttft_duration",

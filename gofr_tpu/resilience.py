@@ -1,0 +1,310 @@
+"""Overload-safe serving primitives: deadlines, admission control, brownout.
+
+No reference equivalent (the reference's resilience surface is the
+client-side circuit breaker, pkg/gofr/service/circuit_breaker.go; nothing
+server-side sheds load). This module is the serving-side discipline of
+Dean & Barroso's "The Tail at Scale" applied to GoFr's one-Context
+handler model:
+
+  - ``Deadline``: one absolute-monotonic expiry threaded from the wire
+    (gRPC ``grpc-timeout`` / HTTP ``X-Request-Timeout``) to the chip
+    (batcher items, generation requests) and back. The transport parses
+    it once and opens a ``deadline_scope``; everything downstream —
+    handler, ``ctx.tpu.predict``, ``generate`` — reads the ambient
+    deadline without per-call plumbing, and the dispatcher DROPS
+    already-expired items before burning device time on a caller that
+    is gone.
+  - ``AdmissionGate``: a bounded gate in front of the batcher queue and
+    the generation slot queue. Under overload every queued request gets
+    slower; the gate instead fails the excess FAST
+    (``TooManyRequests`` -> 429 / ``RESOURCE_EXHAUSTED``) with a
+    ``Retry-After`` estimate, keeping admitted-request latency flat and
+    goodput at capacity (proved by ``tools/chaos_bench.py``).
+  - Brownout: between "healthy" and "shedding" there is a window where
+    the gate caps ``max_new_tokens`` so each admitted stream costs
+    fewer decode iterations — degrading answer length before
+    availability.
+
+Thread model: the ambient deadline is a ``threading.local`` (handlers
+run one-per-thread on both transports, like ``tracing.current_span``);
+the gate's EWMA state is guarded by one small lock and is touched only
+at admission/dispatch, never per token.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+from .errors import DeadlineExceeded, TooManyRequests
+
+__all__ = [
+    "AdmissionGate",
+    "Deadline",
+    "DeadlineExceeded",
+    "TooManyRequests",
+    "current_deadline",
+    "deadline_scope",
+    "parse_http_timeout",
+]
+
+
+class Deadline:
+    """An absolute expiry on the monotonic clock.
+
+    Built once at the transport edge and carried by reference; every
+    layer asks the same object ``remaining()``/``expired()`` so clock
+    reads stay consistent and the budget shrinks as work progresses
+    (the grpc-timeout contract: the deadline covers the WHOLE request,
+    not each hop)."""
+
+    __slots__ = ("at",)
+
+    def __init__(self, at: float):
+        self.at = float(at)
+
+    @classmethod
+    def after(cls, seconds: float) -> "Deadline":
+        return cls(time.monotonic() + float(seconds))
+
+    def remaining(self) -> float:
+        """Seconds left; <= 0 once expired."""
+        return self.at - time.monotonic()
+
+    def expired(self) -> bool:
+        return time.monotonic() >= self.at
+
+    def budget(self, timeout: float | None) -> float:
+        """Tighten a layer's own timeout to what the deadline allows."""
+        rem = self.remaining()
+        return rem if timeout is None else min(timeout, rem)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"Deadline(in {self.remaining() * 1e3:.1f}ms)"
+
+
+_scope = threading.local()
+
+
+def current_deadline() -> Deadline | None:
+    """The ambient deadline opened by the transport for this handler
+    thread (None outside any scope)."""
+    return getattr(_scope, "deadline", None)
+
+
+@contextlib.contextmanager
+def deadline_scope(deadline: Deadline | None):
+    """Make ``deadline`` ambient for the calling thread. Nested scopes
+    keep the TIGHTER deadline (a handler-set sub-deadline may shrink
+    the budget, never extend the caller's)."""
+    prev = getattr(_scope, "deadline", None)
+    if deadline is not None and prev is not None and prev.at < deadline.at:
+        deadline = prev
+    _scope.deadline = deadline if deadline is not None else prev
+    try:
+        yield deadline
+    finally:
+        _scope.deadline = prev
+
+
+_HTTP_TIMEOUT_UNITS = (("ms", 1e-3), ("us", 1e-6), ("s", 1.0), ("m", 60.0))
+
+
+def parse_http_timeout(val: str | None) -> float | None:
+    """``X-Request-Timeout`` header -> seconds. Accepts a bare float
+    (seconds) or a unit suffix: ``50ms``, ``2s``, ``250us``, ``1m``.
+    Malformed/non-positive values are ignored (None) — a bad client
+    header must never fail the request itself."""
+    if not val:
+        return None
+    val = val.strip().lower()
+    scale = 1.0
+    for suffix, s in _HTTP_TIMEOUT_UNITS:
+        if val.endswith(suffix):
+            val, scale = val[: -len(suffix)], s
+            break
+    try:
+        seconds = float(val) * scale
+    except ValueError:
+        return None
+    return seconds if seconds > 0 else None
+
+
+class AdmissionGate:
+    """Bounded admission with early shedding and a brownout band.
+
+    One gate fronts one queue (a program's coalescing batcher, or the
+    generation engine's pending queue). ``admit(depth)`` raises
+    ``TooManyRequests`` when either bound is crossed:
+
+      - ``max_queue_depth``: more than this many waiters queued;
+      - ``max_queue_delay``: the EWMA of observed queue wait exceeds
+        this — the "every request is already slow" signal that depth
+        alone misses when service time varies.
+
+    The wait EWMA is fed by the dispatcher (``note_wait``) with each
+    batch's oldest-item wait / each admission's queue wait, so the gate
+    tracks the latency a NEW arrival would actually experience. The
+    shed's ``Retry-After`` is that same estimate — honest backpressure
+    a client-side retry policy (service/retry.py) can obey.
+
+    Brownout: with ``brownout_delay`` configured, ``cap_tokens`` caps
+    ``max_new_tokens`` while the wait EWMA sits above the threshold —
+    shorter answers per admitted stream instead of shed streams.
+
+    Both bounds disabled (0) -> the gate admits everything and costs
+    one attribute read per request.
+    """
+
+    # EWMA smoothing for the observed-wait estimate: heavy enough to
+    # ride out one odd batch, light enough to track a load swing within
+    # a few dispatches.
+    ALPHA = 0.3
+
+    def __init__(self, max_queue_depth: int = 0, max_queue_delay: float = 0.0,
+                 brownout_delay: float = 0.0, brownout_max_new: int = 32,
+                 name: str = "", metrics=None, tracer=None, logger=None):
+        self.max_queue_depth = int(max_queue_depth)
+        self.max_queue_delay = float(max_queue_delay)
+        self.brownout_delay = float(brownout_delay)
+        self.brownout_max_new = int(brownout_max_new)
+        self.name = name
+        self.metrics = metrics
+        self.tracer = tracer
+        self.logger = logger
+        self.enabled = self.max_queue_depth > 0 or self.max_queue_delay > 0
+        self._lock = threading.Lock()
+        self._wait_ewma = 0.0
+        self._brownout_on = False  # edge-logged, gauge-backed
+        self.sheds = 0
+        self.brownout_capped = 0
+
+    def clone(self, name: str) -> "AdmissionGate":
+        """A fresh gate with the same bounds and telemetry plumbing but
+        its OWN state — one gate must front one queue, so a multi-program
+        engine clones its configured gate per program (a shared wait
+        EWMA would let a backlogged program shed a healthy one's
+        traffic)."""
+        return AdmissionGate(
+            max_queue_depth=self.max_queue_depth,
+            max_queue_delay=self.max_queue_delay,
+            brownout_delay=self.brownout_delay,
+            brownout_max_new=self.brownout_max_new,
+            name=name, metrics=self.metrics, tracer=self.tracer,
+            logger=self.logger)
+
+    # -- dispatcher side ------------------------------------------------------
+    def note_wait(self, wait_s: float) -> None:
+        """Feed one observed queue wait (seconds) into the estimate."""
+        with self._lock:
+            self._wait_ewma += self.ALPHA * (wait_s - self._wait_ewma)
+
+    @property
+    def estimated_wait(self) -> float:
+        return self._wait_ewma
+
+    # -- admission side -------------------------------------------------------
+    def admit(self, depth: int, program: str = "") -> None:
+        """Admit or raise ``TooManyRequests``. ``depth`` is the queue's
+        CURRENT depth (the caller reads it lock-free; an off-by-a-few
+        race only moves the shed boundary by that much)."""
+        if not self.enabled:
+            return
+        wait = self._wait_ewma
+        over_depth = self.max_queue_depth > 0 and depth >= self.max_queue_depth
+        over_delay = (self.max_queue_delay > 0 and depth > 0
+                      and wait > self.max_queue_delay)
+        if not (over_depth or over_delay):
+            return
+        self._shed(depth, wait, program)
+
+    def _shed(self, depth: int, wait: float, program: str) -> None:
+        self.sheds += 1
+        # honest Retry-After: the current wait estimate, floored so a
+        # zero-estimate early shed doesn't invite an instant retry storm
+        retry_after = max(0.05, wait)
+        now = time.monotonic()
+        if self.metrics is not None:
+            try:
+                self.metrics.increment_counter(
+                    "app_tpu_shed_total", program=program or self.name)
+            except Exception:
+                pass
+        if self.tracer is not None:
+            try:
+                # zero-length marker span: the request's trace shows WHERE
+                # it died (queue depth + wait estimate at the gate)
+                self.tracer.record_span(
+                    "tpu.shed", now, now,
+                    attributes={"queue_depth": depth,
+                                "wait_ewma_ms": round(wait * 1e3, 3),
+                                "program": program or self.name})
+            except Exception:
+                pass
+        raise TooManyRequests(
+            f"{self.name or 'admission'}: queue depth {depth}, "
+            f"estimated wait {wait * 1e3:.0f}ms — shed",
+            retry_after=retry_after)
+
+    def cap_tokens(self, max_new_tokens: int) -> int:
+        """Brownout: cap a generation request's token budget while the
+        queue-wait estimate sits above ``brownout_delay``."""
+        if self.brownout_delay <= 0:
+            return max_new_tokens
+        wait = self._wait_ewma
+        active = wait > self.brownout_delay
+        if active != self._brownout_on:
+            with self._lock:
+                if active != self._brownout_on:
+                    self._brownout_on = active
+                    if self.metrics is not None:
+                        try:
+                            self.metrics.set_gauge("app_tpu_brownout_active",
+                                                   1.0 if active else 0.0)
+                        except Exception:
+                            pass
+                    if self.logger is not None:
+                        self.logger.warn({
+                            "event": "brownout " + ("entered" if active
+                                                    else "cleared"),
+                            "gate": self.name,
+                            "wait_ewma_ms": round(wait * 1e3, 1)})
+        if not active or max_new_tokens <= self.brownout_max_new:
+            return max_new_tokens
+        self.brownout_capped += 1
+        if self.metrics is not None:
+            try:
+                self.metrics.increment_counter("app_tpu_brownout_capped_total")
+            except Exception:
+                pass
+        return self.brownout_max_new
+
+    def stats(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "max_queue_depth": self.max_queue_depth,
+            "max_queue_delay": self.max_queue_delay,
+            "wait_ewma_ms": round(self._wait_ewma * 1e3, 3),
+            "sheds": self.sheds,
+            "brownout_active": self._brownout_on,
+            "brownout_capped": self.brownout_capped,
+        }
+
+
+def gate_from_config(cfg, name: str, metrics=None, tracer=None,
+                     logger=None) -> AdmissionGate | None:
+    """Build a gate from ``TPU_MAX_QUEUE_DEPTH`` / ``TPU_MAX_QUEUE_DELAY``
+    / ``TPU_BROWNOUT_DELAY`` / ``TPU_BROWNOUT_MAX_NEW`` (all bounds
+    default off: enabling load shedding is a capacity-planning decision,
+    not a framework default). Returns None when fully disabled."""
+    depth = cfg.get_int("TPU_MAX_QUEUE_DEPTH", 0)
+    delay = cfg.get_float("TPU_MAX_QUEUE_DELAY", 0.0)
+    b_delay = cfg.get_float("TPU_BROWNOUT_DELAY", 0.0)
+    if depth <= 0 and delay <= 0 and b_delay <= 0:
+        return None
+    return AdmissionGate(
+        max_queue_depth=depth, max_queue_delay=delay,
+        brownout_delay=b_delay,
+        brownout_max_new=cfg.get_int("TPU_BROWNOUT_MAX_NEW", 32),
+        name=name, metrics=metrics, tracer=tracer, logger=logger)

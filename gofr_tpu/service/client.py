@@ -18,6 +18,7 @@ import urllib.parse
 import urllib.request
 from typing import Any, Mapping
 
+from .. import chaos
 from ..datasource import Health, STATUS_DOWN, STATUS_UP
 from .wrap import VerbSurface
 
@@ -91,6 +92,7 @@ class HTTPService(VerbSurface):
         start = time.perf_counter()
         status = 0
         try:
+            chaos.fire(chaos.SERVICE_REQUEST)
             req = urllib.request.Request(url, data=data, method=method, headers=hdrs)
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout) as resp:

@@ -54,6 +54,14 @@ Config keys (reference config style, pkg/gofr/config/config.go:3):
                       selection via generate(adapter=i); install
                       weights with engine.generator.load_adapter
   TPU_LORA_RANK       LoRA bottleneck rank (default 16)
+  TPU_MAX_QUEUE_DEPTH admission control (resilience.AdmissionGate):
+                      shed with 429/RESOURCE_EXHAUSTED once this many
+                      requests wait in a queue (default 0 = off)
+  TPU_MAX_QUEUE_DELAY shed once the observed queue-wait EWMA exceeds
+                      this many seconds (default 0 = off)
+  TPU_BROWNOUT_DELAY  brownout band: cap max_new_tokens while the
+                      queue-wait EWMA exceeds this (default 0 = off)
+  TPU_BROWNOUT_MAX_NEW token cap applied in brownout (default 32)
   TPU_BATCH_BUCKETS   csv of predict batch buckets (default 1,2,4,8)
   TPU_SEQ_BUCKETS     csv of token-length buckets  (default 32..512)
   TPU_MAX_BATCH_DELAY coalescing window in seconds (default 0.004)
@@ -113,8 +121,13 @@ def new_engine_from_config(cfg, logger=None, metrics=None,
     batch_buckets = _csv_ints(cfg.get("TPU_BATCH_BUCKETS"), DEFAULT_BATCH_BUCKETS)
     seq_buckets = _csv_ints(cfg.get("TPU_SEQ_BUCKETS"), DEFAULT_SEQ_BUCKETS)
 
+    from ..resilience import gate_from_config
+
+    tracer = getattr(observe, "tracer", None)
     engine = TPUEngine(logger=logger, metrics=metrics, max_delay=max_delay,
-                       mesh=mesh, model_name=name, observe=observe)
+                       mesh=mesh, model_name=name, observe=observe,
+                       gate=gate_from_config(cfg, "predict", metrics=metrics,
+                                             tracer=tracer, logger=logger))
 
     weights = cfg.get("TPU_WEIGHTS")
     quant = (cfg.get("TPU_QUANT") or "").lower() == "int8"
@@ -172,6 +185,8 @@ def new_engine_from_config(cfg, logger=None, metrics=None,
         engine.generator = GenerationEngine(
             mc, params, slots=slots, max_seq=max_seq, prompt_buckets=prompt_b,
             logger=logger, metrics=metrics, observe=observe, mesh=mesh,
+            gate=gate_from_config(cfg, "generate", metrics=metrics,
+                                  tracer=tracer, logger=logger),
             kv_dtype=kv_dtype,
             decode_block=cfg.get_int("TPU_DECODE_BLOCK", 4),
             admit_window_ms=cfg.get_float("TPU_ADMIT_WINDOW_MS", 2.0),

@@ -25,16 +25,34 @@ import threading
 import time
 from typing import Any, Callable, Sequence
 
+from .. import chaos
+from ..errors import DeadlineExceeded
+
 
 class BatchItem:
-    __slots__ = ("payload", "result", "error", "done", "enqueued_at")
+    __slots__ = ("payload", "result", "error", "done", "enqueued_at",
+                 "deadline", "cancelled", "claimed")
 
-    def __init__(self, payload: Any):
+    def __init__(self, payload: Any, deadline=None):
         self.payload = payload
         self.result: Any = None
         self.error: BaseException | None = None
         self.done = threading.Event()
         self.enqueued_at = time.monotonic()
+        # resilience.Deadline (or None): the caller's wire deadline.
+        # Expired items are DROPPED at dispatch — device time is never
+        # spent on a caller that already gave up.
+        self.deadline = deadline
+        # Lifecycle flags, both guarded by the batcher lock:
+        #   cancelled — the submitting thread stopped waiting (timeout /
+        #     deadline); the dispatcher must not deliver into it and
+        #     _run_one must not overwrite its error after the caller
+        #     already raised (the PR-3 abandonment race).
+        #   claimed — the dispatcher owns it (inside a batch); the
+        #     waiter may still stop waiting but can no longer reap it
+        #     from the queue.
+        self.cancelled = False
+        self.claimed = False
 
 
 class BatcherClosed(RuntimeError):
@@ -53,7 +71,8 @@ class CoalescingBatcher:
                  max_delay: float = 0.005, name: str = "batcher",
                  on_dispatch: Callable[[int, float], None] | None = None,
                  use_native: bool = True,
-                 on_queue_depth: Callable[[int], None] | None = None):
+                 on_queue_depth: Callable[[int], None] | None = None,
+                 on_expired: Callable[[int], None] | None = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.runner = runner
@@ -61,10 +80,16 @@ class CoalescingBatcher:
         self.max_delay = max_delay
         self.name = name
         self.on_dispatch = on_dispatch  # (batch_size, oldest_wait_s) -> None
+        # (n_dropped,) -> None: expired items dropped WITHOUT executing
+        # (feeds app_tpu_expired_dropped_total)
+        self.on_expired = on_expired
         # (queued_items,) -> None: fired on enqueue and after each batch
         # take, so a queue-depth gauge tracks the wait line in real time
         self.on_queue_depth = on_queue_depth
         self._queue: list[BatchItem] = []
+        # expired items dropped by _prune_locked, awaiting an
+        # outside-the-lock telemetry flush (_flush_expired)
+        self._expired_pending = 0
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
         self._closed = False
@@ -100,9 +125,21 @@ class CoalescingBatcher:
                 pass  # telemetry must never take the batcher down
 
     # -- producer side -------------------------------------------------------
-    def submit(self, payload: Any, timeout: float | None = None) -> Any:
-        """Block until the batched result for ``payload`` is ready."""
-        item = BatchItem(payload)
+    def submit(self, payload: Any, timeout: float | None = None,
+               deadline=None) -> Any:
+        """Block until the batched result for ``payload`` is ready.
+
+        ``deadline`` (resilience.Deadline): tightens the wait to the
+        caller's remaining budget AND rides on the item so the
+        dispatcher drops it unexecuted if it expires while queued."""
+        if deadline is not None:
+            if deadline.expired():
+                self._count_expired(1)
+                raise DeadlineExceeded(
+                    f"{self.name}: deadline expired before enqueue")
+            timeout = deadline.budget(timeout)
+        item = BatchItem(payload, deadline=deadline)
+        item_id = 0
         if self._native is not None:
             with self._lock:
                 if self._closed:
@@ -121,22 +158,114 @@ class CoalescingBatcher:
                 self._nonempty.notify()
         self._report_depth()
         if not item.done.wait(timeout):
-            item.error = TimeoutError(f"{self.name}: no result in {timeout}s")
-            raise item.error
+            err = self._abandon(item, item_id, timeout)
+            if err is not None:
+                raise err
         if item.error is not None:
             raise item.error
         return item.result
 
+    def _abandon(self, item: BatchItem, item_id: int,
+                 timeout: float | None) -> BaseException | None:
+        """The waiter's timeout fired. Under the lock: if the dispatcher
+        already finished the item (lost race), return None and use the
+        result; otherwise mark it cancelled and REAP it from the queue /
+        native id map so the runner never executes it and nothing leaks.
+        A claimed item (already inside a dispatched batch) can't be
+        reaped — the cancelled flag stops _run_one from delivering into
+        it (and from overwriting the error this method returns)."""
+        with self._lock:
+            if item.done.is_set():
+                return None
+            item.cancelled = True
+            if not item.claimed:
+                if self._native is not None:
+                    self._items.pop(item_id, None)
+                else:
+                    try:
+                        self._queue.remove(item)
+                    except ValueError:
+                        pass
+            if item.deadline is not None and item.deadline.expired():
+                # the caller's wire deadline expired while queued and WE
+                # reaped it (not the dispatcher): it still counts as an
+                # expired item dropped without execution
+                expired = not item.claimed
+                item.error = DeadlineExceeded(
+                    f"{self.name}: deadline expired after "
+                    f"{time.monotonic() - item.enqueued_at:.3f}s in queue")
+            else:
+                expired = False
+                item.error = TimeoutError(
+                    f"{self.name}: no result in {timeout}s")
+            item.done.set()
+            err = item.error
+        if expired:
+            self._count_expired(1)
+        self._report_depth()
+        return err
+
+    def _count_expired(self, n: int) -> None:
+        if self.on_expired is not None and n > 0:
+            try:
+                self.on_expired(n)
+            except Exception:
+                pass  # telemetry must never take the batcher down
+
     # -- dispatcher ----------------------------------------------------------
+    def _prune_locked(self) -> None:
+        """Drop cancelled and expired items from the queue (lock held).
+        Cancelled waiters already raised — silently discard; expired
+        items fail with DEADLINE_EXCEEDED and are counted: the whole
+        point is that the runner never burns device time on them. The
+        telemetry callback for the count is DEFERRED (accumulated in
+        ``_expired_pending``, flushed by the dispatch loop outside the
+        lock): firing metrics here would stall every concurrent
+        submit() behind per-item counter work exactly under overload."""
+        n_expired = 0
+        keep: list[BatchItem] = []
+        for it in self._queue:
+            if it.cancelled:
+                continue
+            if it.deadline is not None and it.deadline.expired():
+                it.error = DeadlineExceeded(
+                    f"{self.name}: deadline expired after "
+                    f"{time.monotonic() - it.enqueued_at:.3f}s in queue")
+                it.done.set()
+                n_expired += 1
+                continue
+            keep.append(it)
+        if len(keep) != len(self._queue):
+            self._queue[:] = keep
+        self._expired_pending += n_expired
+
+    def _flush_expired(self) -> None:
+        """Report prune-dropped expired items, outside the lock."""
+        with self._lock:
+            n, self._expired_pending = self._expired_pending, 0
+        self._count_expired(n)
+
     def _take_batch(self) -> list[BatchItem] | None:
-        """Wait for a flush condition; pop up to max_batch items (None on close)."""
+        """Wait for a flush condition; pop up to max_batch live items
+        (None on close). Expired/cancelled items are pruned BEFORE the
+        flush decision so a dead head-of-line never triggers a dispatch
+        of its own."""
         with self._lock:
             while True:
+                if self._queue:
+                    self._prune_locked()
+                    if not self._queue and self._expired_pending:
+                        # pruning emptied the line: bounce through the
+                        # loop (empty batch) so the pending count is
+                        # flushed now, not at the next enqueue
+                        return []
                 if self._queue:
                     oldest_wait = time.monotonic() - self._queue[0].enqueued_at
                     if len(self._queue) >= self.max_batch or oldest_wait >= self.max_delay:
                         batch = self._queue[: self.max_batch]
                         del self._queue[: self.max_batch]
+                        for it in batch:
+                            it.claimed = True
                         return batch
                     # Not full yet: sleep exactly until the oldest's deadline.
                     self._nonempty.wait(self.max_delay - oldest_wait)
@@ -152,34 +281,58 @@ class CoalescingBatcher:
             except Exception:
                 pass
         try:
+            chaos.fire(chaos.BATCHER_DISPATCH)
             results = self.runner([it.payload for it in batch])
             if len(results) != len(batch):
                 raise RuntimeError(
                     f"{self.name}: runner returned {len(results)} results "
                     f"for a batch of {len(batch)}")
             for it, res in zip(batch, results):
+                if it.cancelled:
+                    continue  # waiter already raised; never overwrite
                 it.result = res
                 it.done.set()
         except BaseException as e:  # noqa: BLE001 — every waiter must wake
             for it in batch:
+                if it.cancelled:
+                    continue
                 it.error = e
                 it.done.set()
 
     def _loop(self) -> None:
         while True:
             batch = self._take_batch()
+            self._flush_expired()
             if batch is None:
                 return
             self._report_depth()
-            self._run_one(batch, time.monotonic() - batch[0].enqueued_at)
+            if batch:
+                self._run_one(batch, time.monotonic() - batch[0].enqueued_at)
 
     def _native_loop(self) -> None:
         while True:
             ids, oldest_wait = self._native.pop_batch()  # blocks outside GIL
             if not ids:
                 return
+            n_expired = 0
             with self._lock:
-                batch = [self._items.pop(i) for i in ids if i in self._items]
+                # ids whose item left _items were reaped by their waiter
+                # (timeout/deadline) — the pop simply skips them
+                popped = [self._items.pop(i) for i in ids if i in self._items]
+                batch = []
+                for it in popped:
+                    if it.cancelled:
+                        continue
+                    if it.deadline is not None and it.deadline.expired():
+                        it.error = DeadlineExceeded(
+                            f"{self.name}: deadline expired after "
+                            f"{time.monotonic() - it.enqueued_at:.3f}s in queue")
+                        it.done.set()
+                        n_expired += 1
+                        continue
+                    it.claimed = True
+                    batch.append(it)
+            self._count_expired(n_expired)
             self._report_depth()
             if batch:
                 self._run_one(batch, oldest_wait)
@@ -196,6 +349,8 @@ class CoalescingBatcher:
             self._native.close()
         if not drain:
             for it in pending:
+                if it.cancelled:
+                    continue  # waiter already raised
                 it.error = BatcherClosed(f"{self.name} closed")
                 it.done.set()
         self._thread.join(timeout=5.0)

@@ -32,6 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..datasource import Health, STATUS_DEGRADED, STATUS_DOWN, STATUS_UP
+from ..errors import DeadlineExceeded
+from ..resilience import current_deadline
 from .batcher import CoalescingBatcher, pad_bucket
 
 DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8)
@@ -79,10 +81,16 @@ class TPUEngine:
     """
 
     def __init__(self, logger=None, metrics=None, max_delay: float = 0.004,
-                 mesh=None, model_name: str = "", observe=None):
+                 mesh=None, model_name: str = "", observe=None, gate=None):
         self.logger = logger
         self.metrics = metrics
         self.observe = observe  # Observe bundle (registry + flight recorder)
+        # resilience.AdmissionGate TEMPLATE (None = admit everything):
+        # each program gets its own clone (one gate per queue — a shared
+        # wait EWMA would let a backlogged program shed a healthy one's
+        # traffic), fed with that program's batch waits at dispatch
+        self.gate = gate
+        self._gates: dict[str, Any] = {}
         self.max_delay = max_delay
         self.mesh = mesh
         self.model_name = model_name
@@ -112,11 +120,14 @@ class TPUEngine:
                        example_item=example_item)
         with self._lock:
             self._programs[name] = prog
+            if self.gate is not None:
+                self._gates[name] = self.gate.clone(name)
             self._batchers[name] = CoalescingBatcher(
                 runner=lambda items, p=prog: self._run_batch(p, items),
                 max_batch=prog.max_batch, max_delay=self.max_delay,
                 name=f"tpu-{name}", on_dispatch=self._dispatch_metrics(prog),
-                on_queue_depth=self._depth_gauge(name))
+                on_queue_depth=self._depth_gauge(name),
+                on_expired=self._expired_counter(name))
         if self.logger is not None:
             self.logger.info({"event": "tpu program registered", "program": name,
                               "kind": kind, "batch_buckets": list(prog.batch_buckets)})
@@ -131,8 +142,24 @@ class TPUEngine:
                                    program=program)
         return hook
 
+    def _gate_for(self, program: str):
+        """The program's own gate clone; created lazily for gates
+        installed after registration (tests, dynamic reconfiguration)."""
+        g = self._gates.get(program)
+        if g is None and self.gate is not None:
+            with self._lock:
+                g = self._gates.get(program)
+                if g is None:
+                    g = self._gates[program] = self.gate.clone(program)
+        return g
+
     def _dispatch_metrics(self, prog: Program):
         def hook(batch_size: int, oldest_wait: float) -> None:
+            gate = self._gates.get(prog.name)
+            if gate is not None:
+                # the gate's shed decision tracks what a new arrival
+                # would wait — exactly this program's oldest-item wait
+                gate.note_wait(oldest_wait)
             if self.metrics is None:
                 return
             bucket = pad_bucket(batch_size, prog.batch_buckets)
@@ -140,6 +167,15 @@ class TPUEngine:
                                           oldest_wait, program=prog.name)
             self.metrics.set_gauge("app_tpu_batch_fill", batch_size / bucket,
                                    program=prog.name)
+        return hook
+
+    def _expired_counter(self, program: str):
+        def hook(n: int) -> None:
+            if self.metrics is None:
+                return
+            for _ in range(n):
+                self.metrics.increment_counter(
+                    "app_tpu_expired_dropped_total", program=program)
         return hook
 
     # -- the batched device dispatch ----------------------------------------
@@ -185,15 +221,34 @@ class TPUEngine:
                                    "shape": list(shape)})
 
     # -- public API (ctx.tpu.predict) ---------------------------------------
-    def predict(self, program: str, item: Any, timeout: float | None = 60.0) -> Any:
+    def predict(self, program: str, item: Any, timeout: float | None = 60.0,
+                deadline=None) -> Any:
         """Run one item through a registered program, coalescing with any
-        concurrent callers. Returns the un-batched result (numpy)."""
+        concurrent callers. Returns the un-batched result (numpy).
+
+        ``deadline`` (resilience.Deadline) defaults to the AMBIENT one
+        the transport opened from the request's wire deadline
+        (grpc-timeout / X-Request-Timeout): the wait is capped to the
+        remaining budget and the item is dropped unexecuted if it
+        expires while queued. An admission gate, when configured, sheds
+        with ``TooManyRequests`` before the item ever joins the line."""
         if self._closed:
             raise RuntimeError("TPU engine is closed")
         batcher = self._batchers.get(program)
         if batcher is None:
             raise KeyError(f"no TPU program {program!r}; registered: "
                            f"{sorted(self._programs)}")
+        if deadline is None:
+            deadline = current_deadline()
+        if deadline is not None and deadline.expired():
+            if self.metrics is not None:
+                self.metrics.increment_counter(
+                    "app_tpu_expired_dropped_total", program=program)
+            raise DeadlineExceeded(
+                f"deadline expired before predict({program!r}) was queued")
+        gate = self._gate_for(program)
+        if gate is not None:
+            gate.admit(batcher.queue_depth(), program=program)
         self._validate_item(self._programs[program], item)
         t0 = time.monotonic()
         entry = None
@@ -206,7 +261,7 @@ class TPUEngine:
                 stage="batch-wait")
         failed = None
         try:
-            return batcher.submit(item, timeout=timeout)
+            return batcher.submit(item, timeout=timeout, deadline=deadline)
         except BaseException as e:
             failed = e
             raise
@@ -311,6 +366,9 @@ class TPUEngine:
                 for n, p in self._programs.items()
             },
         }
+        if self._gates:
+            details["admission"] = {n: g.stats()
+                                    for n, g in sorted(self._gates.items())}
         if self.mesh is not None:
             details["mesh"] = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
         try:

@@ -39,8 +39,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import chaos
+from ..errors import DeadlineExceeded
 from ..models import llama
 from ..models.common import ModelConfig
+from ..resilience import current_deadline
 from ..wire import PushStream
 from .batcher import pad_bucket
 
@@ -143,7 +146,8 @@ class GenStream(PushStream):
 
 class _Request:
     __slots__ = ("stream", "prompt", "max_new", "temperature", "top_k",
-                 "eos_id", "adapter", "enqueued_at", "lattice_peek")
+                 "eos_id", "adapter", "enqueued_at", "lattice_peek",
+                 "deadline")
 
     @property
     def logprobs(self) -> bool:
@@ -151,7 +155,7 @@ class _Request:
 
     def __init__(self, stream: GenStream, prompt: np.ndarray, max_new: int,
                  temperature: float, top_k: int, eos_id: int | None,
-                 adapter: int = 0):
+                 adapter: int = 0, deadline=None):
         self.stream = stream
         self.prompt = prompt
         self.max_new = max_new
@@ -161,6 +165,9 @@ class _Request:
         self.adapter = adapter
         self.enqueued_at = time.monotonic()
         self.lattice_peek: tuple[int, bool] | None = None
+        # resilience.Deadline: expired requests are dropped at admission
+        # (no prefill dispatch for a caller that already gave up)
+        self.deadline = deadline
 
 
 class _Inflight:
@@ -193,7 +200,7 @@ class GenerationEngine:
                  max_seq: int | None = None,
                  prompt_buckets: tuple[int, ...] = (32, 64, 128, 256, 512),
                  logger=None, metrics=None, observe=None, seed: int = 0,
-                 mesh=None,
+                 mesh=None, gate=None,
                  kv_dtype=None, decode_block: int = 4,
                  admit_window_ms: float = 2.0,
                  prefix_cache_slots: int = 0,
@@ -308,6 +315,11 @@ class GenerationEngine:
                                       or self.prompt_buckets[-1])
         self.logger = logger
         self.metrics = metrics
+        # resilience.AdmissionGate fronting the pending queue (None =
+        # admit everything): sheds with TooManyRequests under overload
+        # and caps max_new_tokens in its brownout band; fed with each
+        # admission's observed queue wait at _start
+        self.gate = gate
         # flight recorder + in-flight registry + stage spans (observe/)
         self._observe = observe
         self.mesh = mesh
@@ -753,7 +765,7 @@ class GenerationEngine:
     def generate(self, prompt, max_new_tokens: int = 128,
                  temperature: float = 0.0, top_k: int = 0,
                  eos_id=None, adapter: int = 0,
-                 logprobs: bool = False) -> GenStream:
+                 logprobs: bool = False, deadline=None) -> GenStream:
         """Enqueue a prompt (sequence of token ids); returns a GenStream
         yielding generated ids as the device produces them.
 
@@ -766,13 +778,30 @@ class GenerationEngine:
         ``eos_id``: a single stop token id, or any iterable of them
         (OpenAI-style ``stop`` sets) — the stream ends at (and includes)
         the first generated token in the set. Checked host-side per
-        delivered token; never a compile key."""
+        delivered token; never a compile key.
+
+        ``deadline`` (resilience.Deadline) defaults to the ambient one
+        the transport opened from the wire deadline; an expired request
+        raises here, and one that expires while queued is dropped at
+        admission without a prefill dispatch. With an admission gate
+        configured, overload sheds with ``TooManyRequests`` (fast 429/
+        RESOURCE_EXHAUSTED) and the brownout band caps
+        ``max_new_tokens``."""
         if self._closed:
             raise GenerationError("generation engine is closed")
         if self._draining:
             raise GenerationError("generation engine is draining")
         if self.down is not None:
             raise GenerationError(f"generation engine is down: {self.down}")
+        if deadline is None:
+            deadline = current_deadline()
+        if deadline is not None and deadline.expired():
+            self._count_expired()
+            raise DeadlineExceeded("deadline expired before generate() "
+                                   "was queued")
+        if self.gate is not None:
+            self.gate.admit(self._pending.qsize(), program="generate")
+            max_new_tokens = self.gate.cap_tokens(max_new_tokens)
         if eos_id is not None and not isinstance(eos_id, (int, np.integer)):
             eos_id = frozenset(int(t) for t in eos_id) or None
         elif isinstance(eos_id, np.integer):
@@ -848,7 +877,8 @@ class GenerationEngine:
                     raise GenerationError("generation engine is draining")
                 self._pending.put(_Request(stream, prompt, max_new_tokens,
                                            temperature, top_k, eos_id,
-                                           adapter=int(adapter)))
+                                           adapter=int(adapter),
+                                           deadline=deadline))
         except BaseException:
             self._obs_end(stream, "failed", error="rejected at admission")
             raise
@@ -869,6 +899,8 @@ class GenerationEngine:
             "total_requests": self.total_requests,
             "total_tokens": self.total_tokens,
         }
+        if self.gate is not None:
+            out["admission"] = self.gate.stats()
         if self._prefix_idx is not None:
             out["prefix_cache"] = self._prefix_idx.stats()
         if self._paged:
@@ -1201,6 +1233,19 @@ class GenerationEngine:
                 if req.stream.cancelled.is_set():
                     req.stream._q.put(None)
                     self._obs_end(req.stream, "cancelled", tokens=0)
+                    continue
+                if req.deadline is not None and req.deadline.expired():
+                    # the caller's wire deadline ran out while queued:
+                    # fail fast, never dispatch its prefill
+                    self._count_expired()
+                    wait_s = time.monotonic() - req.enqueued_at
+                    req.stream._q.put(DeadlineExceeded(
+                        f"deadline expired after {wait_s:.3f}s in the "
+                        "admission queue"))
+                    req.stream._q.put(None)
+                    self._obs_end(req.stream, "failed",
+                                  error="deadline expired in queue",
+                                  wait_s=round(wait_s, 6))
                     continue
                 blocks = None
                 if self._paged:
@@ -1539,6 +1584,14 @@ class GenerationEngine:
         self._pool = self._pool_store_jit(self._pool, self.cache,
                                           jnp.int32(row), jnp.int32(idx))
 
+    def _count_expired(self) -> None:
+        if self.metrics is not None:
+            try:
+                self.metrics.increment_counter(
+                    "app_tpu_expired_dropped_total", program="generate")
+            except Exception:
+                pass
+
     # -- flight-recorder plumbing (all no-ops without an Observe bundle) -----
     def _obs_end(self, stream: GenStream, event: str, **fields) -> None:
         """Remove the request's registry entry and record its terminal
@@ -1595,6 +1648,8 @@ class GenerationEngine:
                blocks: "tuple | None" = None) -> None:
         t0 = time.monotonic()
         req.stream.trace["admit"] = t0
+        if self.gate is not None:
+            self.gate.note_wait(t0 - req.enqueued_at)
         self._obs_stage(req.stream, "prefill")
         if self._observe is not None:
             self._observe.recorder.record(
@@ -1602,6 +1657,7 @@ class GenerationEngine:
                 trace_id=req.stream.trace_id, slot=idx,
                 wait_s=round(t0 - req.enqueued_at, 6))
         try:
+            chaos.fire(chaos.GENERATOR_PREFILL)
             if self._paged:
                 shared, m, fresh = blocks
                 first, first_lp = self._paged_admit_prefill(
@@ -1757,6 +1813,7 @@ class GenerationEngine:
                 if self._active.any() or not self._pending.empty():
                     with self._device_lock:
                         self._admit()
+                        chaos.fire(chaos.GENERATOR_STEP)
                         inflight = self._tick()
                     if inflight is not None:
                         # serve admissions WHILE the block runs on

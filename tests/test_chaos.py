@@ -1,0 +1,261 @@
+"""Chaos-harness tests: the injection seams stay wired, schedules are
+deterministic, and the stack survives what they throw at it.
+
+Every test here carries the ``chaos`` marker (tier-1: they are fast and
+hermetic). The determinism tests are the CI contract behind
+``tools/chaos_bench.py --smoke`` being run twice: decisions derive only
+from (seed, seam, call index), never wall-clock or interpreter state.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gofr_tpu import chaos
+from gofr_tpu.errors import CircuitOpenError
+from gofr_tpu.service.retry import Retry
+from gofr_tpu.tpu.batcher import CoalescingBatcher
+
+pytestmark = pytest.mark.chaos
+
+
+# -- schedule determinism -----------------------------------------------------
+
+def test_schedule_digest_is_deterministic_across_instances():
+    def build():
+        return (chaos.ChaosSchedule(seed=17)
+                .on(chaos.BATCHER_DISPATCH, latency=0.01, jitter=0.005,
+                    error=RuntimeError, p=0.2)
+                .on(chaos.SERVICE_REQUEST, error=OSError, every=3))
+
+    a, b = build(), build()
+    assert a.digest() == b.digest()
+    assert a.decisions(chaos.BATCHER_DISPATCH, 64) == \
+        b.decisions(chaos.BATCHER_DISPATCH, 64)
+    # a different seed is a different schedule
+    c = chaos.ChaosSchedule(seed=18).on(chaos.BATCHER_DISPATCH,
+                                        latency=0.01, jitter=0.005,
+                                        error=RuntimeError, p=0.2)
+    assert c.digest() != a.digest()
+
+
+def test_fired_decisions_match_precomputed_replay():
+    sched = chaos.ChaosSchedule(seed=5).on("test.seam", error=ValueError,
+                                           p=0.5)
+    expected = [fire for fire, _ in sched.decisions("test.seam", 40)]
+    observed = []
+    for _ in range(40):
+        try:
+            sched.fire("test.seam")
+            observed.append(False)
+        except ValueError:
+            observed.append(True)
+    assert observed == expected
+    assert 0 < sum(observed) < 40  # p=0.5 over 40 draws: both outcomes
+
+
+def test_every_rule_fires_on_exact_cadence():
+    sched = chaos.ChaosSchedule(seed=0).on("test.seam", error=OSError,
+                                           every=3, limit=2)
+    fired = []
+    for i in range(12):
+        try:
+            sched.fire("test.seam")
+        except OSError:
+            fired.append(i)
+    assert fired == [2, 5]  # every 3rd call, capped by limit=2
+    assert sched.stats()["errors_fired"]["test.seam"] == 2
+
+
+def test_uninstalled_fire_is_a_noop():
+    chaos.uninstall()
+    chaos.fire(chaos.BATCHER_DISPATCH)  # must not raise
+    assert chaos.active() is None
+
+
+# -- batcher seam -------------------------------------------------------------
+
+def test_batcher_error_injection_fails_waiters_and_recovers():
+    sched = chaos.ChaosSchedule(seed=0).on(
+        chaos.BATCHER_DISPATCH, error=chaos.DeviceLost, every=2)
+    b = CoalescingBatcher(lambda items: [x * 2 for x in items],
+                          max_batch=1, max_delay=0.001, use_native=False)
+    outcomes = []
+    try:
+        with chaos.scope(sched):
+            for i in range(6):
+                try:
+                    outcomes.append(b.submit(i, timeout=5.0))
+                except chaos.DeviceLost:
+                    outcomes.append("lost")
+        # every=2 with max_batch=1: dispatch indices 1, 3, 5 fail
+        assert outcomes == [0, "lost", 4, "lost", 8, "lost"]
+    finally:
+        b.close(drain=False)
+
+
+# -- generator seams: injected device loss exercises loop recovery ------------
+
+def test_generator_device_loss_recovery():
+    import jax
+
+    from gofr_tpu.models import LLAMA_CONFIGS, llama
+    from gofr_tpu.tpu import GenerationEngine, GenerationError
+
+    tiny = LLAMA_CONFIGS["tiny"]
+    params = llama.init(tiny, jax.random.PRNGKey(1))
+    eng = GenerationEngine(tiny, params, slots=2, max_seq=32,
+                           prompt_buckets=(8,))
+    sched = chaos.ChaosSchedule(seed=0).on(
+        chaos.GENERATOR_STEP, error=chaos.DeviceLost, every=1, limit=1)
+    try:
+        with chaos.scope(sched):
+            with pytest.raises(GenerationError):
+                eng.generate([1, 2, 3], max_new_tokens=4).tokens()
+            # the loop reallocated the donated cache and keeps serving
+            toks = eng.generate([1, 2, 3], max_new_tokens=4).tokens()
+            assert len(toks) == 4
+            assert eng.down is None
+    finally:
+        eng.close()
+
+
+# -- socket-level faults ------------------------------------------------------
+
+def test_slow_loris_does_not_wedge_http_server():
+    from gofr_tpu.http.router import Router
+    from gofr_tpu.http.server import HTTPServer
+
+    r = Router()
+    r.add("GET", "/ok", lambda req, w: w.write(b'{"data":"ok"}'))
+    srv = HTTPServer(r, 0)
+    srv.start()
+    try:
+        loris = threading.Thread(
+            target=chaos.slow_loris,
+            args=("127.0.0.1", srv.port),
+            kwargs={"duration": 1.5, "interval": 0.05}, daemon=True)
+        loris.start()
+        time.sleep(0.2)  # the loris is mid-dribble
+        # normal clients are served throughout
+        for _ in range(5):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/ok", timeout=5.0) as resp:
+                assert resp.status == 200
+        loris.join(timeout=10.0)
+    finally:
+        srv.stop()
+
+
+def test_slow_h2_preface_does_not_wedge_grpc_server():
+    from gofr_tpu.grpcx import GRPCServer, GRPCService, dial
+
+    svc = GRPCService("demo.Echo")
+    svc.unary("Say", lambda ctx, req: {"msg": req["msg"]})
+    srv = GRPCServer([svc], port=0)
+    srv.start()
+    try:
+        loris = threading.Thread(
+            target=chaos.slow_h2_preface,
+            args=("127.0.0.1", srv.port),
+            kwargs={"duration": 1.5, "interval": 0.05}, daemon=True)
+        loris.start()
+        time.sleep(0.2)
+        ch = dial(f"127.0.0.1:{srv.port}")
+        for i in range(5):
+            assert ch.unary("/demo.Echo/Say", {"msg": i},
+                            timeout=5.0)["msg"] == i
+        ch.close()
+        loris.join(timeout=10.0)
+    finally:
+        srv.stop()
+
+
+# -- service-client seam + retry: faults absorbed end to end ------------------
+
+def test_retry_absorbs_injected_service_faults():
+    from gofr_tpu.http.router import Router
+    from gofr_tpu.http.server import HTTPServer
+    from gofr_tpu.service import new_http_service
+    from gofr_tpu.service.retry import RetryOption
+
+    r = Router()
+    r.add("GET", "/echo", lambda req, w: w.write(b'{"data":"pong"}'))
+    srv = HTTPServer(r, 0)
+    srv.start()
+    # every 2nd outbound attempt dies before the network hop
+    sched = chaos.ChaosSchedule(seed=0).on(
+        chaos.SERVICE_REQUEST, error=lambda: OSError("chaos: conn reset"),
+        every=2)
+    svc = new_http_service(f"http://127.0.0.1:{srv.port}", None, None,
+                           RetryOption(max_attempts=3, base_delay=0.001))
+    try:
+        with chaos.scope(sched):
+            for _ in range(6):
+                assert svc.get("/echo").json() == {"data": "pong"}
+        # every=2 fires on odd attempt indices; after the first clean
+        # call each logical call's first attempt lands on an odd index
+        # and needs exactly one retry: 5 retries across 6 calls
+        assert svc.retries == 5
+    finally:
+        svc.close()
+        srv.stop()
+
+
+def test_chaos_respects_open_circuit():
+    """Chaos at the service seam + breaker outside retry: once the
+    breaker opens, calls fail fast with CircuitOpenError and chaos's
+    seam stops being reached (no hammering)."""
+    from gofr_tpu.service.circuit_breaker import CircuitBreaker
+
+    class Dead:
+        address = "dead"
+
+        def get_with_headers(self, path, params=None, headers=None):
+            chaos.fire(chaos.SERVICE_REQUEST)
+            raise OSError("unreachable")
+
+        def health_check(self):
+            from gofr_tpu.datasource import Health, STATUS_DOWN
+
+            return Health(STATUS_DOWN, {})
+
+        def close(self):
+            pass
+
+    sched = chaos.ChaosSchedule(seed=0)
+    retry = Retry(Dead(), max_attempts=2, sleep=lambda s: None)
+    cb = CircuitBreaker(retry, threshold=2, interval=60.0,
+                        start_background_probe=False)
+    with chaos.scope(sched):
+        for _ in range(2):
+            with pytest.raises(OSError):
+                cb.get("/x")
+        assert cb.is_open
+        with pytest.raises(CircuitOpenError):
+            cb.get("/x")
+
+
+# -- chaos latency pins service time (the bench's capacity mechanism) ---------
+
+def test_latency_rule_sets_dispatch_cadence():
+    service_s = 0.03
+    sched = chaos.ChaosSchedule(seed=0).on(chaos.BATCHER_DISPATCH,
+                                           latency=service_s)
+    b = CoalescingBatcher(lambda items: items, max_batch=4,
+                          max_delay=0.001, use_native=False)
+    try:
+        with chaos.scope(sched):
+            t0 = time.monotonic()
+            b.submit(np.int32(1), timeout=5.0)
+            elapsed = time.monotonic() - t0
+        assert elapsed >= service_s
+        assert sched.stats()["injected_sleep_s"][chaos.BATCHER_DISPATCH] \
+            == pytest.approx(service_s)
+    finally:
+        b.close(drain=False)

@@ -1,0 +1,886 @@
+"""Overload-safety tests: deadline propagation wire->chip, admission
+control/shedding, brownout, graceful-drain readiness, retry/breaker
+composition, and the batcher abandonment race.
+
+The e2e acceptance scenarios from ISSUE 3 live here: a 50 ms gRPC
+deadline on a deliberately slow program must yield DEADLINE_EXCEEDED
+without the runner ever executing the expired item (asserted via
+``app_tpu_expired_dropped_total``), and the HTTP path must 504
+analogously. Slowness is injected with the seeded chaos harness
+(``gofr_tpu/chaos.py``) so no test depends on real device timing.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gofr_tpu import chaos
+from gofr_tpu import metrics as gmetrics
+from gofr_tpu.config import MapConfig
+from gofr_tpu.errors import CircuitOpenError, DeadlineExceeded, TooManyRequests
+from gofr_tpu.resilience import (AdmissionGate, Deadline, current_deadline,
+                                 deadline_scope, parse_http_timeout)
+from gofr_tpu.service.circuit_breaker import CircuitBreaker
+from gofr_tpu.service.retry import Retry
+from gofr_tpu.service.wrap import VerbSurface
+from gofr_tpu.tpu.batcher import CoalescingBatcher
+from gofr_tpu.tpu.engine import TPUEngine
+from gofr_tpu.grpcx import GRPCError, GRPCServer, GRPCService, dial
+from gofr_tpu.grpcx import service as grpc_svc
+
+
+def counter_value(metrics: gmetrics.Manager, name: str) -> float:
+    """Sum a counter over all label sets from the Prometheus rendering —
+    the same surface operators read."""
+    total = 0.0
+    for line in metrics.render_prometheus().splitlines():
+        m = re.match(rf"{name}(?:\{{[^}}]*\}})? ([0-9.e+-]+)$", line)
+        if m:
+            total += float(m.group(1))
+    return total
+
+
+def new_metrics() -> gmetrics.Manager:
+    m = gmetrics.Manager()
+    gmetrics.register_framework_metrics(m)
+    return m
+
+
+# -- Deadline primitives ------------------------------------------------------
+
+def test_parse_http_timeout_units_and_garbage():
+    assert parse_http_timeout("0.05") == pytest.approx(0.05)
+    assert parse_http_timeout("50ms") == pytest.approx(0.05)
+    assert parse_http_timeout("250us") == pytest.approx(250e-6)
+    assert parse_http_timeout("2s") == pytest.approx(2.0)
+    assert parse_http_timeout("1m") == pytest.approx(60.0)
+    assert parse_http_timeout("  5S ") == pytest.approx(5.0)
+    for bad in (None, "", "soon", "-3", "0", "12q"):
+        assert parse_http_timeout(bad) is None
+
+
+def test_deadline_budget_and_expiry():
+    dl = Deadline.after(0.05)
+    assert not dl.expired()
+    assert 0 < dl.remaining() <= 0.05
+    assert dl.budget(10.0) <= 0.05
+    assert dl.budget(0.01) == pytest.approx(0.01, abs=1e-3)
+    time.sleep(0.06)
+    assert dl.expired() and dl.remaining() <= 0
+
+
+def test_deadline_scope_is_ambient_and_keeps_tighter():
+    assert current_deadline() is None
+    outer = Deadline.after(0.05)
+    with deadline_scope(outer):
+        assert current_deadline() is outer
+        with deadline_scope(Deadline.after(60.0)) as inner:
+            # nesting may only TIGHTEN the budget, never extend it
+            assert inner is outer and current_deadline() is outer
+        loose = Deadline.after(0.001)
+        with deadline_scope(loose):
+            assert current_deadline() is loose
+        assert current_deadline() is outer
+    assert current_deadline() is None
+
+
+def test_deadline_scope_is_per_thread():
+    seen = []
+    with deadline_scope(Deadline.after(1.0)):
+        t = threading.Thread(target=lambda: seen.append(current_deadline()))
+        t.start()
+        t.join()
+    assert seen == [None]
+
+
+# -- batcher: expired drop + abandonment race (satellite 1) -------------------
+
+def test_batcher_drops_expired_item_without_executing():
+    """An item whose deadline expires while queued is failed with
+    DeadlineExceeded and NEVER reaches the runner."""
+    executed = []
+    release = threading.Event()
+
+    def runner(items):
+        executed.extend(items)
+        release.wait(5.0)
+        return items
+
+    expired_counts = []
+    b = CoalescingBatcher(runner, max_batch=4, max_delay=0.001,
+                          use_native=False,
+                          on_expired=lambda n: expired_counts.append(n))
+    try:
+        # occupy the dispatcher with a long-running batch
+        occupier = threading.Thread(
+            target=lambda: b.submit("A", timeout=10.0), daemon=True)
+        occupier.start()
+        deadline = time.monotonic() + 2.0
+        while "A" not in executed and time.monotonic() < deadline:
+            time.sleep(0.001)
+        assert executed == ["A"]
+        # the doomed item: 30ms budget, runner busy for much longer
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            b.submit("B", timeout=10.0, deadline=Deadline.after(0.03))
+        assert time.monotonic() - t0 < 1.0  # failed at its deadline, fast
+        release.set()
+        occupier.join(timeout=5.0)
+        assert b.submit("C", timeout=5.0) == "C"  # still serving
+        assert "B" not in executed  # the expired item never ran
+        assert sum(expired_counts) == 1
+        assert b.queue_depth() == 0  # nothing leaked
+    finally:
+        release.set()
+        b.close(drain=False)
+
+
+def test_batcher_prune_path_counts_outside_waiter():
+    """The dispatcher-side prune (queue scan at _take_batch) also drops
+    expired items, fails them with DeadlineExceeded, and reports the
+    count — even when no waiter is around to reap them."""
+    from gofr_tpu.tpu.batcher import BatchItem
+
+    counts = []
+    b = CoalescingBatcher(lambda items: items, max_batch=4, max_delay=0.001,
+                          use_native=False, on_expired=counts.append)
+    try:
+        dead = BatchItem("zombie", deadline=Deadline(time.monotonic() - 1.0))
+        with b._lock:
+            b._queue.append(dead)
+            b._nonempty.notify()
+        assert dead.done.wait(2.0)
+        assert isinstance(dead.error, DeadlineExceeded)
+        deadline = time.monotonic() + 2.0
+        while not counts and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert sum(counts) == 1
+        assert b.submit("live", timeout=5.0) == "live"  # still serving
+    finally:
+        b.close(drain=False)
+
+
+def test_batcher_rejects_already_expired_submit():
+    b = CoalescingBatcher(lambda items: items, max_batch=2, use_native=False)
+    try:
+        dl = Deadline(time.monotonic() - 1.0)
+        with pytest.raises(DeadlineExceeded):
+            b.submit("x", deadline=dl)
+    finally:
+        b.close(drain=False)
+
+
+@pytest.mark.parametrize("use_native", [False, True])
+def test_batcher_timeout_reaps_abandoned_item(use_native):
+    """Satellite: a timed-out waiter's item must not linger in the
+    queue/native map and must never be executed by a later dispatch."""
+    executed = []
+    release = threading.Event()
+
+    def runner(items):
+        executed.extend(items)
+        release.wait(5.0)
+        return items
+
+    b = CoalescingBatcher(runner, max_batch=4, max_delay=0.001,
+                          use_native=use_native)
+    try:
+        occupier = threading.Thread(
+            target=lambda: b.submit("A", timeout=10.0), daemon=True)
+        occupier.start()
+        deadline = time.monotonic() + 2.0
+        while "A" not in executed and time.monotonic() < deadline:
+            time.sleep(0.001)
+        # B queues behind the stuck batch and its waiter gives up
+        with pytest.raises(TimeoutError):
+            b.submit("B", timeout=0.05)
+        assert b.queue_depth() == 0  # reaped, not leaked
+        release.set()
+        occupier.join(timeout=5.0)
+        assert b.submit("C", timeout=5.0) == "C"
+        assert "B" not in executed  # abandoned item never dispatched
+    finally:
+        release.set()
+        b.close(drain=False)
+
+
+def test_batcher_timeout_of_claimed_item_keeps_waiter_error():
+    """A waiter that times out while its item is INSIDE a dispatched
+    batch must keep its TimeoutError — the runner's later completion
+    must not overwrite it (the PR-3 _run_one race)."""
+    release = threading.Event()
+
+    def runner(items):
+        release.wait(5.0)
+        return [it.upper() for it in items]
+
+    b = CoalescingBatcher(runner, max_batch=2, max_delay=0.001,
+                          use_native=False)
+    try:
+        with pytest.raises(TimeoutError):
+            b.submit("a", timeout=0.05)  # claimed by the dispatcher, stuck
+        release.set()
+        # the batcher survives and serves normally afterwards
+        assert b.submit("b", timeout=5.0) == "B"
+    finally:
+        release.set()
+        b.close(drain=False)
+
+
+# -- admission gate -----------------------------------------------------------
+
+def test_gate_depth_shed_carries_retry_after():
+    m = new_metrics()
+    gate = AdmissionGate(max_queue_depth=4, name="g", metrics=m)
+    gate.admit(3)  # under the bound: admitted
+    with pytest.raises(TooManyRequests) as ei:
+        gate.admit(4)
+    e = ei.value
+    assert e.status_code == 429
+    assert e.retry_after is not None and e.retry_after > 0
+    assert int(e.headers["Retry-After"]) >= 1
+    assert gate.sheds == 1
+    assert counter_value(m, "app_tpu_shed_total") == 1.0
+
+
+def test_gate_delay_shed_uses_wait_ewma():
+    gate = AdmissionGate(max_queue_delay=0.05, name="g")
+    gate.admit(100)  # no wait signal yet: depth alone never sheds here
+    for _ in range(20):
+        gate.note_wait(0.5)
+    with pytest.raises(TooManyRequests):
+        gate.admit(1)
+    gate.admit(0)  # an empty queue always admits (nothing to wait behind)
+
+
+def test_gate_disabled_admits_everything():
+    gate = AdmissionGate()
+    assert not gate.enabled
+    gate.admit(10**6)
+
+
+def test_gate_brownout_caps_token_budget():
+    m = new_metrics()
+    gate = AdmissionGate(max_queue_depth=1000, brownout_delay=0.05,
+                         brownout_max_new=16, name="g", metrics=m)
+    assert gate.cap_tokens(128) == 128  # healthy: no cap
+    for _ in range(20):
+        gate.note_wait(0.2)  # wait estimate over the brownout threshold
+    assert gate.cap_tokens(128) == 16
+    assert gate.cap_tokens(8) == 8  # already under the cap
+    assert gate.stats()["brownout_active"] is True
+    assert counter_value(m, "app_tpu_brownout_capped_total") == 1.0
+    for _ in range(40):
+        gate.note_wait(0.0)  # recovered
+    assert gate.cap_tokens(128) == 128
+    assert gate.stats()["brownout_active"] is False
+
+
+def test_engine_predict_sheds_with_gate():
+    release = threading.Event()
+    sched = chaos.ChaosSchedule(seed=7).on(chaos.BATCHER_DISPATCH,
+                                           latency=0.05)
+    m = new_metrics()
+    eng = TPUEngine(metrics=m, max_delay=0.001,
+                    gate=AdmissionGate(max_queue_depth=2, name="predict",
+                                       metrics=m))
+    eng.register("echo", lambda p, t, lens: t, None, kind="tokens",
+                 batch_buckets=(1, 2), seq_buckets=(8,))
+    item = np.arange(1, 4, dtype=np.int32)
+    eng.warmup("echo")
+    results = {"ok": 0, "shed": 0}
+    lock = threading.Lock()
+
+    def one():
+        try:
+            eng.predict("echo", item, timeout=10.0)
+            with lock:
+                results["ok"] += 1
+        except TooManyRequests:
+            with lock:
+                results["shed"] += 1
+
+    try:
+        with chaos.scope(sched):
+            threads = [threading.Thread(target=one) for _ in range(12)]
+            for t in threads:
+                t.start()
+                time.sleep(0.003)  # arrivals spread across ~one dispatch
+            for t in threads:
+                t.join(timeout=10.0)
+        assert results["ok"] + results["shed"] == 12
+        assert results["shed"] >= 2  # overload vs depth bound 2: must shed
+        assert results["ok"] >= 2    # in-flight + queued still served
+        assert counter_value(m, "app_tpu_shed_total") == results["shed"]
+    finally:
+        release.set()
+        eng.close()
+
+
+def test_engine_gates_are_per_program():
+    """One gate per program queue: a backlogged program's wait EWMA must
+    not shed a healthy program's traffic."""
+    m = new_metrics()
+    eng = TPUEngine(metrics=m, max_delay=0.001,
+                    gate=AdmissionGate(max_queue_delay=0.05, name="tmpl",
+                                       metrics=m))
+    eng.register("hot", lambda p, t, lens: t, None, kind="tokens",
+                 batch_buckets=(1, 2), seq_buckets=(8,))
+    eng.register("cold", lambda p, t, lens: t, None, kind="tokens",
+                 batch_buckets=(1, 2), seq_buckets=(8,))
+    try:
+        ga, gb = eng._gates["hot"], eng._gates["cold"]
+        assert ga is not gb
+        for _ in range(20):
+            ga.note_wait(1.0)  # "hot" is drowning
+        with pytest.raises(TooManyRequests):
+            ga.admit(1, program="hot")
+        # "cold" still admits — its own EWMA is untouched
+        gb.admit(1, program="cold")
+        out = eng.predict("cold", np.arange(1, 4, dtype=np.int32),
+                          timeout=10.0)
+        assert np.asarray(out).shape == (8,)
+        health = eng.health_check().details["admission"]
+        assert health["hot"]["sheds"] == 1 and health["cold"]["sheds"] == 0
+    finally:
+        eng.close()
+
+
+# -- e2e: gRPC 50ms deadline -> DEADLINE_EXCEEDED, item never executed --------
+
+class _Box:
+    """Minimal container stand-in for GRPCServer/handlers."""
+
+    def __init__(self, tpu, logger=None, tracer=None):
+        self.tpu = tpu
+        self.logger = logger
+        self.tracer = tracer
+
+    def get_http_service(self, name):
+        return None
+
+
+def _slow_engine(metrics, latency=0.15):
+    """Engine whose every dispatch takes ``latency`` (chaos-injected)."""
+    eng = TPUEngine(metrics=metrics, max_delay=0.001)
+    eng.register("echo", lambda p, t, lens: t, None, kind="tokens",
+                 batch_buckets=(1, 2), seq_buckets=(8,))
+    eng.warmup("echo")
+    sched = chaos.ChaosSchedule(seed=3).on(chaos.BATCHER_DISPATCH,
+                                           latency=latency)
+    return eng, sched
+
+
+def _occupy(eng, executed_sizes):
+    """Park one request inside a (slow) dispatch so later arrivals queue."""
+    b = eng._batchers["echo"]
+    prev = b.on_dispatch
+
+    def hook(n, w):
+        executed_sizes.append(n)
+        if prev is not None:
+            prev(n, w)
+
+    b.on_dispatch = hook
+    t = threading.Thread(
+        target=lambda: eng.predict(
+            "echo", np.arange(1, 4, dtype=np.int32), timeout=10.0),
+        daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while not executed_sizes and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert executed_sizes, "occupier dispatch never started"
+    return t
+
+
+def test_grpc_deadline_expires_in_queue_without_execution():
+    m = new_metrics()
+    eng, sched = _slow_engine(m, latency=0.25)
+    svc = GRPCService("bench.Slow")
+
+    @svc.unary("Predict")
+    def predict(ctx, req):
+        out = ctx.tpu.predict("echo", np.asarray(req["tokens"], np.int32))
+        return {"out": np.asarray(out).tolist()}
+
+    server = GRPCServer([svc], port=0, container=_Box(eng))
+    server.start()
+    executed = []
+    try:
+        with chaos.scope(sched):
+            occupier = _occupy(eng, executed)
+            ch = dial(f"127.0.0.1:{server.port}")
+            t0 = time.monotonic()
+            with pytest.raises(GRPCError) as ei:
+                ch.unary("/bench.Slow/Predict", {"tokens": [1, 2, 3]},
+                         timeout=0.05)
+            elapsed = time.monotonic() - t0
+            assert ei.value.code == grpc_svc.DEADLINE_EXCEEDED
+            # failed at ~the deadline, not after the slow dispatch
+            assert elapsed < 0.2
+            occupier.join(timeout=10.0)
+            ch.close()
+        # dispatches only ever carried the occupier — the expired item
+        # was dropped before execution, and the counter proves it
+        assert all(n == 1 for n in executed)
+        assert counter_value(m, "app_tpu_expired_dropped_total") >= 1.0
+    finally:
+        server.stop()
+        eng.close()
+
+
+def test_grpc_maps_shed_to_resource_exhausted_with_retry_after():
+    m = new_metrics()
+    eng, sched = _slow_engine(m, latency=0.25)
+    eng.gate = AdmissionGate(max_queue_depth=1, name="predict", metrics=m)
+    svc = GRPCService("bench.Slow")
+
+    @svc.unary("Predict")
+    def predict(ctx, req):
+        out = ctx.tpu.predict("echo", np.asarray(req["tokens"], np.int32))
+        return {"out": np.asarray(out).tolist()}
+
+    server = GRPCServer([svc], port=0, container=_Box(eng))
+    server.start()
+    executed = []
+    try:
+        with chaos.scope(sched):
+            occupier = _occupy(eng, executed)
+            # one rider fills the queue (depth 1), the next is shed
+            rider = threading.Thread(
+                target=lambda: eng.predict(
+                    "echo", np.arange(1, 4, dtype=np.int32), timeout=10.0),
+                daemon=True)
+            rider.start()
+            time.sleep(0.05)
+            ch = dial(f"127.0.0.1:{server.port}")
+            with pytest.raises(GRPCError) as ei:
+                ch.unary("/bench.Slow/Predict", {"tokens": [1, 2, 3]},
+                         timeout=2.0)
+            assert ei.value.code == grpc_svc.RESOURCE_EXHAUSTED
+            ch.close()
+            occupier.join(timeout=10.0)
+            rider.join(timeout=10.0)
+    finally:
+        server.stop()
+        eng.close()
+
+
+# -- e2e: HTTP X-Request-Timeout -> 504 ---------------------------------------
+
+def test_http_deadline_expires_in_queue_returns_504():
+    from gofr_tpu import App
+
+    app = App(MapConfig({"HTTP_PORT": "0", "METRICS_PORT": "0"}))
+    m = app.container.metrics
+    eng, sched = _slow_engine(m, latency=0.25)
+    app.container.tpu = eng
+
+    @app.get("/predict")
+    def predict(ctx):
+        out = ctx.tpu.predict("echo", np.arange(1, 4, dtype=np.int32))
+        return {"out": np.asarray(out).tolist()}
+
+    app.run(block=False)
+    executed = []
+    try:
+        with chaos.scope(sched):
+            occupier = _occupy(eng, executed)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{app.http_port}/predict",
+                headers={"X-Request-Timeout": "50ms"})
+            t0 = time.monotonic()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5.0)
+            assert ei.value.code == 504
+            assert time.monotonic() - t0 < 0.2
+            body = json.loads(ei.value.read())
+            assert "deadline" in body["error"]["message"].lower()
+            occupier.join(timeout=10.0)
+        assert all(n == 1 for n in executed)
+        assert counter_value(m, "app_tpu_expired_dropped_total") >= 1.0
+    finally:
+        app.stop()
+        eng.close()
+
+
+def test_http_shed_returns_429_with_retry_after():
+    from gofr_tpu import App
+
+    app = App(MapConfig({"HTTP_PORT": "0", "METRICS_PORT": "0"}))
+    eng, sched = _slow_engine(app.container.metrics, latency=0.25)
+    eng.gate = AdmissionGate(max_queue_depth=1, name="predict",
+                             metrics=app.container.metrics)
+    app.container.tpu = eng
+
+    @app.get("/predict")
+    def predict(ctx):
+        out = ctx.tpu.predict("echo", np.arange(1, 4, dtype=np.int32))
+        return {"out": np.asarray(out).tolist()}
+
+    app.run(block=False)
+    executed = []
+    try:
+        with chaos.scope(sched):
+            occupier = _occupy(eng, executed)
+            rider = threading.Thread(
+                target=lambda: eng.predict(
+                    "echo", np.arange(1, 4, dtype=np.int32), timeout=10.0),
+                daemon=True)
+            rider.start()
+            time.sleep(0.05)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{app.http_port}/predict", timeout=5.0)
+            assert ei.value.code == 429
+            assert int(ei.value.headers["Retry-After"]) >= 1
+            occupier.join(timeout=10.0)
+            rider.join(timeout=10.0)
+    finally:
+        app.stop()
+        eng.close()
+
+
+# -- graceful drain flips readiness first (satellite 3) -----------------------
+
+def test_app_drain_readiness_flips_before_engine_stops():
+    """During stop(grace_s): HTTP health 503 + Retry-After, gRPC health
+    NOT_SERVING, new RPCs UNAVAILABLE — while the in-flight generation
+    stream finishes over its live connection."""
+    from gofr_tpu import App
+
+    app = App(MapConfig({"HTTP_PORT": "0", "METRICS_PORT": "0",
+                         "GRPC_PORT": "0",
+                         "TPU_MODEL": "tiny", "TPU_MAX_SEQ": "64",
+                         "TPU_SLOTS": "2", "TPU_SEQ_BUCKETS": "8,16"}))
+    gen_svc = GRPCService("demo.Gen")
+
+    @gen_svc.unary("Echo")
+    def echo(ctx, req):
+        return {"ok": True}
+
+    app.register_grpc_service(gen_svc)
+
+    @app.get("/gen")
+    def gen(ctx):
+        return {"tokens": ctx.tpu.generate([1, 2, 3],
+                                           max_new_tokens=40).tokens()}
+
+    # slow the decode loop so the drain window is reliably observable
+    sched = chaos.ChaosSchedule(seed=1).on(chaos.GENERATOR_STEP,
+                                           latency=0.05)
+    app.run(block=False)
+    try:
+        with chaos.scope(sched):
+            results = []
+
+            def client():
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{app.http_port}/gen",
+                        timeout=120) as r:
+                    results.append(json.loads(r.read()))
+
+            t = threading.Thread(target=client)
+            t.start()
+            time.sleep(0.3)  # stream decoding
+            stopper = threading.Thread(target=lambda: app.stop(grace_s=30.0))
+            stopper.start()
+            deadline = time.monotonic() + 5.0
+            while not app._draining and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert app._draining
+
+            # HTTP readiness: health 503 + Retry-After, new requests 503
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{app.http_port}/.well-known/health",
+                    timeout=5.0)
+            assert ei.value.code == 503
+            assert int(ei.value.headers["Retry-After"]) >= 1
+            # liveness stays up: the process is healthy, just leaving
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{app.http_port}/.well-known/alive",
+                    timeout=5.0) as r:
+                assert r.status == 200
+
+            # gRPC readiness: health NOT_SERVING, new RPCs UNAVAILABLE
+            ch = dial(f"127.0.0.1:{app.grpc_port}")
+            health = ch.unary("/grpc.health.v1.Health/Check", {},
+                              timeout=5.0)
+            assert health["status"] == "NOT_SERVING"
+            with pytest.raises(GRPCError) as gei:
+                ch.unary("/demo.Gen/Echo", {}, timeout=5.0)
+            assert gei.value.code == grpc_svc.UNAVAILABLE
+            ch.close()
+
+            # the in-flight stream still completes in full
+            t.join(timeout=60.0)
+            assert results and len(results[0]["data"]["tokens"]) == 40
+            stopper.join(timeout=60.0)
+            assert not stopper.is_alive()
+    finally:
+        if app._running.is_set():
+            app.stop()
+
+
+def test_grpc_health_serving_when_up():
+    svc = GRPCService("noop.Svc")
+    svc.unary("Nop", lambda ctx, req: {})
+    server = GRPCServer([svc], port=0)
+    server.start()
+    try:
+        ch = dial(f"127.0.0.1:{server.port}")
+        assert ch.unary("/grpc.health.v1.Health/Check", {},
+                        timeout=5.0)["status"] == "SERVING"
+        ch.close()
+    finally:
+        server.stop()
+
+
+# -- retry with backoff (satellite 2) -----------------------------------------
+
+class ScriptedService(VerbSurface):
+    """Inner client whose _do returns scripted responses or raises."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls: list[tuple] = []
+        self.address = "scripted"
+
+    def _do(self, method, path, params, body, headers):
+        self.calls.append((method, path))
+        step = self.script.pop(0) if self.script else 200
+        if isinstance(step, BaseException):
+            raise step
+        if callable(step):
+            return step()
+
+        class R:
+            def __init__(self, status, hdrs=None):
+                self.status_code = status
+                self._h = {k.lower(): v for k, v in (hdrs or {}).items()}
+
+            def header(self, k, default=""):
+                return self._h.get(k.lower(), default)
+
+        if isinstance(step, tuple):
+            return R(step[0], step[1])
+        return R(step)
+
+    def health_check(self):
+        from gofr_tpu.datasource import Health, STATUS_UP
+
+        return Health(STATUS_UP, {})
+
+    def close(self):
+        pass
+
+
+def test_retry_honors_retry_after_then_succeeds():
+    sleeps = []
+    inner = ScriptedService([(503, {"Retry-After": "1"}), 200])
+    r = Retry(inner, max_attempts=3, base_delay=0.01, max_delay=5.0,
+              sleep=sleeps.append)
+    resp = r.get("/x")
+    assert resp.status_code == 200
+    assert len(inner.calls) == 2
+    assert sleeps == [1.0]  # the server's hint, not computed jitter
+    assert r.retries == 1
+
+
+def test_retry_after_beats_max_delay_up_to_cap():
+    """A draining server's Retry-After wins over max_delay (the server
+    knows its queue); only retry_after_cap bounds a runaway header."""
+    sleeps = []
+    inner = ScriptedService([(503, {"Retry-After": "5"}), 200])
+    r = Retry(inner, max_attempts=2, base_delay=0.01, max_delay=2.0,
+              sleep=sleeps.append)
+    assert r.get("/x").status_code == 200
+    assert sleeps == [5.0]  # honored past max_delay...
+
+    sleeps2 = []
+    inner2 = ScriptedService([(503, {"Retry-After": "9999"}), 200])
+    r2 = Retry(inner2, max_attempts=2, base_delay=0.01, max_delay=2.0,
+               retry_after_cap=10.0, sleep=sleeps2.append)
+    assert r2.get("/x").status_code == 200
+    assert sleeps2 == [10.0]  # ...but never past the cap
+
+
+def test_retry_full_jitter_backoff_is_bounded():
+    import random
+
+    sleeps = []
+    inner = ScriptedService([503, 503, 200])
+    r = Retry(inner, max_attempts=3, base_delay=0.1, max_delay=0.15,
+              rng=random.Random(42), sleep=sleeps.append)
+    assert r.get("/x").status_code == 200
+    assert len(sleeps) == 2
+    assert 0 <= sleeps[0] <= 0.1     # U[0, base*2^0)
+    assert 0 <= sleeps[1] <= 0.15    # capped by max_delay
+
+
+def test_retry_only_idempotent_methods_by_default():
+    inner = ScriptedService([503, 200])
+    r = Retry(inner, max_attempts=3, sleep=lambda s: None)
+    assert r.post("/x").status_code == 503  # POST: surfaced, not retried
+    assert len(inner.calls) == 1
+
+    inner2 = ScriptedService([503, 200])
+    r2 = Retry(inner2, max_attempts=3, retry_non_idempotent=True,
+               sleep=lambda s: None)
+    assert r2.post("/x").status_code == 200
+    assert len(inner2.calls) == 2
+
+
+def test_retry_connection_error_idempotent_only():
+    inner = ScriptedService([OSError("boom"), 200])
+    r = Retry(inner, max_attempts=3, sleep=lambda s: None)
+    assert r.get("/x").status_code == 200
+    assert len(inner.calls) == 2
+
+    inner2 = ScriptedService([OSError("boom"), 200])
+    r2 = Retry(inner2, max_attempts=3, sleep=lambda s: None)
+    with pytest.raises(OSError):
+        r2.post("/x")
+    assert len(inner2.calls) == 1
+
+
+def test_retry_gives_up_before_outliving_deadline():
+    sleeps = []
+    inner = ScriptedService([503, 503, 200])
+    r = Retry(inner, max_attempts=3, base_delay=5.0, max_delay=5.0,
+              sleep=sleeps.append)
+    with deadline_scope(Deadline.after(0.05)):
+        resp = r.get("/x")
+    # backoff (up to 5s) would outlive the 50ms budget: stop, surface 503
+    assert resp.status_code == 503
+    assert sleeps == []
+
+
+def test_retry_inside_breaker_counts_one_failure_not_n():
+    """Composition contract: breaker OUTSIDE retrier — a logical call
+    that exhausts 3 attempts is ONE breaker failure."""
+    inner = ScriptedService([OSError("a"), OSError("b"), OSError("c"),
+                             200])
+    retry = Retry(inner, max_attempts=3, sleep=lambda s: None)
+    breaker = CircuitBreaker(retry, threshold=2,
+                             start_background_probe=False)
+    with pytest.raises(OSError):
+        breaker.get("/x")
+    assert len(inner.calls) == 3       # the retrier burned its attempts
+    assert breaker._failures == 1      # ...but the breaker counted ONE
+    assert not breaker.is_open
+    assert breaker.get("/x").status_code == 200
+    assert breaker._failures == 0
+
+
+def test_retry_never_retries_open_circuit():
+    inner = ScriptedService([200])
+    breaker = CircuitBreaker(inner, threshold=1, interval=60.0,
+                             start_background_probe=False)
+    with breaker._lock:
+        breaker._open()
+    retry = Retry(breaker, max_attempts=5, sleep=lambda s: None)
+    with pytest.raises(CircuitOpenError):
+        retry.get("/x")
+    assert inner.calls == []  # open circuit: zero attempts reached it
+
+
+# -- half-open breaker inline probe under concurrency (satellite 4) -----------
+
+class GatedService(VerbSurface):
+    """Inner service that parks every call on a barrier so concurrent
+    probe attempts overlap deterministically."""
+
+    def __init__(self):
+        self.calls = 0
+        self.release = threading.Event()
+        self.status = 200
+        self._lock = threading.Lock()
+        self.address = "gated"
+
+    def _do(self, method, path, params, body, headers):
+        with self._lock:
+            self.calls += 1
+        self.release.wait(5.0)
+
+        class R:
+            pass
+
+        r = R()
+        r.status_code = self.status
+        return r
+
+    def health_check(self):
+        from gofr_tpu.datasource import Health, STATUS_UP
+
+        return Health(STATUS_UP, {})
+
+    def close(self):
+        pass
+
+
+class TestHalfOpenProbeConcurrency:
+    def _opened_breaker(self, inner, interval=0.15):
+        cb = CircuitBreaker(inner, threshold=1, interval=interval,
+                            start_background_probe=False)
+        with cb._lock:
+            cb._open()
+        # age the OPEN state past `interval` so the inline probe arms
+        cb._opened_at = time.monotonic() - 2 * interval
+        return cb
+
+    def test_exactly_one_probe_passes_concurrently(self):
+        inner = GatedService()
+        cb = self._opened_breaker(inner)
+        outcomes = []
+        lock = threading.Lock()
+
+        def call():
+            try:
+                r = cb.get("/probe")
+                with lock:
+                    outcomes.append(r.status_code)
+            except CircuitOpenError:
+                with lock:
+                    outcomes.append("open")
+
+        threads = [threading.Thread(target=call) for _ in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)  # everyone has hit the gate; probe is parked
+        inner.release.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert inner.calls == 1  # exactly ONE request passed while OPEN
+        assert outcomes.count("open") == 7
+        assert outcomes.count(200) == 1
+        assert not cb.is_open  # 2xx probe closed the circuit
+
+    def test_probe_5xx_rearms_the_window(self):
+        inner = GatedService()
+        inner.status = 500
+        inner.release.set()
+        cb = self._opened_breaker(inner, interval=0.2)
+        r = cb.get("/probe")  # the armed probe goes through...
+        assert r.status_code == 500
+        assert cb.is_open  # ...fails, circuit stays open
+        # window re-armed: an immediate caller is rejected inline
+        with pytest.raises(CircuitOpenError):
+            cb.get("/again")
+        assert inner.calls == 1
+        # after `interval` elapses again, the next probe is allowed
+        cb._last_probe = time.monotonic() - 0.3
+        inner.status = 200
+        assert cb.get("/recovered").status_code == 200
+        assert not cb.is_open
+        assert inner.calls == 2
