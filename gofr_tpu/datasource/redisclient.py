@@ -203,6 +203,29 @@ class RedisClient:
     def get(self, key: str) -> str | None:
         return self._text(self.command("GET", key))
 
+    def get_bytes(self, key: str) -> bytes | None:
+        """GET returning the raw bulk-string payload. ``get`` decodes
+        replies to ``str``, which is lossy for binary values (KV cache
+        blocks, packed structs, pickles) — this keeps the bytes."""
+        reply = self.command("GET", key)
+        if reply is None or isinstance(reply, bytes):
+            return reply
+        return str(reply).encode()
+
+    def mget(self, *keys: str) -> list[bytes | None]:
+        """MGET returning raw ``bytes`` per key (None for absent keys)
+        — one round trip for a whole block chain; binary-safe like
+        ``get_bytes``."""
+        if not keys:
+            return []
+        out = []
+        for reply in self.command("MGET", *keys) or []:
+            if reply is None or isinstance(reply, bytes):
+                out.append(reply)
+            else:
+                out.append(str(reply).encode())
+        return out
+
     def delete(self, *keys: str) -> int:
         return self.command("DEL", *keys)
 

@@ -287,6 +287,24 @@ def register_framework_metrics(m: Manager) -> None:
     m.new_counter("app_tpu_tokens_generated_total", "total generated tokens")
     m.new_counter("app_tpu_prefix_cache_hits_total",
                   "generation admissions that restored a cached prompt-prefix KV row")
+    # hierarchical kv cache (tpu/kvcache: t0=HBM pool, t1=host DRAM,
+    # t2=Redis-shared — docs/advanced-guide/kv-cache.md). Counters are
+    # labeled by tier; a lookup that falls through t0 to hit t1 counts
+    # a t0 miss AND a t1 hit, so per-tier hit ratios read directly.
+    m.new_counter("app_tpu_kvcache_hits_total",
+                  "prefix-cache lookups served, by tier")
+    m.new_counter("app_tpu_kvcache_misses_total",
+                  "prefix-cache lookups a consulted tier failed to serve")
+    m.new_counter("app_tpu_kvcache_evictions_total",
+                  "prefix entries evicted, by tier (t0 evictions spill "
+                  "to t1 when the host tier is enabled)")
+    m.new_gauge("app_tpu_kvcache_entries", "live prefix entries, by tier")
+    m.new_gauge("app_tpu_kvcache_bytes",
+                "bytes held by the host offload tier")
+    m.new_histogram("app_tpu_kvcache_restore_duration",
+                    "host-side prefix-restore path time in seconds, by "
+                    "tier (row copy dispatch; +device_put for t1; "
+                    "+Redis fetch for t2)", TPU_BUCKETS)
     m.new_gauge("app_tpu_devices", "number of visible TPU devices")
     m.new_counter("app_tpu_paged_evictions_total",
                   "streams truncated early by paged KV pool exhaustion")

@@ -393,7 +393,9 @@ class BlockAllocator:
 
 class SharedPrefixIndex:
     """Zero-copy prefix reuse for the paged pool (the paged counterpart
-    of tpu/prefix_cache.PrefixIndex): entries record the FULL T-token
+    of the contiguous engine's tpu/kvcache hierarchy — here the pool
+    blocks ARE the storage, so there is nothing to tier): entries
+    record the FULL T-token
     blocks of a stored prompt prefix and hold a reference on each — no
     KV is ever copied to store. Full blocks are immutable once written
     (decode only ever writes the block at a slot's cursor, which lies

@@ -124,6 +124,8 @@ def install_debug_routes(router, app) -> None:
             " — flight recorder</li>"
             '<li><a href="/debug/vars">/debug/vars</a>'
             " — config, topology, engine state</li>"
+            '<li><a href="/debug/cache">/debug/cache</a>'
+            " — prefix KV cache tiers</li>"
             '<li><a href="/debug/pprof/profile?seconds=1">'
             "/debug/pprof/profile</a> — wall-clock sampling profile</li>"
             '<li><a href="/metrics">/metrics</a> — Prometheus</li></ul>'))
@@ -196,6 +198,38 @@ def install_debug_routes(router, app) -> None:
             payload["tpu"] = engine
         _json(w, payload)
 
+    def cache_page(req, w) -> None:
+        """Prefix-KV-cache introspection: per-tier entries/bytes/hits/
+        misses/evictions and the aggregate hit ratio (the TTFT lever —
+        every hit replaces a prefill dispatch with a row copy)."""
+        tpu = app.container.tpu
+        gen = getattr(tpu, "generator", None) if tpu is not None else None
+        stats = gen.kvcache_stats() if gen is not None else None
+        payload = {"enabled": stats is not None, "cache": stats}
+        if req.param("format") == "json" or stats is None:
+            return _json(w, payload)
+        tiers = stats.get("tiers", {})
+        cols = ("entries", "hits", "misses", "evictions", "bytes",
+                "blocks_put", "blocks_got", "errors")
+        rows = "".join(
+            "<tr><td>{t}</td>{cells}</tr>".format(
+                t=html.escape(t),
+                cells="".join(f"<td>{html.escape(str(d.get(c, '-')))}</td>"
+                              for c in cols))
+            for t, d in tiers.items())
+        ratio = stats.get("hit_ratio")
+        _html(w, "prefix kv cache", (
+            "<h2>prefix KV cache ({kind})</h2>"
+            "<p>entries={entries} hits={hits} misses={misses} "
+            "hit_ratio={ratio}</p>"
+            "<table><tr><th>tier</th>{heads}</tr>{rows}</table>"
+            '<p><a href="/debug/cache?format=json">json</a></p>').format(
+                kind=html.escape(str(stats.get("kind", "?"))),
+                entries=stats.get("entries"), hits=stats.get("hits"),
+                misses=stats.get("misses"),
+                ratio="-" if ratio is None else f"{ratio:.3f}",
+                heads="".join(f"<th>{c}</th>" for c in cols), rows=rows))
+
     def profile_page(req, w) -> None:
         try:
             seconds = float(req.param("seconds", "1"))
@@ -225,4 +259,5 @@ def install_debug_routes(router, app) -> None:
     router.add("GET", "/debug/requests", requests_page)
     router.add("GET", "/debug/events", events_page)
     router.add("GET", "/debug/vars", vars_page)
+    router.add("GET", "/debug/cache", cache_page)
     router.add("GET", "/debug/pprof/profile", profile_page)

@@ -133,7 +133,10 @@ class FakeRedisServer:
         s = self.store
         s.stats["total_commands_processed"] += 1
         cmd = args[0].decode().upper()
-        a = [x.decode() for x in args[1:]]
+        # surrogateescape: VALUES may be arbitrary binary (KV cache
+        # frames) — the text view must never throw; commands that care
+        # about bytes read from ``raw`` anyway
+        a = [x.decode("utf-8", "surrogateescape") for x in args[1:]]
         with s.lock:
             try:
                 return self._run(cmd, a, args[1:])
@@ -164,6 +167,12 @@ class FakeRedisServer:
             if v is not None and not isinstance(v, bytes):
                 raise RedisFakeError("WRONGTYPE")
             return self._bulk(v)
+        if cmd == "MGET":
+            vals = []
+            for k in a:
+                v = s.get(k)
+                vals.append(v if isinstance(v, bytes) else None)
+            return self._array(vals)
         if cmd == "DEL":
             n = sum(1 for k in a if s.data.pop(k, None) is not None)
             return self._int(n)

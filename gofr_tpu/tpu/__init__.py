@@ -32,9 +32,29 @@ Config keys (reference config style, pkg/gofr/config/config.go:3):
                       queueing behind it on the device stream)
   TPU_PREFIX_CACHE    prefix-KV pool rows (default 0 = off): stored
                       prompt prefixes restore as one HBM row copy
-                      instead of prefill compute (tpu/prefix_cache.py)
+                      instead of prefill compute. The pool is the T0
+                      tier of the hierarchical kv cache (tpu/kvcache/,
+                      docs/advanced-guide/kv-cache.md); the radix
+                      index, host-DRAM offload and Redis-shared tiers
+                      are tuned by the TPU_KVCACHE_* keys below
   TPU_PREFIX_MIN      min prompt length stored in the pool (default:
                       the largest prompt bucket)
+  TPU_KVCACHE_BLOCK   radix/content-hash block size in tokens
+                      (default 16); also the Redis tier's sharing
+                      granularity
+  TPU_KVCACHE_HOST_MB host-DRAM offload tier budget in MiB (default 0
+                      = off): LRU-evicted pool rows spill to host
+                      numpy and restore via device_put on hit —
+                      cache capacity beyond HBM, survives device loss
+  TPU_KVCACHE_REDIS   "true" shares quantized int8 KV blocks through
+                      the framework Redis client (REDIS_HOST/PORT) so
+                      replicas warm each other (default off)
+  TPU_KVCACHE_REDIS_TTL_S      shared-block TTL seconds (default 300)
+  TPU_KVCACHE_REDIS_TIMEOUT_S  socket timeout for the tier's dedicated
+                      client (default 0.25 — fail open fast; the
+                      serving loop must never stall on Redis)
+  TPU_KVCACHE_EPOCH_REFRESH_S  staleness bound on the adapter-epoch
+                      invalidation key (default 5)
   TPU_SPEC_DECODE     prompt-lookup speculative decoding: K draft
                       tokens per verify pass (default 0 = off). One
                       weight stream emits 1..K+1 tokens per greedy slot
@@ -182,6 +202,17 @@ def new_engine_from_config(cfg, logger=None, metrics=None,
         kv_choice = (cfg.get("TPU_KV_DTYPE") or "int8").lower()
         kv_dtype = jnp.int8 if kv_choice == "int8" else None
         prompt_b = tuple(b for b in seq_buckets if b < max_seq) or (max_seq // 2,)
+        kv_opts = None
+        if cfg.get_int("TPU_PREFIX_CACHE", 0) > 0 \
+                and cfg.get_int("TPU_PAGED_BLOCKS", 0) == 0 \
+                and mesh is None:
+            # paged engines keep their zero-copy SharedPrefixIndex and
+            # mesh engines run T0-only — don't open a Redis connection
+            # the engine would immediately discard
+            from .kvcache import options_from_config
+
+            kv_opts = options_from_config(cfg, logger=logger,
+                                          metrics=metrics)
         engine.generator = GenerationEngine(
             mc, params, slots=slots, max_seq=max_seq, prompt_buckets=prompt_b,
             logger=logger, metrics=metrics, observe=observe, mesh=mesh,
@@ -192,6 +223,7 @@ def new_engine_from_config(cfg, logger=None, metrics=None,
             admit_window_ms=cfg.get_float("TPU_ADMIT_WINDOW_MS", 2.0),
             prefix_cache_slots=cfg.get_int("TPU_PREFIX_CACHE", 0),
             prefix_store_min=cfg.get_int("TPU_PREFIX_MIN", 0) or None,
+            kvcache=kv_opts,
             spec_decode_k=cfg.get_int("TPU_SPEC_DECODE", 0),
             lora_adapters=cfg.get_int("TPU_LORA_ADAPTERS", 0),
             lora_rank=cfg.get_int("TPU_LORA_RANK", 16),

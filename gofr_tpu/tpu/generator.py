@@ -27,6 +27,7 @@ stream; one background thread owns the device loop.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import itertools
 import os
@@ -46,6 +47,7 @@ from ..models.common import ModelConfig
 from ..resilience import current_deadline
 from ..wire import PushStream
 from .batcher import pad_bucket
+from .kvcache import HostKV, clamp_restore_len
 
 _REQ_IDS = itertools.count(1)
 
@@ -66,6 +68,24 @@ def _copy_row(dst, src, dst_idx, src_idx):
         k=cp(dst.k, src.k), v=cp(dst.v, src.v),
         k_scale=cp(dst.k_scale, src.k_scale) if quant else None,
         v_scale=cp(dst.v_scale, src.v_scale) if quant else None)
+
+
+def _write_row_from_host(pool, k, v, ks, vs, row):
+    """Land a host KV slab in pool row ``row`` — the device half of a
+    T1/T2 restore (kvcache promotion). ``k``/``v`` arrive padded to
+    [L, 1, Smax, KV, hd] (scales [L, 1, Smax, KV]) so the program
+    compiles once; positions past the entry's length are zeros that the
+    resumed prefill overwrites or the cursor masks."""
+    import jax.lax as lax
+
+    def wr(dst, src):
+        return lax.dynamic_update_slice_in_dim(dst, src, row, axis=1)
+
+    quant = pool.k_scale is not None
+    return pool._replace(
+        k=wr(pool.k, k), v=wr(pool.v, v),
+        k_scale=wr(pool.k_scale, ks) if quant else None,
+        v_scale=wr(pool.v_scale, vs) if quant else None)
 
 
 def _copy_row_masked(dst, src, dst_idx, src_idx):
@@ -147,7 +167,7 @@ class GenStream(PushStream):
 class _Request:
     __slots__ = ("stream", "prompt", "max_new", "temperature", "top_k",
                  "eos_id", "adapter", "enqueued_at", "lattice_peek",
-                 "deadline")
+                 "kv_match", "deadline")
 
     @property
     def logprobs(self) -> bool:
@@ -165,6 +185,9 @@ class _Request:
         self.adapter = adapter
         self.enqueued_at = time.monotonic()
         self.lattice_peek: tuple[int, bool] | None = None
+        # memoized CacheManager.match verdict, keyed by the manager's
+        # version counter (see GenerationEngine._kv_match)
+        self.kv_match: tuple[int, Any] | None = None
         # resilience.Deadline: expired requests are dropped at admission
         # (no prefill dispatch for a caller that already gave up)
         self.deadline = deadline
@@ -205,6 +228,7 @@ class GenerationEngine:
                  admit_window_ms: float = 2.0,
                  prefix_cache_slots: int = 0,
                  prefix_store_min: int | None = None,
+                 kvcache=None,
                  spec_decode_k: int = 0,
                  lora_adapters: int = 0, lora_rank: int = 16,
                  paged_blocks: int = 0, paged_block_size: int = 128):
@@ -355,28 +379,76 @@ class GenerationEngine:
         self._last_dev = None
         self._host_wins = np.ones((slots,), bool)
 
-        # Prefix KV cache (tpu/prefix_cache.py): a P-row pool of stored
-        # prompt-prefix KV. A hit replaces MXU prefill work for the
-        # matched positions with one HBM row copy; the remainder (always
-        # >= 1 token, so the first sample recomputes) prefills from the
-        # match point. On mesh engines the pool shards like the serving
-        # cache and the row copies run mask-and-reduce (_copy_row_masked)
-        # instead of traced-index dynamic slices, which GSPMD could only
-        # lower by replicating the cache; the jits are built after the
-        # mesh block below, where the shardings exist. (Paged engines
-        # built their zero-copy SharedPrefixIndex above instead — no
-        # side pool, the entries reference pool blocks directly.)
+        # Hierarchical prefix KV cache (tpu/kvcache/): a P-row HBM pool
+        # (T0) indexed by a block-hash radix tree, spilling LRU-evicted
+        # rows into host DRAM (T1) and sharing int8 blocks through the
+        # framework Redis client (T2), behind one CacheManager facade.
+        # A hit replaces MXU prefill work for the matched positions
+        # with one HBM row copy (T0) or a host->device upload + row
+        # copy (T1/T2 promotion); the remainder (always >= 1 token, so
+        # the first sample recomputes) prefills from the match point.
+        # On mesh engines the pool shards like the serving cache and
+        # the row copies run mask-and-reduce (_copy_row_masked) instead
+        # of traced-index dynamic slices, which GSPMD could only lower
+        # by replicating the cache; the jits are built after the mesh
+        # block below, where the shardings exist. The OFFLOAD tiers are
+        # single-device only: their promote path is a traced-row
+        # dynamic_update_slice with the same GSPMD problem, so a mesh
+        # engine keeps the radix-indexed T0 and logs the downgrade.
+        # (Paged engines built their zero-copy SharedPrefixIndex above
+        # instead — no side pool, entries reference pool blocks.)
         self._pool = None
+        self._kvc = None
+        self._host_write_jit = None
         if not self._paged:
             self._prefix_idx = None
             if prefix_cache_slots > 0:
-                from .prefix_cache import PrefixIndex
+                from .kvcache import (CacheManager, KVCacheOptions,
+                                      KVLayout, model_fingerprint)
 
-                self._prefix_idx = PrefixIndex(prefix_cache_slots)
+                opts = kvcache or KVCacheOptions()
+                if mesh is not None and (opts.host_mb > 0
+                                         or opts.redis is not None):
+                    if logger is not None:
+                        logger.warn({"event": "kvcache offload tiers "
+                                     "disabled on mesh engine (T0 radix "
+                                     "index stays on)"})
+                    if opts.redis is not None:
+                        try:  # don't leak the discarded connection
+                            opts.redis.close()
+                        except Exception:
+                            pass
+                    opts = dataclasses.replace(opts, host_mb=0, redis=None)
                 self._pool = llama.init_cache(cfg, prefix_cache_slots,
                                               self.max_seq, dtype=kv_dtype)
+                layout = KVLayout(cfg.n_layers, cfg.n_kv_heads,
+                                  cfg.head_dim, self._pool.quantized,
+                                  np.dtype(self._pool.k.dtype),
+                                  self.max_seq)
+                self._kvc = CacheManager(
+                    prefix_cache_slots, layout, block=opts.block,
+                    host_bytes=opts.host_mb << 20, redis=opts.redis,
+                    redis_ttl_s=opts.redis_ttl_s,
+                    epoch_refresh_s=opts.epoch_refresh_s,
+                    fingerprint=model_fingerprint(
+                        cfg, params, extra=str(layout.np_dtype)),
+                    metrics=metrics, logger=logger)
                 self._store_min = int(prefix_store_min
                                       or self.prompt_buckets[-1])
+        if (self._kvc is None and kvcache is not None
+                and kvcache.redis is not None):
+            # KVCacheOptions promises the engine owns the client; a
+            # paged or prefix_cache_slots=0 engine never builds the
+            # manager, so honor the contract here instead of leaking
+            # the socket for the process lifetime
+            if logger is not None:
+                logger.warn({"event": "kvcache redis client discarded "
+                             "(engine has no prefix cache: paged or "
+                             "prefix_cache_slots=0)"})
+            try:
+                kvcache.redis.close()
+            except Exception:
+                pass
 
         # Prompt-lookup speculative decoding (greedy slots only): each
         # tick proposes K draft tokens per slot by matching the trailing
@@ -452,7 +524,7 @@ class GenerationEngine:
                                             donate_argnums=(0,),
                                             out_shardings=(rep, rep, rep,
                                                            cache_sh))
-            if self._prefix_idx is not None:
+            if self._kvc is not None:
                 # pool shards like the serving cache (batch rows over the
                 # data axes when they divide, KV heads over tp); pinning
                 # out_shardings keeps donation aliasing across copies
@@ -504,9 +576,12 @@ class GenerationEngine:
             self._chunk_mid_jit = jax.jit(self._chunk_mid, donate_argnums=(0,))
             self._chunk_final_jit = jax.jit(self._chunk_final,
                                             donate_argnums=(0,))
-            if self._prefix_idx is not None:
+            if self._kvc is not None:
                 self._pool_load_jit = jax.jit(_copy_row, donate_argnums=(0,))
                 self._pool_store_jit = jax.jit(_copy_row, donate_argnums=(0,))
+                if self._kvc.wants_offload or self._kvc.shares:
+                    self._host_write_jit = jax.jit(_write_row_from_host,
+                                                   donate_argnums=(0,))
             if self._spec_k:
                 self._verify_jit = jax.jit(self._verify_fn,
                                            donate_argnums=(0,))
@@ -901,7 +976,9 @@ class GenerationEngine:
         }
         if self.gate is not None:
             out["admission"] = self.gate.stats()
-        if self._prefix_idx is not None:
+        if self._kvc is not None:
+            out["prefix_cache"] = self._kvc.stats()
+        elif self._prefix_idx is not None:
             out["prefix_cache"] = self._prefix_idx.stats()
         if self._paged:
             n_usable = self._alloc.n_blocks - 1
@@ -951,7 +1028,7 @@ class GenerationEngine:
                 paged_chunks = self._paged and hasattr(self, "_scratch")
                 chunked_reachable = (not self._paged
                                      and (self.max_seq - 1 > C
-                                          or self._prefix_idx is not None))
+                                          or self._kvc is not None))
                 for b in self.prompt_buckets:
                     toks = jnp.zeros((1, b), jnp.int32)
                     if paged_chunks:
@@ -1016,6 +1093,18 @@ class GenerationEngine:
             elif self.logger is not None:
                 self.logger.debug({"event": "generator warmup skipped prefill",
                                    "reason": "no free slot"})
+            if self._host_write_jit is not None:
+                # warm the T1/T2 promote program with an IDENTITY
+                # rewrite of pool row 0 (a zero-filled dummy would
+                # corrupt a live entry's stored KV)
+                kv = self._kv_row_get(self._pool, 0, self.max_seq)
+                quant = self._pool.quantized
+                self._pool = jax.block_until_ready(self._host_write_jit(
+                    self._pool, jnp.asarray(kv.k[:, None]),
+                    jnp.asarray(kv.v[:, None]),
+                    jnp.asarray(kv.k_scale[:, None]) if quant else None,
+                    jnp.asarray(kv.v_scale[:, None]) if quant else None,
+                    jnp.int32(0)))
             if self._paged:
                 # ZEROED table, not the live one: an active slot whose
                 # cursor sits at an unallocated block boundary would have
@@ -1083,6 +1172,15 @@ class GenerationEngine:
             # restore cursors dirtied by the dummy dispatches
             self.cache = self.cache._replace(lengths=jnp.asarray(cursors))
 
+    def kvcache_stats(self) -> dict | None:
+        """Tiered prefix-cache stats for /debug/cache; None when no
+        prefix cache is configured."""
+        if self._kvc is not None:
+            return {"kind": "hierarchical", **self._kvc.stats()}
+        if self._prefix_idx is not None:
+            return {"kind": "paged-shared", **self._prefix_idx.stats()}
+        return None
+
     def load_adapter(self, idx: int, tree: dict) -> None:
         """Install adapter weights into slot ``idx``: ``tree`` maps a
         projection name ('wq'/'wk'/'wv'/'wo') to its (A [L, in, r],
@@ -1115,8 +1213,13 @@ class GenerationEngine:
                 # reuse). Invalidating inside the device lock, AFTER the
                 # swap, serializes against the loop's match/store: no
                 # old-weight entry can be stored after we invalidate,
-                # and PrefixIndex is only ever mutated under this lock.
+                # and the index is only ever mutated under this lock.
                 self._prefix_idx.invalidate_adapter(idx)
+            if self._kvc is not None:
+                # ALL tiers (same hazard as above): T0/T1 drop locally;
+                # T2 bumps the adapter's Redis epoch, which renames the
+                # shared namespace for every replica at once
+                self._kvc.invalidate_adapter(idx)
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Graceful shutdown, phase 1: refuse NEW requests (generate()
@@ -1144,6 +1247,11 @@ class GenerationEngine:
             self._closed = True
         self._work.set()
         self._thread.join(timeout=10.0)
+        if self._kvc is not None and self._kvc.redis is not None:
+            try:  # the engine owns the T2 client (KVCacheOptions.redis)
+                self._kvc.redis.client.close()
+            except Exception:
+                pass
         for slot in self._slots:
             if slot.request is not None:
                 slot.request.stream._q.put(GenerationError("engine closed"))
@@ -1277,6 +1385,21 @@ class GenerationEngine:
         L = len(req.prompt)
         if L > self.prompt_buckets[-1]:
             return True
+        if not self._paged and self._kvc is not None:
+            # contiguous engines: a usable tier hit ALSO resumes the
+            # chunk lattice mid-prompt, so in-flight admission must
+            # defer it exactly like the paged path (starting the
+            # lattice under an un-reaped outer block double-decodes
+            # active slots). The memoized _kv_match keeps the verdict
+            # consistent with the real admission's — a T2 consult does
+            # network I/O, and peeking a DIFFERENT answer than the
+            # restore would re-open the hazard this guard closes.
+            mt = self._kv_match(req)
+            if mt is None:
+                return False
+            m_eff = clamp_restore_len(mt.matched_len, L)
+            return (m_eff >= self.prompt_buckets[0]
+                    and self._lattice_resume_valid(L, m_eff))
         if self._paged and self._prefix_idx is not None:
             ver = self._prefix_idx.version
             if req.lattice_peek is not None and req.lattice_peek[0] == ver:
@@ -1530,31 +1653,127 @@ class GenerationEngine:
                 continue
             self._write_table_row(idx)
 
+    def _kv_match(self, req: _Request, prompt: np.ndarray | None = None):
+        """Request-memoized ``CacheManager.match``, keyed by the
+        manager's version counter. The in-flight admission peek
+        (_needs_lattice) and the real admission must see ONE verdict —
+        a disagreement would start a chunk lattice inside an in-flight
+        admission — and a T2 consult does network I/O the ~2 ms peek
+        poll must not repeat. Only the serving-loop thread calls this,
+        and it cannot store between peek and admit, so a memo keyed by
+        version is exact."""
+        ver = self._kvc.version
+        if req.kv_match is not None and req.kv_match[0] == ver:
+            return req.kv_match[1]
+        if prompt is None:
+            prompt = np.asarray(req.prompt, np.int32)
+        mt = self._kvc.match(prompt, req.adapter)
+        req.kv_match = (ver, mt)
+        return mt
+
+    def _kv_row_get(self, store, row: int, plen: int) -> HostKV:
+        """Fetch the first ``plen`` positions of one pool/cache row to
+        host numpy — the spill half of T1 offload and the read half of
+        T2 write-through. Single-device only (on a mesh this would
+        gather the sharded row; offload tiers are gated off there)."""
+        quant = store.k_scale is not None
+        return HostKV(
+            np.asarray(store.k[:, row, :plen]),
+            np.asarray(store.v[:, row, :plen]),
+            np.asarray(store.k_scale[:, row, :plen]) if quant else None,
+            np.asarray(store.v_scale[:, row, :plen]) if quant else None)
+
+    def _offload_victim(self, victim) -> None:
+        """Spill a T0-evicted entry's pool row to the host tier. MUST
+        run before the dispatch that overwrites the row (store/promote
+        call it between claiming the row and copying into it)."""
+        if victim is None or not self._kvc.wants_offload:
+            return
+        plen = min(len(victim.key), self.max_seq)
+        self._kvc.offload(victim, self._kv_row_get(self._pool,
+                                                   victim.row, plen))
+
+    def _promote_hostkv(self, mt) -> int | None:
+        """Land a T1/T2 match's host KV in a T0 pool row (device_put +
+        one compiled row write) and register it under the entry's full
+        key — the next hit on this prefix is a T0 row copy. Returns the
+        row, or None when the payload cannot serve this engine (shape/
+        quantization drift: treat as a miss, never an error)."""
+        kv = mt.hostkv
+        quant = self._pool.quantized
+        if (kv is None or kv.plen > self.max_seq or len(mt.key) < kv.plen
+                or (quant and kv.k_scale is None)
+                or kv.k.shape[0] != self._pool.k.shape[0]
+                or kv.k.shape[2:] != self._pool.k.shape[3:]):
+            return None
+        row, victim = self._kvc.store(mt.key[:kv.plen], mt.adapter)
+        self._offload_victim(victim)
+
+        def pad(a, like):
+            out = np.zeros((a.shape[0], 1, self.max_seq) + a.shape[2:],
+                           like.dtype)
+            out[:, 0, :kv.plen] = a
+            return jnp.asarray(out)
+
+        self._pool = self._host_write_jit(
+            self._pool, pad(kv.k, self._pool.k), pad(kv.v, self._pool.v),
+            pad(kv.k_scale, self._pool.k_scale) if quant else None,
+            pad(kv.v_scale, self._pool.v_scale) if quant else None,
+            jnp.int32(row))
+        return row
+
     def _prefix_restore(self, idx: int, req: _Request, L: int,
                         C: int) -> int:
-        """Consult the prefix pool; on a useful hit copy the stored row
-        into slot ``idx`` and return the position prefill resumes from
-        (0 = no hit). The returned position keeps every later dispatch on
-        the compiled lattice: chunk STARTS are traced values, only chunk
-        LENGTHS are compile keys, so resuming mid-prompt compiles
-        nothing new. At least one prompt position is always recomputed —
-        the final chunk ends at the prompt end and samples there."""
-        if self._prefix_idx is None:
+        """Consult the cache hierarchy; on a useful hit land the prefix
+        KV in slot ``idx`` and return the position prefill resumes from
+        (0 = no hit). T0 hits are one pool-row copy; T1/T2 hits promote
+        through a pool row first (_promote_hostkv). The returned
+        position keeps every later dispatch on the compiled lattice:
+        chunk STARTS are traced values, only chunk LENGTHS are compile
+        keys, so resuming mid-prompt compiles nothing new. At least one
+        prompt position is always recomputed — the final chunk ends at
+        the prompt end and samples there."""
+        if self._kvc is None:
             return 0
         prompt = np.asarray(req.prompt, np.int32)
-        row, m = self._prefix_idx.match(prompt, req.adapter)
-        m_eff = min(int(m), L - 1)
-        if (row < 0
-                # matched less than the smallest bucket: the copy would
-                # not remove a single dispatch's worth of work
-                or m_eff < self.prompt_buckets[0]
-                # the final chunk needs [L - Sb, L) to be a valid window
-                or not self._lattice_resume_valid(L, m_eff)):
-            self._prefix_idx.reject()
+        t_start = time.monotonic()
+        mt = self._kv_match(req, prompt)
+        # the memo's job (one verdict for peek AND restore) is done the
+        # moment the restore reads it — drop it now, or a T2 match's
+        # decoded HostKV (tens of MB at real model dims) stays pinned
+        # on the request for the stream's whole lifetime
+        req.kv_match = None
+        if mt is None:
+            self._kvc.reject(prompt=prompt)
             return 0
+        # Full-prompt-hit clamp: match() may cover the ENTIRE prompt
+        # (exact repeat); restore at most L-1 positions so the final
+        # chunk prefills >= 1 token — the dispatch needs logits at the
+        # prompt end to sample the first generated token (the pool
+        # stores KV, not logits).
+        m_eff = clamp_restore_len(mt.matched_len, L)
+        assert m_eff < L, "kvcache restore clamp violated"
+        if (m_eff < self.prompt_buckets[0]
+                # matched less than the smallest bucket: the copy would
+                # not remove a single dispatch's worth of work; and the
+                # final chunk needs [L - Sb, L) to be a valid window
+                or not self._lattice_resume_valid(L, m_eff)):
+            self._kvc.reject(mt)
+            return 0
+        if mt.tier == "t0":
+            row = mt.row
+        else:
+            row = self._promote_hostkv(mt)
+            if row is None:
+                self._kvc.reject(mt)
+                return 0
         self.cache = self._pool_load_jit(self.cache, self._pool,
                                          jnp.int32(idx), jnp.int32(row))
-        self._prefix_idx.accept(row)
+        restore_s = time.monotonic() - t_start
+        self._kvc.accept(mt, restore_s)
+        self._obs_span("tpu.prefix-restore", t_start, t_start + restore_s,
+                       req.stream, {"tier": mt.tier, "tokens": m_eff,
+                                    "slot": idx})
         if self.metrics is not None:
             self.metrics.increment_counter(
                 "app_tpu_prefix_cache_hits_total")
@@ -1562,16 +1781,19 @@ class GenerationEngine:
 
     def _prefix_store(self, idx: int, req: _Request) -> None:
         """After a completed admission, remember this prompt's KV row
-        (LRU pool; skipped for short prompts and already-covered ones).
-        Must run BEFORE the slot's first decode tick — decode writes
-        position L into the same row."""
-        if self._prefix_idx is None or req.stream.cancelled.is_set():
+        (skipped for short prompts and already-covered ones). Must run
+        BEFORE the slot's first decode tick — decode writes position L
+        into the same row. A T0 victim spills its row to the host tier
+        before being overwritten; with the Redis tier on, the fresh
+        KV's full blocks write through so sibling replicas skip the
+        prefill too."""
+        if req.stream.cancelled.is_set():
             return
         prompt = np.asarray(req.prompt, np.int32)
-        if len(prompt) < self._store_min or \
-                self._prefix_idx.covered(prompt, req.adapter):
-            return
         if self._paged:
+            if self._prefix_idx is None or len(prompt) < self._store_min \
+                    or self._prefix_idx.covered(prompt, req.adapter):
+                return
             # zero-copy: reference the slot's full prompt blocks as a
             # SharedPrefixIndex entry — they are immutable from here on
             # (decode only writes the cursor's block). _start calls this
@@ -1580,9 +1802,24 @@ class GenerationEngine:
             self._prefix_idx.store(prompt, self._slot_blocks[idx],
                                    req.adapter)
             return
-        row = self._prefix_idx.store_row(prompt, req.adapter)
+        if self._kvc is None or len(prompt) < self._store_min \
+                or self._kvc.covered(prompt, req.adapter):
+            return
+        row, victim = self._kvc.store(prompt, req.adapter)
+        self._offload_victim(victim)
         self._pool = self._pool_store_jit(self._pool, self.cache,
                                           jnp.int32(row), jnp.int32(idx))
+        if self._kvc.shares:
+            # write-through: a device_get of the slot's fresh KV is the
+            # price of warming every replica — but only through the
+            # last full block this replica hasn't already shared (an
+            # already-written prefix costs no transfer; the trailing
+            # partial block has no chain hash and never transfers)
+            want = self._kvc.redis.pending_put_len(prompt, req.adapter)
+            if want > 0:
+                self._kvc.store_shared(prompt, req.adapter,
+                                       self._kv_row_get(self.cache, idx,
+                                                        want))
 
     def _count_expired(self) -> None:
         if self.metrics is not None:
@@ -1853,11 +2090,17 @@ class GenerationEngine:
                     self._host_wins[:] = True
                     self._recoveries += 1
                     if self._prefix_idx is not None:
-                        # pool-branch entries would match prompts against
-                        # the fresh zeroed rows; paged entries reference
-                        # blocks of the OLD pool and would restore
-                        # all-zero KV on a hit
+                        # paged entries reference blocks of the OLD
+                        # pool and would restore all-zero KV on a hit
                         self._prefix_idx.clear()
+                    if self._kvc is not None:
+                        # tiered recovery: T0 entries die with the pool
+                        # (they'd match prompts against fresh zeroed
+                        # rows), but T1 host snapshots and T2 shared
+                        # blocks are device-independent and SURVIVE —
+                        # the next admission rewarns the new pool from
+                        # them instead of paying a full prefill
+                        self._kvc.clear_device()
                 for idx, slot in enumerate(self._slots):
                     if slot.request is not None:
                         slot.request.stream.failed = repr(e)
@@ -1880,7 +2123,7 @@ class GenerationEngine:
                             # _pool_store_jit donates the pool buffer —
                             # a failed store leaves it consumed/poisoned
                             pool = llama.init_cache(
-                                self.cfg, self._prefix_idx.slots,
+                                self.cfg, self._kvc.slots,
                                 self.max_seq, dtype=self._kv_dtype)
                             if self._pool_sh is not None:
                                 pool = jax.device_put(pool, self._pool_sh)

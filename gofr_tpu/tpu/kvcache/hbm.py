@@ -1,0 +1,86 @@
+"""T0: the HBM tier — radix-indexed bookkeeping for the device pool.
+
+Same division of labor as the flat PrefixIndex it supersedes: the
+ENGINE owns the device pool rows and every jitted copy; this class is
+the host-side map from token prefixes to rows, now behind the
+block-hash radix tree instead of an O(rows x len) scan. A hit costs
+one HBM row copy on device; entries are LRU-evicted on store, and the
+evicted entry is handed BACK to the caller so the engine can spill the
+row's KV to the host tier before the pool row is overwritten.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .radix import Entry, RadixIndex
+
+
+class HBMTier:
+    tier = "t0"
+
+    def __init__(self, slots: int, block: int = 16):
+        self.slots = int(slots)
+        self.index = RadixIndex(block)
+        self._rows: list[Entry | None] = [None] * self.slots
+        self._tick = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return sum(1 for e in self._rows if e is not None)
+
+    def match(self, prompt: np.ndarray, adapter: int = 0
+              ) -> tuple[Entry | None, int]:
+        """PURE longest-prefix lookup (see RadixIndex.match); the
+        manager reports usability via touch() on accept."""
+        return self.index.match(prompt, adapter)
+
+    def touch(self, entry: Entry) -> None:
+        self._tick += 1
+        entry.tick = self._tick
+
+    def covered(self, prompt: np.ndarray, adapter: int = 0) -> bool:
+        """True when a stored entry already contains ``prompt`` as a
+        prefix — storing it again would only duplicate a row."""
+        _, m = self.index.match(prompt, adapter)
+        return m >= len(prompt)
+
+    def store(self, key: np.ndarray, adapter: int = 0
+              ) -> tuple[int, Entry | None]:
+        """Claim a row for a new entry: a free row, else the LRU
+        victim's. Returns (row, victim) with the victim ALREADY
+        unindexed but its key/payload intact — the caller must read the
+        victim's pool row (for host-tier spill) BEFORE dispatching the
+        store that overwrites it."""
+        victim = None
+        row = next((i for i, e in enumerate(self._rows) if e is None), None)
+        if row is None:
+            row = min(range(self.slots), key=lambda i: self._rows[i].tick)
+            victim = self._rows[row]
+            self.index.remove(victim)
+            self.evictions += 1
+        entry = Entry(key, adapter, payload=row)
+        self.index.insert(entry)
+        self._rows[row] = entry
+        self.touch(entry)
+        return row, victim
+
+    def clear(self) -> int:
+        """Drop every entry — engine recovery calls this after
+        reallocating the side pool (stored keys would otherwise match
+        prompts against zeroed rows and restore all-zero KV)."""
+        n = len(self)
+        self.index.clear()
+        self._rows = [None] * self.slots
+        return n
+
+    def invalidate_adapter(self, adapter: int) -> int:
+        n = self.index.invalidate_adapter(adapter)
+        for i, e in enumerate(self._rows):
+            if e is not None and e.adapter == int(adapter):
+                self._rows[i] = None
+        return n
+
+    def stats(self) -> dict:
+        return {"slots": self.slots, "entries": len(self),
+                "evictions": self.evictions}

@@ -1,0 +1,139 @@
+"""Host-side KV snapshots and the int8 block codec for the Redis tier.
+
+``HostKV`` is the host half of a cache row: contiguous numpy arrays in
+the engine's cache-native layout ([L, plen, KV, hd] values, [L, plen,
+KV] scale planes when the cache is int8-quantized). The T1 host tier
+stores them verbatim — a T1 round trip is bit-exact by construction.
+
+The T2 Redis tier serializes per BLOCK (the radix block size) so
+replicas can share partial prefixes: each payload is a self-describing
+frame of int8 values + float32 per-vector scales + a truncated sha256
+checksum. int8-cache engines store their native planes (lossless round
+trip); fp-cache engines quantize on write with the same per-vector
+max-abs scheme the serving cache uses (ops.quant) and dequantize on
+read — a documented precision trade for cross-replica reuse.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import NamedTuple
+
+import numpy as np
+
+_MAGIC = b"GKV1"
+# magic, version, flags, L, T, KV, hd
+_HEADER = struct.Struct("<4sBBHHHH")
+_DIGEST_LEN = 16
+FLAG_INT8_SRC = 1  # payload came off an int8 cache (round trip exact)
+
+
+class KVLayout(NamedTuple):
+    """The engine-side shape contract a decoded block must satisfy
+    before its bytes are allowed anywhere near a pool row."""
+
+    layers: int
+    kv_heads: int
+    head_dim: int
+    quantized: bool        # serving cache is int8 + scale planes
+    np_dtype: np.dtype     # cache value dtype (int8 / float32 / ...)
+    max_seq: int
+
+
+class HostKV(NamedTuple):
+    k: np.ndarray                  # [L, plen, KV, hd] cache-native dtype
+    v: np.ndarray
+    k_scale: np.ndarray | None     # [L, plen, KV] f32 (int8 caches)
+    v_scale: np.ndarray | None
+
+    @property
+    def plen(self) -> int:
+        return int(self.k.shape[1])
+
+    @property
+    def nbytes(self) -> int:
+        n = self.k.nbytes + self.v.nbytes
+        if self.k_scale is not None:
+            n += self.k_scale.nbytes + self.v_scale.nbytes
+        return n
+
+    def slice_tokens(self, start: int, stop: int) -> "HostKV":
+        return HostKV(
+            self.k[:, start:stop], self.v[:, start:stop],
+            self.k_scale[:, start:stop] if self.k_scale is not None else None,
+            self.v_scale[:, start:stop] if self.v_scale is not None else None)
+
+
+def _quantize(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Per-vector max-abs int8: scale [..., KV] over the head dim."""
+    x32 = np.asarray(x, np.float32)
+    scale = np.max(np.abs(x32), axis=-1) / 127.0
+    scale = np.maximum(scale, 1e-12)
+    i8 = np.clip(np.rint(x32 / scale[..., None]), -127, 127).astype(np.int8)
+    return i8, scale.astype(np.float32)
+
+
+def encode_block(kv: HostKV) -> bytes:
+    """One radix block's KV -> a checksummed wire frame."""
+    L, T, KV, hd = kv.k.shape
+    if kv.k.dtype == np.int8:
+        flags = FLAG_INT8_SRC
+        k8, v8 = np.ascontiguousarray(kv.k), np.ascontiguousarray(kv.v)
+        ks = np.ascontiguousarray(kv.k_scale, dtype=np.float32)
+        vs = np.ascontiguousarray(kv.v_scale, dtype=np.float32)
+    else:
+        flags = 0
+        k8, ks = _quantize(kv.k)
+        v8, vs = _quantize(kv.v)
+    body = _HEADER.pack(_MAGIC, 1, flags, L, T, KV, hd) \
+        + k8.tobytes() + v8.tobytes() + ks.tobytes() + vs.tobytes()
+    return body + hashlib.sha256(body).digest()[:_DIGEST_LEN]
+
+
+def decode_block(data: bytes, layout: KVLayout) -> HostKV | None:
+    """Wire frame -> HostKV in the layout's cache-native dtype, or None
+    for anything malformed: wrong magic/version, shape not matching
+    this engine's layout, bad checksum, truncated payload. A None is a
+    cache miss, never an error — shared-tier bytes are untrusted input."""
+    if data is None or len(data) < _HEADER.size + _DIGEST_LEN:
+        return None
+    body, digest = data[:-_DIGEST_LEN], data[-_DIGEST_LEN:]
+    if hashlib.sha256(body).digest()[:_DIGEST_LEN] != digest:
+        return None
+    magic, version, flags, L, T, KV, hd = _HEADER.unpack_from(body)
+    if magic != _MAGIC or version != 1:
+        return None
+    if (L, KV, hd) != (layout.layers, layout.kv_heads, layout.head_dim) \
+            or T <= 0:
+        return None
+    nval = L * T * KV * hd
+    nsc = L * T * KV
+    want = _HEADER.size + 2 * nval + 2 * nsc * 4
+    if len(body) != want:
+        return None
+    off = _HEADER.size
+    k8 = np.frombuffer(body, np.int8, nval, off).reshape(L, T, KV, hd)
+    off += nval
+    v8 = np.frombuffer(body, np.int8, nval, off).reshape(L, T, KV, hd)
+    off += nval
+    ks = np.frombuffer(body, np.float32, nsc, off).reshape(L, T, KV)
+    off += nsc * 4
+    vs = np.frombuffer(body, np.float32, nsc, off).reshape(L, T, KV)
+    if layout.quantized:
+        return HostKV(k8.copy(), v8.copy(), ks.copy(), vs.copy())
+    k = (k8.astype(np.float32) * ks[..., None]).astype(layout.np_dtype)
+    v = (v8.astype(np.float32) * vs[..., None]).astype(layout.np_dtype)
+    return HostKV(k, v, None, None)
+
+
+def concat_blocks(blocks: list[HostKV]) -> HostKV:
+    """Consecutive decoded blocks -> one HostKV along the token axis."""
+    k = np.concatenate([b.k for b in blocks], axis=1)
+    v = np.concatenate([b.v for b in blocks], axis=1)
+    if blocks[0].k_scale is not None:
+        ks = np.concatenate([b.k_scale for b in blocks], axis=1)
+        vs = np.concatenate([b.v_scale for b in blocks], axis=1)
+    else:
+        ks = vs = None
+    return HostKV(k, v, ks, vs)

@@ -1,0 +1,539 @@
+"""Hierarchical KV cache units: radix index, block codec, tiers, and
+the CacheManager facade — all host-side (no engine, no jax dispatch),
+plus the Redis tier over a real socket against the RESP fake."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from gofr_tpu.datasource.redisclient import RedisClient
+from gofr_tpu.metrics import Manager, register_framework_metrics
+from gofr_tpu.testutil.redisfake import FakeRedisServer
+from gofr_tpu.tpu.kvcache import (CacheManager, Entry, HBMTier, HostKV,
+                                  HostTier, KVLayout, RadixIndex, RedisTier,
+                                  chain_hashes, clamp_restore_len,
+                                  decode_block, encode_block,
+                                  model_fingerprint)
+
+L, KV, HD, B = 2, 2, 4, 16
+INT8 = KVLayout(L, KV, HD, True, np.dtype(np.int8), 128)
+FP32 = KVLayout(L, KV, HD, False, np.dtype(np.float32), 128)
+
+
+def toks(*vals) -> np.ndarray:
+    return np.asarray(vals, np.int32)
+
+
+def arange(a, b) -> np.ndarray:
+    return np.arange(a, b, dtype=np.int32)
+
+
+def make_kv(plen: int, seed: int = 0, quant: bool = True) -> HostKV:
+    rng = np.random.default_rng(seed)
+    if quant:
+        return HostKV(
+            rng.integers(-127, 127, (L, plen, KV, HD)).astype(np.int8),
+            rng.integers(-127, 127, (L, plen, KV, HD)).astype(np.int8),
+            rng.random((L, plen, KV)).astype(np.float32),
+            rng.random((L, plen, KV)).astype(np.float32))
+    return HostKV(
+        rng.standard_normal((L, plen, KV, HD)).astype(np.float32),
+        rng.standard_normal((L, plen, KV, HD)).astype(np.float32),
+        None, None)
+
+
+# -- chain hashing ------------------------------------------------------------
+
+def test_chain_hashes_encode_left_context_and_adapter():
+    a = arange(0, 48)
+    ha = list(chain_hashes(a, 16))
+    assert len(ha) == 3  # full blocks only
+    # same block content, different left context -> different hash
+    b = np.concatenate([toks(99), a[1:48]])
+    hb = list(chain_hashes(b, 16))
+    assert ha[0] != hb[0] and ha[1] != hb[1]
+    # deterministic
+    assert ha == list(chain_hashes(a, 16))
+    # adapter-keyed: adapter 1's chain never collides with adapter 0's
+    assert ha != list(chain_hashes(a, 16, adapter=1))
+    # lazy limit
+    assert list(chain_hashes(a, 16, limit=1)) == ha[:1]
+
+
+# -- radix index --------------------------------------------------------------
+
+def test_radix_longest_match_and_partial_block_lcp():
+    idx = RadixIndex(block=16)
+    a, b = arange(1, 41), arange(100, 140)
+    ea, eb = Entry(a, 0, payload=0), Entry(b, 0, payload=1)
+    idx.insert(ea)
+    idx.insert(eb)
+    # partial-block LCP: 1 full block walks, 9 tail tokens compare
+    probe = np.concatenate([a[:25], toks(9, 9)])
+    e, m = idx.match(probe)
+    assert e is ea and m == 25
+    # full coverage
+    assert idx.match(a) == (ea, 40)
+    # sub-block prompt (no full block) still matches via root LCP
+    assert idx.match(b[:10]) == (eb, 10)
+    # nothing shared
+    assert idx.match(toks(7, 7, 7)) == (None, 0)
+
+
+def test_radix_remove_prunes_and_adapter_isolation():
+    idx = RadixIndex(block=8)
+    a = arange(1, 33)
+    e0, e1 = Entry(a, 0), Entry(a, 1)
+    idx.insert(e0)
+    idx.insert(e1)
+    # same tokens, different adapter: invisible to each other
+    assert idx.match(a, adapter=0) == (e0, 32)
+    assert idx.match(a, adapter=1) == (e1, 32)
+    assert idx.invalidate_adapter(1) == 1
+    assert idx.match(a, adapter=1) == (None, 0)
+    assert idx.match(a, adapter=0) == (e0, 32)
+    idx.remove(e0)
+    assert idx.match(a, adapter=0) == (None, 0)
+    assert len(idx) == 0
+    # removing again is a no-op, and the tree accepts fresh inserts
+    idx.remove(e0)
+    idx.insert(Entry(a, 0))
+    assert idx.match(a)[1] == 32
+
+
+def test_radix_prefers_fresh_entries_at_equal_depth():
+    idx = RadixIndex(block=8)
+    shared = arange(1, 17)
+    e_old = Entry(np.concatenate([shared, toks(50, 51)]), 0)
+    e_new = Entry(np.concatenate([shared, toks(60, 61)]), 0)
+    idx.insert(e_old)
+    idx.insert(e_new)
+    e_new.tick = 5  # fresher
+    # probe diverges inside block 3: both candidates match 16; the MRU
+    # one wins the tie
+    e, m = idx.match(np.concatenate([shared, toks(70)]))
+    assert m == 16 and e is e_new
+    # but a LONGER match beats freshness
+    e, m = idx.match(np.concatenate([shared, toks(50, 51)]))
+    assert e is e_old and m == 18
+
+
+# -- block codec --------------------------------------------------------------
+
+def test_codec_int8_roundtrip_bit_exact():
+    kv = make_kv(16, quant=True)
+    got = decode_block(encode_block(kv), INT8)
+    assert np.array_equal(got.k, kv.k) and np.array_equal(got.v, kv.v)
+    assert np.array_equal(got.k_scale, kv.k_scale)
+    assert np.array_equal(got.v_scale, kv.v_scale)
+
+
+def test_codec_fp_quantizes_within_tolerance():
+    kv = make_kv(16, quant=False)
+    got = decode_block(encode_block(kv), FP32)
+    assert got.k_scale is None
+    # per-vector int8: worst-case error is scale/2 = max|x|/254
+    assert np.max(np.abs(got.k - kv.k)) <= np.max(np.abs(kv.k)) / 127
+    assert np.max(np.abs(got.v - kv.v)) <= np.max(np.abs(kv.v)) / 127
+
+
+def test_codec_rejects_corruption_truncation_and_wrong_layout():
+    frame = encode_block(make_kv(16))
+    assert decode_block(frame, INT8) is not None
+    # single flipped byte -> checksum miss
+    flipped = frame[:40] + bytes([frame[40] ^ 1]) + frame[41:]
+    assert decode_block(flipped, INT8) is None
+    assert decode_block(frame[:-1], INT8) is None
+    assert decode_block(frame[:10], INT8) is None
+    assert decode_block(b"", INT8) is None
+    assert decode_block(b"JUNK" + frame[4:], INT8) is None
+    # a frame for a different architecture must never decode
+    other = KVLayout(L + 1, KV, HD, True, np.dtype(np.int8), 128)
+    assert decode_block(frame, other) is None
+
+
+# -- tiers --------------------------------------------------------------------
+
+def test_hbm_tier_free_rows_then_lru_victim():
+    t0 = HBMTier(2, block=16)
+    a, b, c = arange(1, 41), arange(100, 140), arange(200, 240)
+    r_a, v = t0.store(a)
+    assert v is None
+    r_b, v = t0.store(b)
+    assert v is None and r_a != r_b
+    e, _ = t0.match(a)
+    t0.touch(e)  # a is fresher -> b is the victim
+    r_c, victim = t0.store(c)
+    assert r_c == r_b and victim.key[0] == 100
+    assert t0.evictions == 1
+    # victim is unindexed but keeps key+row for the offload spill
+    assert victim.row == r_b
+    assert t0.match(b) == (None, 0)
+
+
+def test_host_tier_byte_budget_lru_and_covered_skip():
+    kv = make_kv(32)
+    t1 = HostTier(max_bytes=kv.nbytes * 2 + 1, block=16)
+    a, b, c = arange(1, 33), arange(100, 132), arange(200, 232)
+    assert t1.put(a, 0, kv)
+    assert t1.put(b, 0, make_kv(32, seed=1))
+    assert len(t1) == 2
+    # covered: a shorter prefix of a stored key is a skip, not a dup
+    assert not t1.put(a[:20], 0, make_kv(20))
+    # budget: storing c evicts the LRU (a)
+    e, _ = t1.match(b)
+    t1.touch(e)
+    assert t1.put(c, 0, make_kv(32, seed=2))
+    assert t1.match(a) == (None, 0) and t1.evictions == 1
+    assert t1.bytes <= t1.max_bytes
+    # an entry bigger than the whole budget is refused outright
+    assert not t1.put(arange(300, 396), 0, make_kv(96))
+
+
+def test_host_tier_drops_dominated_entries_on_superset_put():
+    """Multi-turn growth: when a longer key arrives, stored entries it
+    strictly covers are dropped — every probe they can serve the
+    superset serves at least as well, so keeping both only burns the
+    T1 byte budget toward evicting non-dominated prefixes."""
+    t1 = HostTier(max_bytes=1 << 20, block=16)
+    a = arange(1, 49)
+    kv48 = make_kv(48)
+    assert t1.put(a[:32], 0, make_kv(32))
+    # a different adapter's identical-token short key is NOT dominated
+    assert t1.put(a[:32], 1, make_kv(32, seed=3))
+    assert t1.put(a, 0, kv48)
+    assert len(t1) == 2  # adapter-0's short entry gone, adapter-1 kept
+    assert t1.bytes == kv48.nbytes + make_kv(32, seed=3).nbytes
+    assert t1.match(a, 0)[1] == 48
+    assert t1.match(a[:32], 1)[1] == 32
+    assert t1.evictions == 0  # dedup, not budget pressure
+
+
+# -- redis tier ---------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def redis_server():
+    srv = FakeRedisServer()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture()
+def redis_client(redis_server):
+    c = RedisClient(redis_server.host, redis_server.port)
+    c.flushdb()
+    yield c
+    c.close()
+
+
+def test_redis_tier_roundtrip_and_cross_replica_share(redis_server,
+                                                      redis_client):
+    tier = RedisTier(redis_client, "fpA", INT8, block=B, ttl_s=60)
+    key = arange(1, 41)  # 2 full blocks + 8 tail tokens
+    kv = make_kv(40, seed=3)
+    assert tier.put(key, 0, kv) == 2  # the partial block stays local
+    # duplicate put is deduped by the written-set
+    assert tier.put(key, 0, kv) == 0
+    replica = RedisTier(
+        RedisClient(redis_server.host, redis_server.port), "fpA", INT8,
+        block=B, ttl_s=60)
+    probe = np.concatenate([key[:37], toks(250, 251)])
+    m, got = replica.match(probe)
+    assert m == 32
+    assert np.array_equal(got.k, kv.k[:, :32])
+    assert np.array_equal(got.k_scale, kv.k_scale[:, :32])
+    # a replica with a different model fingerprint shares nothing
+    stranger = RedisTier(
+        RedisClient(redis_server.host, redis_server.port), "fpB", INT8,
+        block=B)
+    assert stranger.match(probe) == (0, None)
+
+
+def test_redis_tier_epoch_invalidation_reaches_replicas(redis_server,
+                                                        redis_client):
+    tier = RedisTier(redis_client, "fpC", INT8, block=B, ttl_s=60,
+                     epoch_refresh_s=0.0)  # refresh every lookup
+    key = arange(1, 33)
+    tier.put(key, 1, make_kv(32))
+    replica = RedisTier(
+        RedisClient(redis_server.host, redis_server.port), "fpC", INT8,
+        block=B, epoch_refresh_s=0.0)
+    assert replica.match(key, 1)[0] == 32
+    tier.invalidate_adapter(1)  # epoch bump, no DELs
+    assert replica.match(key, 1) == (0, None)
+    assert tier.match(key, 1) == (0, None)
+    # other adapters keep their epoch
+    tier.put(arange(1, 33), 0, make_kv(32))
+    assert replica.match(key, 0)[0] == 32
+
+
+def test_redis_tier_corrupted_frame_reads_as_miss(redis_server,
+                                                  redis_client):
+    tier = RedisTier(redis_client, "fpD", INT8, block=B, ttl_s=60)
+    key = arange(1, 33)
+    tier.put(key, 0, make_kv(32))
+    # vandalize the second block server-side: the chain's prefix run
+    # stops there, the first block still serves
+    ep = tier._epoch(0)
+    hashes = list(chain_hashes(key, B, 0))
+    bad_key = tier._block_key(0, ep, hashes[1])
+    redis_client.set(bad_key, b"garbage-bytes")
+    fresh = RedisTier(
+        RedisClient(redis_server.host, redis_server.port), "fpD", INT8,
+        block=B)
+    m, got = fresh.match(key)
+    assert m == 16 and got.plen == 16
+    assert fresh.checksum_rejects == 1
+
+
+def test_redis_tier_fails_open_when_server_dies():
+    srv = FakeRedisServer()
+    cli = RedisClient(srv.host, srv.port)
+    tier = RedisTier(cli, "fpE", INT8, block=B)
+    srv.close()
+    cli.close()
+    assert tier.match(arange(1, 33), 0) == (0, None)
+    assert tier.errors == 1  # counted, never raised
+    # the error opened a backoff window: further consults short-circuit
+    # without touching the client (a down Redis must not tax every
+    # admission with a fresh connect timeout)
+    assert not tier.available
+    assert tier.put(arange(1, 33), 0, make_kv(32)) == 0
+    assert tier.errors == 1
+    tier._down_until = 0.0  # cooldown expires -> consults resume
+    assert tier.put(arange(1, 33), 0, make_kv(32)) == 0
+    assert tier.errors == 2
+
+
+def test_redis_tier_backoff_skips_manager_consult():
+    """While the tier is inside its backoff window the manager must not
+    consult it at all — nor count a t2 miss for lookups it never ran."""
+    srv = FakeRedisServer()
+    cli = RedisClient(srv.host, srv.port)
+    srv.close()
+    cli.close()
+    mgr = CacheManager(1, INT8, block=B, redis=cli)
+    a = arange(1, 33)
+    assert mgr.match(a) is None  # the failed consult opens the window
+    mgr.reject(prompt=a)
+    assert mgr.redis.errors == 1
+    mgr.reject(mgr.match(a))  # backoff window: t2 never consulted
+    assert mgr.redis.errors == 1
+    # neither reject counted a t2 miss: the tier was unavailable by
+    # reject time both times (under-counting the one real failed
+    # consult beats inflating the miss ratio all through an outage)
+    assert mgr.stats()["tiers"]["t2"]["misses"] == 0
+    assert mgr.stats()["tiers"]["t0"]["misses"] == 2
+
+
+def test_redis_tier_warns_once_per_outage(redis_server):
+    """The once-only error log re-arms on any success: squelching
+    repeats WITHIN an outage must not hide the next outage from the
+    operator for the rest of the process lifetime."""
+
+    class Log:
+        def __init__(self):
+            self.warns = []
+
+        def warn(self, obj):
+            self.warns.append(obj)
+
+    class Flaky:
+        def __init__(self, inner):
+            self.inner, self.down = inner, False
+
+        def __getattr__(self, name):
+            if self.down:
+                raise ConnectionError("redis unreachable")
+            return getattr(self.inner, name)
+
+    log = Log()
+    flaky = Flaky(RedisClient(redis_server.host, redis_server.port))
+    tier = RedisTier(flaky, "fpW", INT8, block=B, epoch_refresh_s=0.0,
+                     logger=log)
+    a = arange(1, 33)
+    flaky.down = True
+    assert tier.match(a, 0) == (0, None)
+    assert len(log.warns) == 1
+    tier._down_until = 0.0
+    assert tier.match(a, 0) == (0, None)  # same outage: squelched
+    assert len(log.warns) == 1
+    flaky.down = False
+    tier._down_until = 0.0
+    tier.match(a, 0)  # success re-arms the log
+    flaky.down = True
+    tier._down_until = 0.0
+    tier.match(a, 0)
+    assert len(log.warns) == 2  # the later outage is visible
+
+
+def test_redis_tier_invalidate_fails_closed(redis_server):
+    """A failed epoch INCR must NOT leave pre-swap KV readable: the
+    adapter's shared reads and writes stay off until a bump lands, and
+    the lazy retry renames the namespace so old blocks never serve."""
+
+    class FlakyClient:
+        def __init__(self, inner):
+            self.inner, self.down = inner, False
+
+        def __getattr__(self, name):
+            if self.down:
+                raise ConnectionError("redis unreachable")
+            return getattr(self.inner, name)
+
+    flaky = FlakyClient(RedisClient(redis_server.host, redis_server.port))
+    tier = RedisTier(flaky, "fpF", INT8, block=B, ttl_s=60,
+                     epoch_refresh_s=0.0)
+    key = arange(1, 33)
+    tier.put(key, 1, make_kv(32))
+    assert tier.match(key, 1)[0] == 32
+    flaky.down = True  # Redis vanishes exactly at hot-swap time
+    tier.invalidate_adapter(1)
+    assert tier.stats()["pending_bumps"] == 1
+    flaky.down = False  # Redis recovers — old-epoch blocks still there
+    tier._down_until = 0.0
+    # the lazy INCR retry lands first, so the old blocks are unreadable
+    assert tier.match(key, 1) == (0, None)
+    assert tier.stats()["pending_bumps"] == 0
+    # a sibling replica that never saw the failure re-reads the bumped
+    # epoch and drops the same blocks
+    replica = RedisTier(
+        RedisClient(redis_server.host, redis_server.port), "fpF", INT8,
+        block=B, epoch_refresh_s=0.0)
+    assert replica.match(key, 1) == (0, None)
+    # and writes while the bump was pending would have been refused
+    flaky.down = True
+    tier.invalidate_adapter(1)
+    flaky.down = False
+    tier._down_until = 0.0
+    assert tier.pending_put_len(key, 1) == 32  # retried bump, new epoch
+    assert tier.stats()["pending_bumps"] == 0
+
+
+# -- manager ------------------------------------------------------------------
+
+def test_manager_tier_precedence_longest_match_wins():
+    mgr = CacheManager(1, INT8, block=16, host_bytes=1 << 20)
+    a = arange(1, 49)
+    row, _ = mgr.store(a[:32])         # T0 holds 32 tokens
+    mgr.host.put(a, 0, make_kv(48))    # T1 holds all 48
+    mt = mgr.match(a)
+    assert mt.tier == "t1" and mt.matched_len == 48
+    # equal lengths tie to the cheaper tier (T0 row copy)
+    mgr2 = CacheManager(1, INT8, block=16, host_bytes=1 << 20)
+    mgr2.store(a)
+    mgr2.host.put(a, 0, make_kv(48))
+    assert mgr2.match(a).tier == "t0"
+
+
+def test_manager_t2_consult_needs_full_block_margin(redis_client):
+    """A T2 hit pays MGET + host->device upload + a pool-row promotion.
+    When the local tiers are within one block of the best possible
+    (block-aligned) shared match, the round trip cannot pay for itself:
+    the manager must serve the local match without consulting Redis."""
+    a = arange(1, 33)  # 32 tokens = 2 full blocks
+    seed = RedisTier(redis_client, "fpM", INT8, block=B,
+                     epoch_refresh_s=0.0)
+    assert seed.put(a, 0, make_kv(32)) == 2
+    mgr = CacheManager(2, INT8, block=B, redis=redis_client,
+                       fingerprint="fpM", epoch_refresh_s=0.0)
+    mgr.store(a[:30])  # local covers 30 of full=32: gain < one block
+    mt = mgr.match(a)
+    assert mt.tier == "t0" and mt.matched_len == 30
+    assert "t2" not in mt.consulted
+    assert mgr.redis.blocks_got == 0  # no round trip at all
+    # a full uncovered block IS worth the trip — and T2 wins it
+    mgr2 = CacheManager(2, INT8, block=B, redis=redis_client,
+                        fingerprint="fpM", epoch_refresh_s=0.0)
+    mgr2.store(a[:16])
+    mt2 = mgr2.match(a)
+    assert mt2.tier == "t2" and mt2.matched_len == 32
+
+
+def test_manager_full_prompt_hit_clamps_to_len_minus_one():
+    """Satellite regression: match() may cover the ENTIRE prompt (exact
+    repeat); the restore path must clamp so >= 1 position prefills to
+    produce first-token logits."""
+    mgr = CacheManager(1, INT8, block=16)
+    a = arange(1, 41)
+    mgr.store(a)
+    mt = mgr.match(a)
+    assert mt.matched_len == len(a)  # the full-prompt edge is real
+    assert clamp_restore_len(mt.matched_len, len(a)) == len(a) - 1
+    assert clamp_restore_len(10, 40) == 10  # partial matches untouched
+
+
+def test_manager_clear_device_keeps_host_tier():
+    mgr = CacheManager(2, INT8, block=16, host_bytes=1 << 20)
+    a = arange(1, 33)
+    mgr.store(a)
+    mgr.host.put(a, 0, make_kv(32))
+    v0 = mgr.version
+    assert mgr.clear_device() == 1
+    assert mgr.version > v0
+    assert len(mgr.t0) == 0 and len(mgr.host) == 1
+    mt = mgr.match(a)
+    assert mt.tier == "t1"  # the rewarm source survived
+
+
+def test_manager_invalidate_adapter_hits_all_tiers(redis_server):
+    cli = RedisClient(redis_server.host, redis_server.port)
+    cli.flushdb()
+    mgr = CacheManager(2, INT8, block=16, host_bytes=1 << 20, redis=cli,
+                       epoch_refresh_s=0.0)
+    a = arange(1, 33)
+    mgr.store(a, adapter=1)
+    mgr.host.put(a, 1, make_kv(32))
+    mgr.store_shared(a, 1, make_kv(32))
+    assert mgr.redis.match(a, 1)[0] == 32
+    out = mgr.invalidate_adapter(1)
+    assert out["t0"] == 1 and out["t1"] == 1 and out["t2"] == "epoch_bumped"
+    assert mgr.match(a, adapter=1) is None
+    assert mgr.redis.match(a, 1) == (0, None)
+    cli.close()
+
+
+def test_manager_version_bumps_on_every_match_changing_mutation():
+    mgr = CacheManager(2, INT8, block=16, host_bytes=1 << 20)
+    vers = [mgr.version]
+    mgr.store(arange(1, 33))
+    vers.append(mgr.version)
+    mgr.invalidate_adapter(0)
+    vers.append(mgr.version)
+    mgr.clear_device()
+    vers.append(mgr.version)
+    assert vers == sorted(set(vers)), vers  # strictly increasing
+
+
+def test_manager_emits_labeled_prometheus_metrics():
+    m = Manager()
+    register_framework_metrics(m)
+    mgr = CacheManager(1, INT8, block=16, host_bytes=1 << 20, metrics=m)
+    a, b = arange(1, 33), arange(100, 132)
+    mgr.store(a)
+    mt = mgr.match(a)
+    mgr.accept(mt, restore_s=0.001)
+    mgr.match(toks(9, 9, 9))
+    mgr.reject()
+    mgr.host.put(b, 0, make_kv(32))
+    mt = mgr.match(b)
+    mgr.accept(mt)  # t1 hit implies a t0 miss
+    text = m.render_prometheus()
+    assert 'app_tpu_kvcache_hits_total{tier="t0"} 1' in text
+    assert 'app_tpu_kvcache_hits_total{tier="t1"} 1' in text
+    assert 'app_tpu_kvcache_misses_total{tier="t0"}' in text
+    assert 'app_tpu_kvcache_entries{tier="t0"}' in text
+    assert 'app_tpu_kvcache_restore_duration' in text
+    st = mgr.stats()
+    assert st["hit_ratio"] == round(2 / 3, 4)
+
+
+def test_model_fingerprint_separates_configs():
+    from gofr_tpu.models import LLAMA_CONFIGS
+
+    tiny = LLAMA_CONFIGS["tiny"]
+    fp1 = model_fingerprint(tiny, extra="int8")
+    assert fp1 == model_fingerprint(tiny, extra="int8")  # stable
+    assert fp1 != model_fingerprint(tiny, extra="float32")
+    assert fp1 != model_fingerprint(LLAMA_CONFIGS["llama-1b"], extra="int8")

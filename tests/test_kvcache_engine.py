@@ -1,0 +1,300 @@
+"""Hierarchical KV cache through the engine: T1 spill/rewarm, T2
+cross-replica sharing, recovery x tiers (chaos-injected device loss at
+the generator.prefill seam), adapter hot-swap invalidation across all
+three tiers, and the full-prompt-hit clamp — with every hit stream
+required to yield the EXACT greedy tokens of the cache-free reference
+(int8 caches: the tier round trips are lossless by construction).
+
+Tests deliberately share one engine across several scenario phases:
+each GenerationEngine costs ~10s of CPU-backend compiles, and tier-1
+runs under a wall clock — coverage per compile matters.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu import chaos
+from gofr_tpu.datasource.redisclient import RedisClient
+from gofr_tpu.models import LLAMA_CONFIGS, llama
+from gofr_tpu.testutil.redisfake import FakeRedisServer
+from gofr_tpu.tpu import GenerationEngine, GenerationError
+from gofr_tpu.tpu.kvcache import KVCacheOptions
+
+TINY = LLAMA_CONFIGS["tiny"]
+
+pytestmark = pytest.mark.chaos  # the recovery tests use the chaos seams
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init(TINY, jax.random.PRNGKey(1))
+
+
+@pytest.fixture(scope="module")
+def redis_server():
+    srv = FakeRedisServer()
+    yield srv
+    srv.close()
+
+
+def _ref_greedy(params, prompt, n):
+    toks = list(prompt)
+    for _ in range(n):
+        logits = llama.forward(params, TINY, jnp.asarray([toks], jnp.int32))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def _engine(params, redis_server=None, **kw):
+    opts = KVCacheOptions(
+        block=8, host_mb=kw.pop("host_mb", 64),
+        redis=RedisClient(redis_server.host, redis_server.port)
+        if redis_server is not None else None,
+        epoch_refresh_s=0.0)
+    kw.setdefault("prefix_cache_slots", 2)
+    kw.setdefault("prefix_store_min", 16)
+    kw.setdefault("kv_dtype", jnp.int8)
+    return GenerationEngine(TINY, params, slots=2, max_seq=128,
+                            prompt_buckets=(8, 16, 32), kvcache=opts, **kw)
+
+
+def _fill_t0(eng, rng, n=2):
+    """Generate ``n`` unrelated prompts long enough to store — evicting
+    whatever T0 held into the host tier."""
+    for _ in range(n):
+        p = rng.integers(1, TINY.vocab_size, 20).tolist()
+        eng.generate(p, max_new_tokens=2).tokens()
+
+
+def _inject_device_loss(eng):
+    """One DeviceLost at the generator.prefill chaos seam; the victim
+    request's stream must fail with GenerationError."""
+    sched = chaos.ChaosSchedule(seed=0).on(
+        chaos.GENERATOR_PREFILL, error=chaos.DeviceLost, every=1, limit=1)
+    with chaos.scope(sched):
+        with pytest.raises(GenerationError):
+            eng.generate([1, 2, 3, 4], max_new_tokens=4).tokens()
+
+
+def _wait_recovered(eng, timeout=30.0):
+    """A PREFILL failure fails the request's own stream from _start
+    (so its consumer never hangs) BEFORE re-raising into the loop's
+    recovery handler — unlike a step failure, the consumer can briefly
+    observe pre-clear state. Poll until the T0 clear lands before
+    asserting post-recovery invariants."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if eng.stats()["prefix_cache"]["entries"] == 0:
+            return
+        time.sleep(0.01)
+    raise AssertionError("recovery did not clear T0 within the deadline")
+
+
+def test_t1_spill_rewarm_clamp_and_span(params):
+    """One engine, three pinned behaviors:
+    (1) full-prompt-hit clamp — an exact-repeat prompt matches its own
+        entire length; the restore clamps to L-1 so the final chunk
+        still prefills >= 1 position and samples the first token;
+    (2) T1 spill + rewarm — T0 eviction spills the row to host DRAM,
+        the next request restores from it (exact tokens) and PROMOTES
+        it back to a T0 row, so the hit after that is a row copy again;
+    (3) every restore exports a tpu.prefix-restore span tagged with the
+        serving tier."""
+    from gofr_tpu.observe import Observe
+    from gofr_tpu.tracing import InMemoryExporter, Tracer
+
+    exporter = InMemoryExporter()
+    obs = Observe(tracer=Tracer(service_name="kvcache-test",
+                                exporter=exporter))
+    rng = np.random.default_rng(3)
+    prefix = rng.integers(1, TINY.vocab_size, 24).tolist()
+    eng = _engine(params, observe=obs)
+    try:
+        want = _ref_greedy(params, prefix, 4)
+        assert eng.generate(prefix, max_new_tokens=4).tokens() == want
+        # -- (1) exact repeat: matched_len == len(prompt), clamp path
+        mt = eng._kvc.match(np.asarray(prefix, np.int32), 0)
+        assert mt.matched_len == len(prefix)  # the edge is exercised
+        assert eng.generate(prefix, max_new_tokens=4).tokens() == want
+        st = eng.stats()["prefix_cache"]
+        assert st["tiers"]["t0"]["hits"] == 1
+        # -- (2) evict out of the HBM tier, rewarm from host DRAM
+        _fill_t0(eng, rng)
+        st = eng.stats()["prefix_cache"]
+        assert st["tiers"]["t1"]["entries"] >= 1  # spilled, not lost
+        assert eng.generate(prefix, max_new_tokens=4).tokens() == want
+        st = eng.stats()["prefix_cache"]
+        assert st["tiers"]["t1"]["hits"] == 1
+        spans = [s for s in exporter.spans if s.name == "tpu.prefix-restore"]
+        assert spans and spans[-1].attributes["tier"] == "t1"  # -- (3)
+        assert spans[0].attributes["tier"] == "t0"
+        assert spans[-1].attributes["tokens"] >= 16
+        # promotion: the same prefix is a T0 row copy again
+        assert eng.generate(prefix, max_new_tokens=4).tokens() == want
+        st = eng.stats()["prefix_cache"]
+        assert st["tiers"]["t0"]["hits"] == 2
+        assert st["hit_ratio"] is not None and st["hit_ratio"] > 0
+    finally:
+        eng.close()
+
+
+def test_t1_rewarm_exact_on_fp32_cache(params):
+    """The host tier snapshots cache-native arrays — exactness must
+    hold for dense fp caches too, not just int8."""
+    rng = np.random.default_rng(4)
+    prefix = rng.integers(1, TINY.vocab_size, 24).tolist()
+    eng = _engine(params, kv_dtype=None)
+    try:
+        want = _ref_greedy(params, prefix, 4)
+        assert eng.generate(prefix, max_new_tokens=4).tokens() == want
+        _fill_t0(eng, rng)
+        assert eng.generate(prefix, max_new_tokens=4).tokens() == want
+        assert eng.stats()["prefix_cache"]["tiers"]["t1"]["hits"] == 1
+    finally:
+        eng.close()
+
+
+def test_t2_shares_prefill_across_replicas_and_survives_loss(
+        params, redis_server):
+    """The microservice twist, then its failure half:
+    (1) replica A's admission write-through lets replica B restore the
+        prefix from Redis — B never prefills the shared positions and
+        (int8 cache) streams the exact tokens;
+    (2) after a DeviceLost injected at B's generator.prefill seam, T0
+        is cleared but the shared tier is device-independent — B
+        restores the same prefix from Redis again, no full prefill."""
+    rng = np.random.default_rng(5)
+    prefix = rng.integers(1, TINY.vocab_size, 32).tolist()
+    a = _engine(params, redis_server, host_mb=0)
+    b = _engine(params, redis_server, host_mb=0)
+    try:
+        want = _ref_greedy(params, prefix, 4)
+        assert a.generate(prefix, max_new_tokens=4).tokens() == want
+        assert a.stats()["prefix_cache"]["tiers"]["t2"]["blocks_put"] >= 4
+        got = b.generate(prefix, max_new_tokens=4).tokens()
+        assert got == want
+        st = b.stats()["prefix_cache"]
+        assert st["tiers"]["t2"]["hits"] == 1
+        assert st["tiers"]["t0"]["misses"] >= 1  # fell through locally
+        # -- (2) device loss on the replica: T2 survives recovery
+        _inject_device_loss(b)
+        _wait_recovered(b)  # T0 cleared with the reallocated pool
+        assert b.down is None
+        assert b.generate(prefix, max_new_tokens=4).tokens() == want
+        assert b.stats()["prefix_cache"]["tiers"]["t2"]["hits"] == 2
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recovery_clears_t0_then_t1_rewarms_without_prefill(params):
+    """Recovery x tiers: a DeviceLost injected at the generator.prefill
+    chaos seam bricks the donated cache; recovery must (1) clear T0 —
+    its rows point into the reallocated pool — while (2) KEEPING the
+    host tier, so (3) the next request for a spilled prefix restores
+    from T1 instead of paying a full prefill, with exact tokens."""
+    rng = np.random.default_rng(7)
+    prefix = rng.integers(1, TINY.vocab_size, 24).tolist()
+    eng = _engine(params)
+    try:
+        want = _ref_greedy(params, prefix, 4)
+        assert eng.generate(prefix, max_new_tokens=4).tokens() == want
+        _fill_t0(eng, rng)  # spill the prefix to T1 pre-loss
+        t1_entries = eng.stats()["prefix_cache"]["tiers"]["t1"]["entries"]
+        assert t1_entries >= 1
+        _inject_device_loss(eng)
+        _wait_recovered(eng)  # T0 cleared with the pool
+        assert eng.down is None  # recovered, not bricked
+        st = eng.stats()["prefix_cache"]
+        assert st["tiers"]["t1"]["entries"] == t1_entries  # T1 survives
+        hits_before = st["tiers"]["t1"]["hits"]
+        got = eng.generate(prefix, max_new_tokens=4).tokens()
+        assert got == want
+        st = eng.stats()["prefix_cache"]
+        assert st["tiers"]["t1"]["hits"] == hits_before + 1  # rewarm
+    finally:
+        eng.close()
+
+
+def test_adapter_hot_swap_invalidates_all_three_tiers(params,
+                                                      redis_server):
+    """THE cross-tier hazard: adapter-1 KV spilled to T1 or shared via
+    T2 was computed through the OLD wk/wv — load_adapter must kill the
+    same key in every tier, and the next adapter-1 request must stream
+    the NEW weights' reference tokens."""
+    import zlib
+
+    layers = {**params["layers"],
+              **llama.init_lora(TINY, 3, 4, jax.random.PRNGKey(7))}
+    for name in llama.LORA_TARGETS:
+        # nonzero, reproducible B for adapters 1/2 (crc32 seed: str
+        # hash() is salted per process) — a zero adapter would make the
+        # swap numerically invisible and the test vacuous
+        b = layers[f"lora_b_{name}"]
+        fill = jax.random.normal(
+            jax.random.PRNGKey(zlib.crc32(name.encode()) % 1000),
+            b.shape[:1] + b.shape[2:]) * 0.05
+        b = b.at[:, 1].set(fill.astype(b.dtype))
+        b = b.at[:, 2].set((fill * -0.5).astype(b.dtype))
+        layers[f"lora_b_{name}"] = b
+    lora_params = {**params, "layers": layers}
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(1, TINY.vocab_size, 32).tolist()
+    key = np.asarray(prompt, np.int32)
+    eng = GenerationEngine(
+        TINY, lora_params, slots=2, max_seq=128, prompt_buckets=(8, 16, 32),
+        prefix_cache_slots=1, prefix_store_min=16, kv_dtype=jnp.int8,
+        lora_adapters=3,
+        kvcache=KVCacheOptions(
+            block=8, host_mb=64, epoch_refresh_s=0.0,
+            redis=RedisClient(redis_server.host, redis_server.port)))
+    try:
+        eng.generate(prompt, max_new_tokens=2, adapter=1).tokens()
+        # evict adapter-1's entry into T1 (1 T0 row), keep T2 written
+        eng.generate(rng.integers(1, TINY.vocab_size, 20).tolist(),
+                     max_new_tokens=2, adapter=1).tokens()
+        mgr = eng._kvc
+        assert mgr.host.match(key, 1)[1] >= 16   # in T1
+        assert mgr.redis.match(key, 1)[0] >= 16  # in T2
+        tree = {name: (lora_params["layers"][f"lora_a_{name}"][:, 2],
+                       lora_params["layers"][f"lora_b_{name}"][:, 2])
+                for name in llama.LORA_TARGETS}
+        eng.load_adapter(1, tree)
+        # every tier dropped the adapter-1 key
+        assert mgr.t0.index.entries_for(1) == 0
+        assert mgr.host.match(key, 1) == (None, 0)
+        assert mgr.redis.match(key, 1) == (0, None)
+        # and the next adapter-1 stream recomputes with the NEW weights
+        got = eng.generate(prompt, max_new_tokens=4, adapter=1).tokens()
+        merged = llama.merge_lora(lora_params, TINY, 2)
+        assert got == _ref_greedy(merged, prompt, 4)
+    finally:
+        eng.close()
+
+
+def test_engine_without_prefix_cache_closes_handed_in_redis_client(params):
+    """KVCacheOptions promises the ENGINE owns the redis client. An
+    engine that never builds the CacheManager (prefix_cache_slots=0;
+    same guard covers paged engines) must close the client at
+    construction instead of leaking the socket for the process life."""
+
+    class Client:
+        closed = False
+
+        def close(self):
+            self.closed = True
+
+    cli = Client()
+    eng = GenerationEngine(TINY, params, slots=1, max_seq=32,
+                           prompt_buckets=(8,), prefix_cache_slots=0,
+                           kvcache=KVCacheOptions(redis=cli))
+    try:
+        assert cli.closed
+    finally:
+        eng.close()

@@ -189,6 +189,16 @@ def test_debug_events_bad_request_id_is_400(app):
     assert status == 400
 
 
+def test_debug_cache_without_engine_reports_disabled(app):
+    """/debug/cache on an app with no TPU generator: valid JSON, not a
+    500 — the page must degrade like the rest of the debug surface."""
+    app.run(block=False)
+    status, body, _ = _get(app.metrics_port, "/debug/cache")
+    assert status == 200
+    payload = json.loads(body)
+    assert payload == {"enabled": False, "cache": None}
+
+
 # -- acceptance: the full serving path on the CPU backend -------------------
 
 def test_full_app_generation_flight_recorder_and_telemetry():

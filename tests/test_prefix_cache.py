@@ -1,7 +1,10 @@
 """Prefix KV cache: restored prefixes must be numerically invisible —
 every stream yields the exact greedy tokens the cache-free reference
 produces, hit or miss, across partial matches, eviction, and int8
-quantized caches."""
+quantized caches. The host-side index here is the T0 tier of
+tpu/kvcache/ (radix-indexed HBMTier behind CacheManager), which
+supersedes the flat PrefixIndex with identical engine-visible
+semantics (LRU, adapter keying, clear-on-recovery)."""
 
 import jax
 import jax.numpy as jnp
@@ -10,9 +13,11 @@ import pytest
 
 from gofr_tpu.models import LLAMA_CONFIGS, llama
 from gofr_tpu.tpu import GenerationEngine
-from gofr_tpu.tpu.prefix_cache import PrefixIndex
+from gofr_tpu.tpu.kvcache import CacheManager, KVLayout
 
 TINY = LLAMA_CONFIGS["tiny"]
+LAYOUT = KVLayout(TINY.n_layers, TINY.n_kv_heads, TINY.head_dim,
+                  False, np.dtype(np.float32), 128)
 
 
 @pytest.fixture(scope="module")
@@ -36,31 +41,35 @@ def _engine(params, **kw):
 
 
 # -- index unit tests ---------------------------------------------------------
+# (the flat PrefixIndex's semantics, re-pinned against the radix-backed
+# CacheManager that replaced it: LCP partial matches, pure match(),
+# accept/reject accounting, covered(), LRU victim selection)
 
 def test_index_lcp_match_and_lru_eviction():
-    idx = PrefixIndex(2)
+    mgr = CacheManager(2, LAYOUT, block=16)
     a = np.arange(1, 41, dtype=np.int32)          # 40 tokens
     b = np.arange(100, 140, dtype=np.int32)
-    assert idx.match(a) == (-1, 0)                # cold: no candidate
-    idx.reject()
-    ra = idx.store_row(a)
-    rb = idx.store_row(b)
-    assert ra != rb
+    assert mgr.match(a) is None                   # cold: no candidate
+    mgr.reject()
+    ra, va = mgr.store(a)
+    rb, vb = mgr.store(b)
+    assert ra != rb and va is None and vb is None
     # partial match of a stored prefix is a valid (shorter) hit
     probe = np.concatenate([a[:25], np.asarray([9, 9], np.int32)])
-    row, m = idx.match(probe)
-    assert row == ra and m == 25
+    mt = mgr.match(probe)
+    assert mt.tier == "t0" and mt.row == ra and mt.matched_len == 25
     # match() is pure — only accept() counts the hit and touches LRU
-    assert idx.stats()["hits"] == 0
-    idx.accept(row)
+    assert mgr.stats()["hits"] == 0
+    mgr.accept(mt)
     # covered: storing a shorter prefix of an entry is pointless
-    assert idx.covered(a[:30]) and not idx.covered(probe)
+    assert mgr.covered(a[:30]) and not mgr.covered(probe)
     # LRU: a was just accepted -> b is the victim
     c = np.arange(200, 240, dtype=np.int32)
-    rc = idx.store_row(c)
-    assert rc == rb
-    st = idx.stats()
+    rc, vc = mgr.store(c)
+    assert rc == rb and vc is not None and vc.key[0] == 100
+    st = mgr.stats()
     assert st["entries"] == 2 and st["hits"] == 1 and st["misses"] == 1
+    assert st["tiers"]["t0"]["evictions"] == 1
 
 
 # -- engine behavior ----------------------------------------------------------

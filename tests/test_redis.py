@@ -62,6 +62,27 @@ def test_lists(client):
     assert client.lrange("l", 1, 1) == ["a"]
 
 
+def test_get_bytes_binary_safe(client):
+    """``get`` decodes to str — lossy for binary payloads (KV cache
+    frames). ``get_bytes`` must round-trip arbitrary bytes, including
+    sequences that are invalid UTF-8."""
+    blob = bytes(range(256)) + b"\xff\xfe\x00raw"
+    assert client.set("bin", blob)
+    assert client.get_bytes("bin") == blob
+    assert client.get_bytes("missing-bin") is None
+    # text values still come back as their exact byte encoding
+    client.set("txt", "héllo")
+    assert client.get_bytes("txt") == "héllo".encode()
+
+
+def test_mget_binary_safe(client):
+    b1, b2 = b"\x00\x01\x02", bytes([0xff] * 64)
+    client.set("m1", b1)
+    client.set("m2", b2)
+    assert client.mget("m1", "nope", "m2") == [b1, None, b2]
+    assert client.mget() == []
+
+
 def test_keys_pattern(client):
     client.set("user:1", "x")
     client.set("user:2", "y")

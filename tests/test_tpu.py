@@ -441,9 +441,9 @@ def test_recovery_observer_consistency_cycles(tiny_llama):
         real = eng._step_jit
         for cycle in range(8):
             # (re)populate the index so recovery has something to clear
-            if len(eng._prefix_idx) == 0:
+            if len(eng._kvc) == 0:
                 eng.generate(prefix + [8, 8], max_new_tokens=4)
-            assert len(eng._prefix_idx) >= 1
+            assert len(eng._kvc) >= 1
             state = {"fired": False}
 
             def flaky(*a, **k):
@@ -458,7 +458,7 @@ def test_recovery_observer_consistency_cycles(tiny_llama):
             # the moment the error unblocked THIS thread, invariants
             # must already hold (the old handler delivered first and
             # cleared after — the exact interleaving this pins down)
-            assert len(eng._prefix_idx) == 0, f"cycle {cycle}"
+            assert len(eng._kvc) == 0, f"cycle {cycle}"
             assert eng.down is None, f"cycle {cycle}"
             got = eng.generate(prefix + [8, 8], max_new_tokens=4).tokens()
             assert got == want, f"cycle {cycle}"
@@ -478,7 +478,7 @@ def test_recovery_clears_prefix_pool_and_keeps_serving(tiny_llama):
     try:
         prefix = [3, 1, 4, 1, 5, 9, 2, 6]
         want = eng.generate(prefix + [8, 8], max_new_tokens=4).tokens()
-        assert len(eng._prefix_idx) == 1  # stored
+        assert len(eng._kvc) == 1  # stored
         real = eng._step_jit
         state = {"fired": False}
 
@@ -492,11 +492,11 @@ def test_recovery_clears_prefix_pool_and_keeps_serving(tiny_llama):
         with pytest.raises(GenerationError):
             eng.generate([1, 2, 3], max_new_tokens=4).tokens()
         assert eng.down is None
-        assert len(eng._prefix_idx) == 0  # cleared with the pool
-        hits_before = eng._prefix_idx.hits
+        assert len(eng._kvc) == 0  # cleared with the pool
+        hits_before = eng._kvc.hits
         got = eng.generate(prefix + [8, 8], max_new_tokens=4).tokens()
         assert got == want  # full recompute, exact tokens
-        assert eng._prefix_idx.hits == hits_before  # no zero-KV hit
+        assert eng._kvc.hits == hits_before  # no zero-KV hit
     finally:
         eng.close()
 

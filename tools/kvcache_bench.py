@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""TTFT hit-vs-miss benchmark for the hierarchical prefix KV cache.
+
+CPU-only (JAX_PLATFORMS=cpu, no chip lock): the point is the RATIO
+between a cold prefill and a tier restore on identical hardware, and
+the per-tier plumbing invariants — not absolute chip numbers. One
+process hosts two engines sharing one in-process RESP fake:
+
+  engine A  T0 (2 pool rows) + T1 (host DRAM) + T2 (Redis write-through)
+  engine B  a "replica": T0 only + the same Redis — its first sight of
+            the shared prefix must restore from T2
+
+Scenario: a 512-token shared prefix (the shared-system-prompt shape)
+with per-request tails. Arms, all timed as client-observed TTFT
+(generate() -> first token):
+
+  cold     unrelated random prompts — full chunked prefill
+  t0_hit   shared prefix resident in an HBM pool row — one row copy
+  t1_hit   prefix evicted to host DRAM first — device_put + promote
+  t2_hit   replica engine, prefix only in Redis — fetch + promote
+
+Invariants checked every run (smoke included): every hit stream yields
+the EXACT tokens of a cache-free reference engine (int8 cache: tier
+round trips are lossless), and T1 and T2 must each actually serve hits.
+Full runs additionally gate: t0 hit TTFT >= 40% below cold.
+
+Output follows the bench stdout contract (tools/README.md): the LAST
+stdout line is the JSON artifact; earlier stdout lines are partial
+snapshots; progress goes to stderr. Full runs write KVCACHE_BENCH.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from gofr_tpu.datasource.redisclient import RedisClient  # noqa: E402
+from gofr_tpu.models import LLAMA_CONFIGS, llama  # noqa: E402
+from gofr_tpu.testutil.redisfake import FakeRedisServer  # noqa: E402
+from gofr_tpu.tpu import GenerationEngine  # noqa: E402
+from gofr_tpu.tpu.kvcache import KVCacheOptions  # noqa: E402
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def ttft_ms(eng, prompt, max_new=4):
+    """Client-observed TTFT: generate() call to first delivered token.
+    Drains the stream so the slot retires before the next probe."""
+    t0 = time.perf_counter()
+    stream = eng.generate(prompt, max_new_tokens=max_new)
+    it = iter(stream)
+    first = next(it)
+    ms = (time.perf_counter() - t0) * 1e3
+    toks = [first] + list(it)
+    return ms, toks
+
+
+class Harness:
+    def __init__(self, prefix_tokens: int, reps: int):
+        self.reps = reps
+        if prefix_tokens >= 512:
+            self.cfg = dataclasses.replace(LLAMA_CONFIGS["tiny"],
+                                           max_seq=1024)
+            self.buckets = (32, 64, 128, 256, 512)
+            max_seq, store_min, self.block = 1024, 256, 32
+        else:  # smoke geometry
+            self.cfg = LLAMA_CONFIGS["tiny"]
+            self.buckets = (8, 16, 32)
+            max_seq, store_min, self.block = 128, 16, 8
+        self.params = llama.init(self.cfg, jax.random.PRNGKey(1))
+        self.rng = np.random.default_rng(42)
+        self.prefix = self.rng.integers(
+            1, self.cfg.vocab_size, prefix_tokens).tolist()
+        self.tail_n = self.buckets[0] // 2
+        self.srv = FakeRedisServer()
+
+        def eng(**kw):
+            return GenerationEngine(
+                self.cfg, self.params, slots=2, max_seq=max_seq,
+                prompt_buckets=self.buckets, kv_dtype=jnp.int8,
+                prefix_store_min=store_min, **kw)
+
+        log("kvcache_bench: building engines (A=3 tiers, B=replica, "
+            "M=no cache)")
+        self.a = eng(prefix_cache_slots=2, kvcache=KVCacheOptions(
+            block=self.block, host_mb=256, epoch_refresh_s=0.0,
+            redis=RedisClient(self.srv.host, self.srv.port)))
+        self.b = eng(prefix_cache_slots=2, kvcache=KVCacheOptions(
+            block=self.block, host_mb=0, epoch_refresh_s=0.0,
+            redis=RedisClient(self.srv.host, self.srv.port)))
+        self.miss = eng()
+
+    def close(self):
+        self.a.close()
+        self.b.close()
+        self.miss.close()
+        self.srv.close()
+
+    def tail(self):
+        return self.rng.integers(1, self.cfg.vocab_size,
+                                 self.tail_n).tolist()
+
+    def rand_prompt(self):
+        return self.rng.integers(1, self.cfg.vocab_size,
+                                 len(self.prefix)).tolist()
+
+    def evict_t0(self, eng):
+        """Push two unrelated stored prompts through — with 2 pool
+        rows, anything previously resident leaves T0."""
+        for _ in range(2):
+            eng.generate(self.rand_prompt(), max_new_tokens=1).tokens()
+
+    def warm(self):
+        """Compile every program each arm will hit, OFF the clock:
+        bucket prefills + chunk lattice (warmup()), then one store /
+        T0-hit / T1-promote / T2-fetch cycle with a throwaway prefix."""
+        log("kvcache_bench: warmup (compiles)")
+        for e in (self.a, self.b, self.miss):
+            e.warmup()
+        warm_prefix = self.rng.integers(
+            1, self.cfg.vocab_size, len(self.prefix)).tolist()
+        self.a.generate(warm_prefix + self.tail(), max_new_tokens=1).tokens()
+        self.a.generate(warm_prefix + self.tail(), max_new_tokens=1).tokens()
+        self.evict_t0(self.a)   # spill -> T1
+        self.a.generate(warm_prefix + self.tail(), max_new_tokens=1).tokens()
+        self.b.generate(warm_prefix + self.tail(), max_new_tokens=1).tokens()
+        self.evict_t0(self.b)
+        self.miss.generate(warm_prefix + self.tail(),
+                           max_new_tokens=1).tokens()
+
+    # -- arms ---------------------------------------------------------------
+    def arm_cold(self):
+        out = []
+        for _ in range(self.reps):
+            ms, _ = ttft_ms(self.a, self.rand_prompt() + self.tail())
+            out.append(ms)
+        return out
+
+    def arm_t0(self, probe_tail, want):
+        # plant the shared prefix, then time repeat hits
+        self.a.generate(self.prefix + self.tail(), max_new_tokens=1).tokens()
+        out, exact = [], True
+        for i in range(self.reps):
+            tail = probe_tail if i == 0 else self.tail()
+            ms, toks = ttft_ms(self.a, self.prefix + tail)
+            out.append(ms)
+            if i == 0:
+                exact = toks == want
+        return out, exact
+
+    def arm_t1(self, probe_tail, want):
+        out, exact = [], True
+        for i in range(self.reps):
+            self.evict_t0(self.a)  # spill the prefix entries to host
+            tail = probe_tail if i == 0 else self.tail()
+            ms, toks = ttft_ms(self.a, self.prefix + tail)
+            out.append(ms)
+            if i == 0:
+                exact = toks == want
+        return out, exact
+
+    def arm_t2(self, probe_tail, want):
+        out, exact = [], True
+        for i in range(self.reps):
+            self.evict_t0(self.b)  # host tier off: only Redis has it
+            tail = probe_tail if i == 0 else self.tail()
+            ms, toks = ttft_ms(self.b, self.prefix + tail)
+            out.append(ms)
+            if i == 0:
+                exact = toks == want
+        return out, exact
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="reduced CI run; exits non-zero on invariant "
+                         "breaks (no artifact file)")
+    ap.add_argument("--out", default="KVCACHE_BENCH.json",
+                    help="artifact path (full runs only)")
+    ap.add_argument("--prefix-tokens", type=int, default=None)
+    ap.add_argument("--reps", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    prefix_tokens = args.prefix_tokens or (64 if args.smoke else 512)
+    reps = args.reps or (2 if args.smoke else 5)
+
+    h = Harness(prefix_tokens, reps)
+    artifact = {
+        "bench": "kvcache-tiered-ttft",
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "mode": "cpu",
+        "smoke": bool(args.smoke),
+        "scenario": {
+            "model": f"tiny(max_seq={h.cfg.max_seq})",
+            "kv_dtype": "int8",
+            "prefix_tokens": prefix_tokens,
+            "tail_tokens": h.tail_n,
+            "block": h.block,
+            "pool_rows": 2,
+            "reps": reps,
+        },
+    }
+    try:
+        h.warm()
+        probe_tail = h.tail()
+        _, want = ttft_ms(h.miss, h.prefix + probe_tail)  # reference
+
+        log("kvcache_bench: cold arm")
+        cold = h.arm_cold()
+        print(json.dumps({"partial": "hit arms pending",
+                          "cold_ms": cold}), flush=True)
+        log("kvcache_bench: t0 arm")
+        t0, exact0 = h.arm_t0(probe_tail, want)
+        log("kvcache_bench: t1 arm")
+        t1, exact1 = h.arm_t1(probe_tail, want)
+        log("kvcache_bench: t2 arm (replica via Redis)")
+        t2, exact2 = h.arm_t2(probe_tail, want)
+
+        st_a = h.a.stats()["prefix_cache"]["tiers"]
+        st_b = h.b.stats()["prefix_cache"]["tiers"]
+        med = statistics.median
+        cold_p50 = med(cold)
+        artifact["ttft_ms"] = {
+            "cold_p50": round(cold_p50, 3),
+            "t0_hit_p50": round(med(t0), 3),
+            "t1_hit_p50": round(med(t1), 3),
+            "t2_hit_p50": round(med(t2), 3),
+        }
+        artifact["improvement_pct"] = {
+            t: round(100 * (1 - artifact["ttft_ms"][f"{t}_hit_p50"]
+                            / cold_p50), 1)
+            for t in ("t0", "t1", "t2")}
+        artifact["tier_hits"] = {
+            "t0": st_a["t0"]["hits"],
+            "t1": st_a["t1"]["hits"],
+            "t2": st_b["t2"]["hits"],
+        }
+        artifact["exact_tokens"] = bool(exact0 and exact1 and exact2)
+        artifact["redis"] = {k: st_a["t2"][k] for k in
+                             ("blocks_put", "bytes_put", "errors")}
+
+        failures = []
+        if not artifact["exact_tokens"]:
+            failures.append("hit streams diverged from the cache-free "
+                            "reference")
+        if artifact["tier_hits"]["t1"] < 1:
+            failures.append("T1 served no hits in the scenario")
+        if artifact["tier_hits"]["t2"] < 1:
+            failures.append("T2 served no hits in the scenario")
+        if artifact["redis"]["errors"]:
+            failures.append(f"redis tier errors: {artifact['redis']}")
+        if not args.smoke:
+            # acceptance thresholds only on full runs — smoke geometry
+            # (64-token prefix) is not the 512-token claim
+            if artifact["improvement_pct"]["t0"] < 40:
+                failures.append(
+                    f"t0 hit TTFT only {artifact['improvement_pct']['t0']}% "
+                    "below cold (< 40%)")
+    except Exception as e:  # noqa: BLE001 — artifact over traceback
+        failures = [f"harness error: {e!r}"]
+        artifact["error"] = repr(e)
+    finally:
+        h.close()
+
+    if failures:
+        artifact["failures"] = failures
+    if not args.smoke and "error" not in artifact:
+        Path(args.out).write_text(json.dumps(artifact, indent=2) + "\n")
+        log(f"artifact written to {args.out}")
+    print(json.dumps(artifact), flush=True)
+    if failures:
+        log("FAIL: " + "; ".join(failures))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
