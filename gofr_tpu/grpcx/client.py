@@ -384,7 +384,12 @@ class GRPCChannel:
         return BidiCall(self, call, codec, response_codec, timeout)
 
     def close(self) -> None:
-        self._closed = True
+        # _closed is written under _lock everywhere else (_teardown);
+        # an unlocked flip here can interleave with a streamer checking
+        # it mid-open. io.close() stays outside: it wakes the read loop,
+        # whose _teardown needs the lock.
+        with self._lock:
+            self._closed = True
         self.io.close()
 
 

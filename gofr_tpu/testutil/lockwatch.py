@@ -1,0 +1,353 @@
+"""Lock-order watchdog: the runtime complement to gofrlint GL002.
+
+``go test -race`` observes real executions; this is the Python serving
+stack's equivalent for lock-ORDER bugs. A :class:`LockWatch` instruments
+lock acquisitions and maintains the global acquisition-order graph at
+runtime: acquiring B while holding A records the edge ``A -> B``, where
+nodes are lock *sites* (the ``file:line`` that created the lock — the
+lock's declaration, like a lockdep lock class, so every instance built
+by the same constructor shares one node). An edge that closes a cycle
+is an observed order INVERSION: two threads that hit the two orders
+concurrently would deadlock, even if this run got lucky. Inversions are
+recorded, never raised mid-acquire (raising inside an acquire could
+itself wedge the program under test).
+
+Two ways to instrument:
+
+  - explicit: ``watch.lock()`` / ``watch.rlock()`` build watched locks
+    registered only with that watch — what lockwatch's own tests use,
+    so a deliberately seeded inversion never leaks into a
+    session-level watch running over the same process;
+  - ambient: ``watch.install()`` monkeypatches ``threading.Lock`` /
+    ``threading.RLock`` so every lock created AFTERWARDS is watched
+    (module-import-time locks predate it and stay raw). This is what
+    ``pytest --lockwatch`` uses (tests/conftest.py): the tier-1
+    threaded suite runs with the framework's locks observed and the
+    session fails on any inversion.
+
+Semantics (mirrors kernel lockdep where it translates):
+
+  - only acquisitions that can BLOCK record edges — a
+    ``blocking=False`` try-acquire cannot participate in a deadlock;
+  - edges are recorded at ATTEMPT time: holding A and blocking on B is
+    the hazard whether or not the acquire eventually succeeds;
+  - re-acquiring a lock this thread already holds (RLock reentrancy)
+    records nothing;
+  - two locks from the SAME site never form an edge: per-connection
+    sibling locks have no defined order and would false-positive;
+  - ``Condition(watched_lock)`` works: the wait()-time full release
+    and reacquire flow through ``_release_save``/``_acquire_restore``.
+"""
+
+from __future__ import annotations
+
+import _thread
+import threading
+from typing import Any
+
+__all__ = ["LockOrderViolation", "LockWatch", "Violation"]
+
+# captured at import time, BEFORE any install() can monkeypatch it
+# (tests/conftest.py imports this module first, then installs)
+_RAW_RLOCK = threading.RLock
+
+
+class LockOrderViolation(AssertionError):
+    """Raised by :meth:`LockWatch.check` when inversions were observed."""
+
+
+class Violation:
+    """One observed order inversion: the edge that closed a cycle."""
+
+    __slots__ = ("cycle", "edge", "thread", "prior")
+
+    def __init__(self, cycle: list[str], edge: tuple[str, str],
+                 thread: str, prior: dict[tuple[str, str], str]):
+        self.cycle = cycle          # [A, B, ..., A] of lock sites
+        self.edge = edge            # the (A, B) that closed it
+        self.thread = thread        # thread that attempted the edge
+        self.prior = prior          # existing edges of the cycle -> thread
+
+    def __str__(self) -> str:
+        lines = [f"lock-order inversion: {' -> '.join(self.cycle)}",
+                 f"  new edge {self.edge[0]} -> {self.edge[1]} "
+                 f"in thread {self.thread!r}"]
+        for (a, b), thr in sorted(self.prior.items()):
+            lines.append(f"  prior edge {a} -> {b} in thread {thr!r}")
+        return "\n".join(lines)
+
+
+def _thread_name() -> str:
+    """current_thread().name WITHOUT threading.current_thread(): during
+    Thread._bootstrap the thread is not yet in threading._active, so
+    current_thread() constructs a _DummyThread — whose own Event then
+    acquires a watched lock, which asks for the thread name again:
+    infinite recursion, and the dying child leaves start() waiting on
+    _started forever."""
+    ident = _thread.get_ident()
+    t = threading._active.get(ident)
+    return t.name if t is not None else f"thread-{ident}"
+
+
+def _caller_site() -> str:
+    """file:line of the nearest frame outside lockwatch/threading."""
+    import sys
+
+    f = sys._getframe(1)
+    here = __file__
+    while f is not None:
+        fn = f.f_code.co_filename
+        if fn != here and not fn.endswith(("threading.py", "queue.py")):
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+class _WatchedLock:
+    """A threading.Lock wrapper reporting to one LockWatch.
+
+    Deliberately does NOT define ``_release_save``/``_acquire_restore``/
+    ``_is_owned``: threading.Condition probes those by attribute access
+    and must take its plain-lock fallback path (which flows through our
+    acquire/release and keeps the bookkeeping intact)."""
+
+    _reentrant = False
+
+    def __init__(self, watch: "LockWatch", inner: Any, site: str):
+        self._watch = watch
+        self._inner = inner
+        self.site = site
+        self._owner: int | None = None  # ident of the holding thread
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if blocking:
+            self._watch._note_attempt(self)
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._watch._note_acquired(self)
+        return ok
+
+    def release(self) -> None:
+        self._watch._note_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        kind = "RLock" if self._reentrant else "Lock"
+        return f"<watched {kind} from {self.site}>"
+
+    def _at_fork_reinit(self) -> None:
+        self._inner._at_fork_reinit()
+
+
+class _WatchedRLock(_WatchedLock):
+    """RLock wrapper: adds the threading.Condition wait() protocol."""
+
+    _reentrant = True
+
+    def _release_save(self):
+        # note BEFORE the inner release (same order as release()): once
+        # the inner lock is free, a racing acquirer owns it, and our
+        # late bookkeeping would clobber its ownership and get its live
+        # held entry pruned as stale. The watch-side recursion DEPTH
+        # rides on the saved state: wait() on an RLock held at depth n
+        # must restore to depth n, or the first release() afterwards
+        # pops the entry while the thread still owns the lock
+        depth = self._watch._note_release(self, full=True)
+        return (self._inner._release_save(), depth)
+
+    def _acquire_restore(self, state) -> None:
+        inner_state, depth = state
+        self._watch._note_attempt(self)
+        self._inner._acquire_restore(inner_state)
+        self._watch._note_acquired(self, depth=depth)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+class LockWatch:
+    """Runtime lock-acquisition-order recorder with inversion detection."""
+
+    def __init__(self, name: str = "lockwatch"):
+        self.name = name
+        # raw allocator: with install() active, threading.Lock is OUR
+        # factory — the watch's own mutex must never be watched
+        self._mu = _thread.allocate_lock()
+        self._tls = threading.local()
+        self.graph: dict[str, set[str]] = {}           # site -> successors
+        self.edges: dict[tuple[str, str], str] = {}    # edge -> thread name
+        self.violations: list[Violation] = []
+        self.acquisitions = 0
+        self._sites: set[str] = set()   # every site ever acquired
+        self._orig: tuple[Any, Any] | None = None
+
+    # -- lock factories ------------------------------------------------------
+    def lock(self, site: str | None = None) -> _WatchedLock:
+        return _WatchedLock(self, _thread.allocate_lock(),
+                            site or _caller_site())
+
+    def rlock(self, site: str | None = None) -> _WatchedRLock:
+        # ALWAYS the module-import-time raw ctor: threading.RLock may
+        # currently be an ambient factory (this watch's own under
+        # install(), or a session watch's under --lockwatch), and a
+        # watched inner lock would double-report every acquisition into
+        # that other watch
+        return _WatchedRLock(self, _RAW_RLOCK(), site or _caller_site())
+
+    # -- ambient instrumentation --------------------------------------------
+    def install(self) -> None:
+        """Patch threading.Lock/RLock so every lock created from now on
+        is watched. Idempotent per watch; uninstall() restores."""
+        if self._orig is not None:
+            return
+        self._orig = (threading.Lock, threading.RLock)
+
+        def make_lock(*a: Any, **k: Any) -> _WatchedLock:
+            return self.lock()
+
+        def make_rlock(*a: Any, **k: Any) -> _WatchedLock:
+            return self.rlock()
+
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+
+    def uninstall(self) -> None:
+        if self._orig is None:
+            return
+        threading.Lock, threading.RLock = self._orig
+        self._orig = None
+
+    def __enter__(self) -> "LockWatch":
+        self.install()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.uninstall()
+
+    # -- bookkeeping ---------------------------------------------------------
+    def _held(self) -> list[list[Any]]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held  # entries: [lock, depth]
+
+    def _note_attempt(self, lk: _WatchedLock) -> None:
+        """About to BLOCK on ``lk``: record edges held -> lk and detect
+        cycles. Runs before the inner acquire — holding A and blocking
+        on B is the hazard even if this particular acquire times out."""
+        held = self._held()
+        ident = _thread.get_ident()
+        # prune entries whose lock another thread has since released (a
+        # plain Lock used as a HANDOFF: A acquires, B releases — legal,
+        # and without pruning A's stale entry would later read as a
+        # phantom self-deadlock and contribute bogus order edges)
+        if any(e[0]._owner != ident for e in held):
+            held[:] = [e for e in held if e[0]._owner == ident]
+        for e in held:
+            if e[0] is lk:
+                if lk._reentrant:
+                    return  # RLock re-acquire: no ordering information
+                # blocking on a non-reentrant lock this thread already
+                # holds: guaranteed self-deadlock — record it before the
+                # inner acquire hangs
+                with self._mu:
+                    self.violations.append(Violation(
+                        [lk.site, lk.site], (lk.site, lk.site),
+                        _thread_name(), {}))
+                return
+        new_edges = [(e[0].site, lk.site) for e in held
+                     if e[0].site != lk.site]
+        if not new_edges:
+            return
+        thread = _thread_name()
+        with self._mu:
+            for a, b in new_edges:
+                if (a, b) in self.edges:
+                    continue
+                cycle = self._find_path(b, a)
+                self.graph.setdefault(a, set()).add(b)
+                self.edges[(a, b)] = thread
+                if cycle is not None:
+                    full = [a, b] + cycle[1:]
+                    prior = {
+                        (full[i], full[i + 1]):
+                            self.edges.get((full[i], full[i + 1]), "?")
+                        for i in range(1, len(full) - 1)}
+                    self.violations.append(
+                        Violation(full, (a, b), thread, prior))
+
+    def _find_path(self, src: str, dst: str) -> list[str] | None:
+        """DFS path src -> dst in the current graph (caller holds _mu)."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self.graph.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def _note_acquired(self, lk: _WatchedLock, depth: int = 1) -> None:
+        held = self._held()
+        lk._owner = _thread.get_ident()
+        for e in held:
+            if e[0] is lk:
+                e[1] += depth
+                return
+        held.append([lk, max(1, depth)])
+        with self._mu:   # shared counter: += is not atomic across threads
+            self.acquisitions += 1
+            self._sites.add(lk.site)
+
+    def _note_release(self, lk: _WatchedLock, full: bool = False) -> int:
+        """Returns the recursion depth being released (the FULL depth
+        when ``full=True`` — _release_save threads it through the saved
+        state so _acquire_restore can put it back)."""
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is lk:
+                depth = held[i][1]
+                held[i][1] = 0 if full else held[i][1] - 1
+                if held[i][1] <= 0:
+                    held.pop(i)
+                    lk._owner = None
+                return depth if full else 1
+        # not held by THIS thread: a cross-thread handoff release — mark
+        # the lock free so the owner's stale entry is pruned on its next
+        # attempt
+        lk._owner = None
+        return 1
+
+    # -- reporting -----------------------------------------------------------
+    def summary(self) -> dict:
+        with self._mu:
+            return {
+                "watch": self.name,
+                "acquisitions": self.acquisitions,
+                # acquired sites UNION edge endpoints (an attempt that
+                # never succeeded still contributes an edge)
+                "sites": len(self._sites | set(self.graph)
+                             | {b for s in self.graph.values() for b in s}),
+                "edges": len(self.edges),
+                "violations": [str(v) for v in self.violations],
+            }
+
+    def check(self) -> None:
+        """Raise LockOrderViolation if any inversion was observed."""
+        if self.violations:
+            report = "\n\n".join(str(v) for v in self.violations)
+            raise LockOrderViolation(
+                f"{self.name}: {len(self.violations)} lock-order "
+                f"inversion(s) observed:\n{report}")

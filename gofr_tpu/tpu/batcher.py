@@ -148,7 +148,11 @@ class CoalescingBatcher:
                 item_id = self._next_id
                 self._items[item_id] = item
             if not self._native.push(item_id):
-                self._items.pop(item_id, None)
+                # under the lock: close() fails-and-clears _items while
+                # iterating it, and an unlocked pop here can resurface
+                # mid-iteration
+                with self._lock:
+                    self._items.pop(item_id, None)
                 raise BatcherClosed(f"{self.name} is closed")
         else:
             with self._lock:

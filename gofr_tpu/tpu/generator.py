@@ -2101,11 +2101,16 @@ class GenerationEngine:
                         # the next admission rewarns the new pool from
                         # them instead of paying a full prefill
                         self._kvc.clear_device()
-                for idx, slot in enumerate(self._slots):
-                    if slot.request is not None:
-                        slot.request.stream.failed = repr(e)
-                        slot.request.stream._q.put(err)
-                        self._retire(idx, slot)
+                # under the device lock: _retire mutates _active/_table/
+                # _cursors, and warmup()/swap_adapter() on OTHER threads
+                # hold the lock while reading slot state — an unlocked
+                # retire here could free a slot mid-warmup-prefill
+                with self._device_lock:
+                    for idx, slot in enumerate(self._slots):
+                        if slot.request is not None:
+                            slot.request.stream.failed = repr(e)
+                            slot.request.stream._q.put(err)
+                            self._retire(idx, slot)
                 try:
                     with self._device_lock:
                         # the PRNG key chains THROUGH dispatches now: an
@@ -2283,6 +2288,9 @@ class GenerationEngine:
         return _Inflight((toks, lps, emit), functools.partial(
             self._verify_reap, toks, lps, emit, snap_active, snap_reqs))
 
+    # invoked through _Inflight.reap, always under the engine's device
+    # lock (see _loop) — the partial hides that from static call-graph
+    # inference  # gl: holds self._device_lock
     def _verify_reap(self, toks, lps, emit, snap_active, snap_reqs) -> None:
         toks_np, lps_np, emit_np = jax.device_get((toks, lps, emit))
         self._spec_windows += int(snap_active.sum())
@@ -2353,6 +2361,8 @@ class GenerationEngine:
         return _Inflight((toks, lps), functools.partial(
             self._decode_reap, toks, lps, snap_active, snap_reqs))
 
+    # invoked through _Inflight.reap, always under the engine's device
+    # lock (see _loop)  # gl: holds self._device_lock
     def _decode_reap(self, toks, lps, snap_active, snap_reqs) -> None:
         toks_np, lps_np = jax.device_get((toks, lps))  # [K, B] each
         if self.metrics is not None:

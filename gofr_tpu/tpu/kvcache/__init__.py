@@ -113,10 +113,13 @@ def model_fingerprint(cfg, params=None, extra: str = "") -> str:
             leaves = jax.tree_util.tree_leaves(params)
             picks = sorted({0, len(leaves) // 3, (2 * len(leaves)) // 3,
                             len(leaves) - 1})
-            for i in picks:
-                sample = np.asarray(jax.device_get(
-                    leaves[i].reshape(-1)[:8])).astype(np.float32)
-                h.update(sample.tobytes())
+            # ONE batched transfer for all sampled leaves (device_get
+            # takes a pytree) — per-leaf gets would sync the host once
+            # per pick
+            samples = jax.device_get(
+                [leaves[i].reshape(-1)[:8] for i in picks])
+            for sample in samples:
+                h.update(np.asarray(sample).astype(np.float32).tobytes())
         except Exception:
             pass
     return h.hexdigest()[:16]

@@ -151,7 +151,7 @@ class SocketWriter:
                     # unsent tail goes back to the FRONT: bytes parked by
                     # other threads during this send came later
                     self._backlog[:0] = rest
-                self.deferred += 1
+                    self.deferred += 1
                 return False
             return True
         finally:

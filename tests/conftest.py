@@ -54,6 +54,30 @@ assert len(jax.devices()) == 8, "tests expect a virtual 8-device CPU mesh"
 import pytest
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--lockwatch", action="store_true", default=False,
+        help="instrument threading.Lock/RLock with the lock-order "
+             "watchdog (gofr_tpu.testutil.lockwatch) and fail the "
+             "session on any observed order inversion — this repo's "
+             "`go test -race`")
+
+
+def pytest_configure(config):
+    if config.getoption("--lockwatch"):
+        from gofr_tpu.testutil.lockwatch import LockWatch
+
+        watch = LockWatch(name="pytest-session")
+        watch.install()
+        config._lockwatch = watch
+
+
+def pytest_unconfigure(config):
+    watch = getattr(config, "_lockwatch", None)
+    if watch is not None:
+        watch.uninstall()
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _release_compiled_executables_between_modules():
     """Cap the process's memory-map count. Every compiled XLA executable
@@ -105,7 +129,8 @@ def _release_compiled_executables_between_modules():
 
 
 def pytest_sessionfinish(session, exitstatus):
-    """Fail loudly on leaked worker threads (VERDICT r3 weak #6: a
+    """Fail loudly on (a) lock-order inversions observed by a
+    --lockwatch run and (b) leaked worker threads (VERDICT r3 weak #6: a
     circuit breaker outlived its server and health-probed a dead port
     every 5 s after `314 passed`). Every framework thread — engine
     loops, breaker probes, JWKS refreshers, pollers — is named and must
@@ -113,6 +138,20 @@ def pytest_sessionfinish(session, exitstatus):
     threads mid-teardown."""
     import threading
     import time
+
+    failures = []
+    watch = getattr(session.config, "_lockwatch", None)
+    if watch is not None:
+        s = watch.summary()
+        print(f"\nlockwatch: {s['acquisitions']} acquisitions, "  # noqa: T201
+              f"{s['sites']} lock sites, {s['edges']} order edges, "
+              f"{len(s['violations'])} inversion(s)")
+        # collect, don't raise yet: an inversion must not mask the
+        # leaked-thread gate below — both checks always run
+        try:
+            watch.check()
+        except AssertionError as exc:
+            failures.append(str(exc))
 
     def suspects():
         return [
@@ -123,21 +162,29 @@ def pytest_sessionfinish(session, exitstatus):
                  or "probe" in t.name or "poller" in t.name)
         ]
 
-    deadline = time.monotonic() + 5.0
-    while time.monotonic() < deadline:
-        if not suspects():
-            return
-        time.sleep(0.2)
-    # A gofr-tpu-gen loop thread can legitimately outlive close()'s join:
-    # it may be BLOCKED inside a device dispatch (a chunk-program compile
-    # takes 30-60 s on the virtual CPU mesh) and exits as soon as the
-    # dispatch returns — that is winding-down, not a leak. Give only
-    # those threads a compile-sized drain before failing.
-    if all(t.name == "gofr-tpu-gen" for t in suspects()):
-        deadline = time.monotonic() + 120.0
+    def drained() -> bool:
+        deadline = time.monotonic() + 5.0
         while time.monotonic() < deadline:
             if not suspects():
-                return
-            time.sleep(1.0)
-    names = sorted(t.name for t in suspects())
-    raise RuntimeError(f"leaked framework threads after test session: {names}")
+                return True
+            time.sleep(0.2)
+        # A gofr-tpu-gen loop thread can legitimately outlive close()'s
+        # join: it may be BLOCKED inside a device dispatch (a
+        # chunk-program compile takes 30-60 s on the virtual CPU mesh)
+        # and exits as soon as the dispatch returns — that is
+        # winding-down, not a leak. Give only those threads a
+        # compile-sized drain before failing.
+        if all(t.name == "gofr-tpu-gen" for t in suspects()):
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                if not suspects():
+                    return True
+                time.sleep(1.0)
+        return False
+
+    if not drained():
+        names = sorted(t.name for t in suspects())
+        failures.append(
+            f"leaked framework threads after test session: {names}")
+    if failures:
+        raise RuntimeError("\n\n".join(failures))

@@ -1,0 +1,5 @@
+"""B006 negative: None default."""
+
+
+def f(a=None):
+    return a or []

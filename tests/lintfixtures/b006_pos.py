@@ -1,0 +1,5 @@
+"""Seeded B006: mutable default argument."""
+
+
+def f(a=[]):  # EXPECT: B006
+    return a

@@ -1,0 +1,2 @@
+"""B011 negative: assert with a message."""
+assert 1, "fine"

@@ -1,0 +1,2 @@
+"""Seeded B011: assert on a non-empty tuple is always true."""
+assert (1, "always true")  # EXPECT: B011

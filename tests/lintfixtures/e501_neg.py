@@ -1,0 +1,2 @@
+"""E501 negative: under the limit."""
+y = "bbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbbb"

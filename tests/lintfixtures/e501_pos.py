@@ -1,0 +1,2 @@
+"""Seeded E501: line over 100 columns."""
+x = "aaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaa"  # EXPECT: E501

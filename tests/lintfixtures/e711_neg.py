@@ -1,0 +1,3 @@
+"""E711 negative: identity comparison."""
+x = 1
+ok = x is None

@@ -1,0 +1,3 @@
+"""Seeded E711: equality comparison to None."""
+x = 1
+ok = x == None  # EXPECT: E711

@@ -1,0 +1,5 @@
+"""E722 negative: typed except."""
+try:
+    x = 1
+except ValueError:
+    x = 2

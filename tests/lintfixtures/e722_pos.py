@@ -1,0 +1,5 @@
+"""Seeded E722: bare except."""
+try:
+    x = 1
+except:  # EXPECT: E722
+    x = 2

@@ -1,0 +1,2 @@
+"""E999 negative: parses fine."""
+x = 1

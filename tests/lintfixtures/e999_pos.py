@@ -1,0 +1,2 @@
+"""Seeded E999: syntax error."""
+def f(:

@@ -1,0 +1,7 @@
+"""F401 negatives: used import, re-export idiom, __all__ listing."""
+import os
+import sys as sys
+import json
+
+__all__ = ["json"]
+X = os.getpid()

@@ -1,0 +1,4 @@
+"""Seeded F401: module-scope import never used."""
+import os  # EXPECT: F401
+
+X = 1

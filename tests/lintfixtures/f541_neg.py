@@ -1,0 +1,4 @@
+"""F541 negative: format specs parse as nested placeholder-less
+JoinedStr and must stay silent."""
+x = 1.5
+s = f"{x:.2f}"

@@ -1,0 +1,2 @@
+"""Seeded F541: f-string without placeholders."""
+s = f"static"  # EXPECT: F541

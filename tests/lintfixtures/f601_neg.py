@@ -1,0 +1,2 @@
+"""F601 negative: distinct keys."""
+D = {"a": 1, "b": 2}

@@ -1,0 +1,6 @@
+"""Seeded F601: duplicate literal dict key."""
+D = {
+    "a": 1,
+    "b": 2,
+    "a": 3,  # EXPECT: F601
+}

@@ -1,0 +1,14 @@
+"""F811 negative: @overload stubs legitimately re-bind the name."""
+from typing import overload
+
+
+@overload
+def f(x: int) -> int: ...
+
+
+@overload
+def f(x: str) -> str: ...
+
+
+def f(x):
+    return x

@@ -1,0 +1,9 @@
+"""Seeded F811: plain top-level redefinition."""
+
+
+def f():
+    return 1
+
+
+def f():  # EXPECT: F811
+    return 2

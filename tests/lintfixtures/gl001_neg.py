@@ -1,0 +1,21 @@
+"""GL001 negatives: every shared write guarded; __init__ writes and a
+private helper called only under the lock are exempt."""
+import threading
+
+
+class SafeCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._reset()
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def zero(self):
+        with self._lock:
+            self._reset()
+
+    def _reset(self):
+        self._n = 0

@@ -1,0 +1,15 @@
+"""Seeded GL001: a write to a lock-guarded attribute outside the lock."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def sneak(self):
+        self._n = 0  # EXPECT: GL001

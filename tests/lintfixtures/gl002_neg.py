@@ -1,0 +1,18 @@
+"""GL002 negative: both paths honor one global order (a before b)."""
+import threading
+
+
+class OrderedTransfer:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def audit(self):
+        with self._a:
+            with self._b:
+                return True

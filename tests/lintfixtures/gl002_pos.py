@@ -1,0 +1,19 @@
+"""Seeded GL002: the two methods acquire the same two locks in
+opposite orders — a potential deadlock."""
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:  # EXPECT: GL002
+                pass
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                pass

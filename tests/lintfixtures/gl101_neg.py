@@ -1,0 +1,22 @@
+"""GL101 negatives: cold paths (warmup) and one-shot syncs outside
+loops are allowed; recovery except-handlers may block."""
+import jax
+
+
+def warmup(xs):
+    for x in xs:
+        jax.device_get(x)
+
+
+def fetch_once(x):
+    return jax.device_get(x)
+
+
+def resilient_loop(xs):
+    out = []
+    for x in xs:
+        try:
+            out.append(int(len(out)))
+        except RuntimeError:
+            jax.device_get(x)
+    return out

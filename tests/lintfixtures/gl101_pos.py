@@ -1,0 +1,18 @@
+"""Seeded GL101: host syncs inside decode-style loops (fixture lands
+under a scaffold gofr_tpu/tpu/)."""
+import jax
+
+
+def decode_loop(xs):
+    out = []
+    for x in xs:
+        out.append(jax.device_get(x))  # EXPECT: GL101
+    return out
+
+
+def step_loop(tokens):
+    total = 0
+    while tokens:
+        t = tokens.pop()
+        total += t.item()  # EXPECT: GL101
+    return total

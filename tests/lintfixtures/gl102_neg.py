@@ -1,0 +1,28 @@
+"""GL102 negatives: shape tests are trace-static, statics marked via
+static_argnames branch freely, tuples are hashable statics."""
+import jax
+
+
+@jax.jit
+def pad(x):
+    if x.shape[0] > 8:
+        return x
+    return x
+
+
+@jax.jit
+def norm(x, mode=0):
+    if x.ndim > 1:
+        return x
+    return x
+
+
+def _impl(x, cfg):
+    return x
+
+
+step = jax.jit(_impl, static_argnums=(1,))
+
+
+def run(x):
+    return step(x, (1, 2))

@@ -1,0 +1,21 @@
+"""Seeded GL102: a Python branch on a traced parameter, and an
+unhashable literal at a static position."""
+import jax
+
+
+@jax.jit
+def scale(x, n):
+    if n > 0:  # EXPECT: GL102
+        return x * n
+    return x
+
+
+def _impl(x, cfg):
+    return x
+
+
+step = jax.jit(_impl, static_argnums=(1,))
+
+
+def run(x):
+    return step(x, [1, 2])  # EXPECT: GL102

@@ -1,0 +1,16 @@
+"""GL103 negatives: locals inside jit are fine; module containers may
+be mutated OUTSIDE traced code."""
+import jax
+
+_RESULTS = []
+
+
+@jax.jit
+def pure(x):
+    acc = []
+    acc.append(x)
+    return acc[0] * 2
+
+
+def collect(x):
+    _RESULTS.append(pure(x))

@@ -1,0 +1,18 @@
+"""Seeded GL103: writes escaping a traced function leak tracers."""
+import jax
+
+_TRACE_LOG = []
+_last = None
+
+
+@jax.jit
+def leaky(x):
+    _TRACE_LOG.append(x)  # EXPECT: GL103
+    return x * 2
+
+
+@jax.jit
+def stash(x):
+    global _last
+    _last = x  # EXPECT: GL103
+    return x

@@ -1,0 +1,4 @@
+"""T201 negative: CLI command output opts out per line."""
+import sys
+
+print("result", file=sys.stderr)  # noqa: T201 — command output

@@ -1,0 +1,3 @@
+"""Seeded T201: bare print in framework code (fixture lands under a
+scaffold gofr_tpu/)."""
+print("debugging")  # EXPECT: T201

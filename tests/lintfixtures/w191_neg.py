@@ -1,0 +1,5 @@
+"""W191 negative: four-space indentation."""
+
+
+def g():
+    return 2

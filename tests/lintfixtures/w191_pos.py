@@ -1,0 +1,3 @@
+"""Seeded W191: tab indentation on line 3."""
+def f():
+	return 1

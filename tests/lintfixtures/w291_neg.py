@@ -1,0 +1,5 @@
+"""W291/W191 negative: no trailing whitespace, space indentation."""
+
+
+def f():
+    return 1

@@ -1,0 +1,2 @@
+"""Seeded W291: trailing whitespace on line 2."""
+x = 1   

@@ -1,0 +1,383 @@
+"""Tests for tools/gofrlint — the multi-pass static analyzer.
+
+Three layers, mirroring the acceptance criteria:
+
+  1. the fixture corpus (tests/lintfixtures/): every rule catches its
+     seeded positive at the exact path:line:code and stays silent on
+     its negative;
+  2. `# noqa` generality: suppression is applied centrally, so EVERY
+     rule — style, lock discipline, TPU hot-path — honors both bare
+     `# noqa` and `# noqa: CODE`, and a wrong code suppresses nothing;
+  3. the CLI contract: baseline workflow (new findings AND stale
+     entries fail), `--stats` last-line JSON, and the repo itself
+     reporting zero unbaselined findings against the checked-in
+     baseline — the CI `analysis` job's exact invocation.
+
+Fixtures are scaffolded under a throwaway project root with a
+pyproject.toml and a gofr_tpu/tpu/ package dir, because the lock and
+hot-path passes (and T201) only analyze framework-pathed files.
+"""
+
+import json
+import re
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "lintfixtures"
+
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.gofrlint import run as gofrlint_run  # noqa: E402
+
+ALL_FIXTURES = sorted(FIXTURES.glob("*.py"))
+POSITIVES = [p for p in ALL_FIXTURES if p.name.endswith("_pos.py")]
+NEGATIVES = [p for p in ALL_FIXTURES if p.name.endswith("_neg.py")]
+
+ALL_CODES = {"F401", "F811", "E501", "E711", "E722", "B006", "B011",
+             "F601", "F541", "W291", "W191", "T201", "E999",
+             "GL001", "GL002", "GL101", "GL102", "GL103"}
+
+# Fixtures whose finding line cannot carry an inline `# EXPECT:` marker:
+# a comment would remove the trailing whitespace (W291), sit on a
+# tab-indented line the marker scan can't survive (W191), or live in a
+# file that doesn't tokenize (E999).
+HARDCODED_EXPECT = {
+    "e999_pos.py": [(2, "E999")],
+    "w291_pos.py": [(2, "W291")],
+    "w191_pos.py": [(3, "W191")],
+}
+
+
+def expected_findings(fixture: Path) -> list[tuple[int, str]]:
+    if fixture.name in HARDCODED_EXPECT:
+        return HARDCODED_EXPECT[fixture.name]
+    out = []
+    for i, line in enumerate(fixture.read_text().splitlines(), 1):
+        m = re.search(r"# EXPECT: ([A-Z][A-Z0-9]+)", line)
+        if m:
+            out.append((i, m.group(1)))
+    return out
+
+
+def scaffold(tmp_path: Path, name: str, source: str | None = None,
+             fixture: Path | None = None) -> Path:
+    """Drop a file at <tmp>/proj/gofr_tpu/tpu/<name> with a
+    pyproject.toml project root above it, so in_framework() and the
+    GL101 tpu-scope both classify it as framework code."""
+    proj = tmp_path / "proj"
+    pkg = proj / "gofr_tpu" / "tpu"
+    pkg.mkdir(parents=True, exist_ok=True)
+    (proj / "pyproject.toml").write_text('[project]\nname = "scaffold"\n')
+    dst = pkg / name
+    if fixture is not None:
+        shutil.copyfile(fixture, dst)
+    else:
+        dst.write_text(source)
+    return dst
+
+
+def analyze(path: Path) -> list[tuple[int, str]]:
+    findings, _ = gofrlint_run([path])
+    return [(f.line, f.code) for f in findings]
+
+
+# -- corpus shape ------------------------------------------------------------
+
+def test_corpus_covers_every_rule():
+    names = {p.stem for p in ALL_FIXTURES}
+    missing = [c for c in sorted(ALL_CODES)
+               if f"{c.lower()}_pos" not in names
+               or f"{c.lower()}_neg" not in names]
+    assert not missing, f"rules without a pos+neg fixture pair: {missing}"
+    assert len(ALL_FIXTURES) == 2 * len(ALL_CODES)
+
+
+# -- positives: exact path:line:code -----------------------------------------
+
+@pytest.mark.parametrize("fixture", POSITIVES, ids=lambda p: p.stem)
+def test_positive_fixture_exact_findings(tmp_path, fixture):
+    dst = scaffold(tmp_path, fixture.name, fixture=fixture)
+    findings, n_files = gofrlint_run([dst])
+    assert n_files == 1
+    got = sorted((f.line, f.code) for f in findings)
+    assert got == sorted(expected_findings(fixture)), \
+        "\n".join(str(f) for f in findings)
+    for f in findings:
+        # exact `path:line: CODE msg` rendering, path as given
+        assert str(f).startswith(f"{dst}:{f.line}: {f.code} ")
+
+
+@pytest.mark.parametrize("fixture", NEGATIVES, ids=lambda p: p.stem)
+def test_negative_fixture_stays_silent(tmp_path, fixture):
+    dst = scaffold(tmp_path, fixture.name, fixture=fixture)
+    assert analyze(dst) == []
+
+
+def test_overlapping_roots_analyze_each_file_once(tmp_path):
+    # `gofrlint proj proj/gofr_tpu` must not double-count findings —
+    # a duplicate would also read as a phantom regression against the
+    # baseline multiset
+    dst = scaffold(tmp_path, "mod.py", "import os\n\nX = 1\n")  # F401
+    proj = dst.parents[2]
+    findings, n_files = gofrlint_run([proj, dst.parent, dst])
+    assert n_files == 1
+    assert [(f.line, f.code) for f in findings] == [(1, "F401")]
+
+
+# -- noqa generality ---------------------------------------------------------
+
+def _noqa_variant(fixture: Path, replacement: str) -> str | None:
+    """The fixture source with each `# EXPECT: CODE` marker swapped for
+    a noqa-style comment; None when the fixture cannot express one."""
+    if fixture.name == "e999_pos.py":
+        return None  # does not tokenize: noqa can never apply
+    if fixture.name == "w291_pos.py":
+        return f"x = 1  {replacement % 'W291'}   \n"
+    if fixture.name == "w191_pos.py":
+        return f"def f():\n\treturn 1  {replacement % 'W191'}\n"
+    return re.sub(r"# EXPECT: ([A-Z][A-Z0-9]+)",
+                  lambda m: replacement % m.group(1), fixture.read_text())
+
+
+NOQA_ABLE = [p for p in POSITIVES if p.name != "e999_pos.py"]
+
+
+@pytest.mark.parametrize("fixture", NOQA_ABLE, ids=lambda p: p.stem)
+def test_noqa_with_code_suppresses(tmp_path, fixture):
+    src = _noqa_variant(fixture, "# noqa: %s")
+    dst = scaffold(tmp_path, fixture.name, source=src)
+    assert analyze(dst) == [], f"# noqa: CODE did not suppress\n{src}"
+
+
+@pytest.mark.parametrize("fixture", NOQA_ABLE, ids=lambda p: p.stem)
+def test_bare_noqa_suppresses(tmp_path, fixture):
+    # the %s placeholder lands in prose after the marker — still bare
+    src = _noqa_variant(fixture, "# noqa (was %s)")
+    dst = scaffold(tmp_path, fixture.name, source=src)
+    assert analyze(dst) == [], f"bare # noqa did not suppress\n{src}"
+
+
+@pytest.mark.parametrize("fixture", NOQA_ABLE, ids=lambda p: p.stem)
+def test_wrong_code_noqa_does_not_suppress(tmp_path, fixture):
+    src = _noqa_variant(fixture, "# noqa: ZZZ9  # was %s")
+    dst = scaffold(tmp_path, fixture.name, source=src)
+    got = {code for _, code in analyze(dst)}
+    want = {code for _, code in expected_findings(fixture)}
+    assert want <= got, f"# noqa: ZZZ9 wrongly suppressed {want - got}"
+
+
+def test_noqa_inside_string_literal_grants_nothing(tmp_path):
+    dst = scaffold(tmp_path, "sneaky.py",
+                   'print("see the # noqa: T201 docs")\n')
+    assert (1, "T201") in analyze(dst)
+
+
+def test_e999_is_not_noqa_suppressible(tmp_path):
+    # a file that does not tokenize can never earn suppression
+    dst = scaffold(tmp_path, "broken.py", "def f(:  # noqa\n")
+    assert analyze(dst) == [(1, "E999")]
+
+
+# -- CLI / baseline contract -------------------------------------------------
+
+def run_cli(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "tools.gofrlint", *args],
+        capture_output=True, text=True, cwd=str(REPO))
+
+
+def test_baseline_roundtrip(tmp_path):
+    dst = scaffold(tmp_path, "mod.py", "import os\n\nX = 1\n")  # F401
+    base = tmp_path / "base.json"
+
+    p = run_cli(str(dst))
+    assert p.returncode == 1 and "F401" in p.stdout
+
+    p = run_cli(str(dst), "--write-baseline", str(base))
+    assert p.returncode == 0
+    data = json.loads(base.read_text())
+    assert data["version"] == 1 and len(data["findings"]) == 1
+
+    # baselined -> clean
+    p = run_cli(str(dst), "--baseline", str(base))
+    assert p.returncode == 0 and "F401" not in p.stdout
+
+    # a NEW finding on top of the baselined one -> exit 1, only the new
+    # one reported
+    dst.write_text("import os\nimport sys\n\nX = 1\n")
+    p = run_cli(str(dst), "--baseline", str(base))
+    assert p.returncode == 1
+    assert "'sys'" in p.stdout and "'os'" not in p.stdout
+
+    # finding FIXED but baseline entry kept -> stale -> exit 1
+    dst.write_text("X = 1\n")
+    p = run_cli(str(dst), "--baseline", str(base))
+    assert p.returncode == 1 and "STALE" in p.stdout
+
+
+def test_baseline_keys_survive_line_churn(tmp_path):
+    # baseline identity is path::code::message — edits ABOVE a finding
+    # must not invalidate its entry
+    dst = scaffold(tmp_path, "mod.py", "import os\n\nX = 1\n")
+    base = tmp_path / "base.json"
+    run_cli(str(dst), "--write-baseline", str(base))
+    dst.write_text("# a new leading comment\nimport os\n\nX = 1\n")
+    p = run_cli(str(dst), "--baseline", str(base))
+    assert p.returncode == 0, p.stdout
+
+
+def test_baseline_keys_survive_embedded_line_references(tmp_path):
+    # some MESSAGES embed line numbers ('redefinition ... from line N')
+    # — key() normalizes digits so those entries don't churn either
+    src = "def f():\n    return 1\n\n\ndef f():\n    return 2\n"  # F811
+    dst = scaffold(tmp_path, "mod.py", src)
+    base = tmp_path / "base.json"
+    run_cli(str(dst), "--write-baseline", str(base))
+    dst.write_text("# pushed down\n# two lines\n" + src)
+    p = run_cli(str(dst), "--baseline", str(base))
+    assert p.returncode == 0, p.stdout
+
+
+def test_stats_last_line_json_contract(tmp_path):
+    dst = scaffold(tmp_path, "mod.py", "import os\n\nX = 1\n")
+    p = run_cli(str(dst), "--stats")
+    assert p.returncode == 1
+    obj = json.loads(p.stdout.strip().splitlines()[-1])
+    assert obj["tool"] == "gofrlint"
+    assert obj["files"] == 1 and obj["findings"] == 1 and obj["new"] == 1
+    assert obj["by_code"] == {"F401": 1} and obj["ok"] is False
+
+
+def test_select_filters_by_prefix(tmp_path):
+    src = ("import threading\nimport os\n\n\nclass C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._n = 0\n\n"
+           "    def a(self):\n"
+           "        with self._lock:\n"
+           "            self._n = 1\n\n"
+           "    def b(self):\n"
+           "        self._n = 2\n")
+    dst = scaffold(tmp_path, "mod.py", src)  # F401(os) + GL001
+    p = run_cli(str(dst), "--select", "GL0")
+    assert p.returncode == 1
+    assert "GL001" in p.stdout and "F401" not in p.stdout
+
+
+def test_gl002_cycle_through_shared_module_lock(tmp_path):
+    # a module-level lock is ONE node in the order graph no matter
+    # which class acquires it — per-class node ids would split it and
+    # hide this real cross-class deadlock
+    src = ("import threading\n\n"
+           "_MOD = threading.Lock()\n\n\n"
+           "class A:\n"
+           "    def __init__(self):\n"
+           "        self._la = threading.Lock()\n\n"
+           "    def one(self):\n"
+           "        with self._la:\n"
+           "            with _MOD:\n"
+           "                pass\n\n"
+           "    def two(self):\n"
+           "        with _MOD:\n"
+           "            with self._la:\n"
+           "                pass\n")
+    dst = scaffold(tmp_path, "mod.py", src)
+    got = analyze(dst)
+    assert (12, "GL002") in got, got  # the inner `with _MOD:` in one()
+
+
+def test_gl002_same_named_module_locks_stay_distinct(tmp_path):
+    # same-NAMED module locks in different files are different locks:
+    # opposite nestings across the two modules are not a cycle
+    # SAME class name + SAME lock attr in both files: if the two _MOD
+    # locks collapsed into one node, C._la -> _MOD -> C._la would read
+    # as a cycle — a false positive
+    src_a = ("import threading\n\n_MOD = threading.Lock()\n\n\n"
+             "class C:\n"
+             "    def __init__(self):\n"
+             "        self._la = threading.Lock()\n\n"
+             "    def one(self):\n"
+             "        with self._la:\n"
+             "            with _MOD:\n"
+             "                pass\n")
+    src_b = ("import threading\n\n_MOD = threading.Lock()\n\n\n"
+             "class C:\n"
+             "    def __init__(self):\n"
+             "        self._la = threading.Lock()\n\n"
+             "    def one(self):\n"
+             "        with _MOD:\n"
+             "            with self._la:\n"
+             "                pass\n")
+    scaffold(tmp_path, "mod_a.py", src_a)
+    dst_b = scaffold(tmp_path, "mod_b.py", src_b)
+    findings, _ = gofrlint_run([dst_b.parent])
+    assert [(f.line, f.code) for f in findings] == []
+
+
+def test_write_baseline_refuses_select(tmp_path):
+    dst = scaffold(tmp_path, "mod.py", "import os\n\nX = 1\n")
+    base = tmp_path / "base.json"
+    p = run_cli(str(dst), "--select", "GL0",
+                "--write-baseline", str(base))
+    assert p.returncode == 2
+    assert "refusing" in p.stderr
+    assert not base.exists()
+
+
+def test_select_with_baseline_does_not_fake_stale(tmp_path):
+    # --select filters findings BEFORE the baseline diff: entries for
+    # unselected codes must not be reported as stale
+    dst = scaffold(tmp_path, "mod.py", "import os\n\nX = 1\n")  # F401
+    base = tmp_path / "base.json"
+    run_cli(str(dst), "--write-baseline", str(base))
+    p = run_cli(str(dst), "--select", "GL0", "--baseline", str(base))
+    assert p.returncode == 0, p.stdout
+    assert "STALE" not in p.stdout
+
+
+def test_gl101_cold_path_prefixes_exempt_underscored_names(tmp_path):
+    # `_warm_pool` / `load_x` / `_load_x` are cold paths — the prefix
+    # match runs on the name with leading underscores stripped
+    src = ("import jax\n\n\ndef _warm_pool(xs):\n"
+           "    for x in xs:\n        jax.device_get(x)\n\n\n"
+           "def _load_rows(xs):\n"
+           "    for x in xs:\n        jax.device_get(x)\n\n\n"
+           "def hot(xs):\n"
+           "    for x in xs:\n        jax.device_get(x)\n")
+    dst = scaffold(tmp_path, "mod.py", src)
+    got = analyze(dst)
+    assert got == [(16, "GL101")], got  # only hot() flagged
+
+
+def test_repo_reports_zero_unbaselined_findings():
+    """The CI `analysis` job's exact gate: the checked-in baseline
+    covers the whole repo, with no stale entries."""
+    p = run_cli("--baseline", "tools/gofrlint_baseline.json", "--stats")
+    assert p.returncode == 0, p.stdout
+    obj = json.loads(p.stdout.strip().splitlines()[-1])
+    assert obj["ok"] is True
+    assert obj["new"] == 0 and obj["stale_baseline"] == 0
+    assert obj["files"] > 100  # really scanned the repo
+
+
+# -- regression: the modules fixed in this PR stay clean ---------------------
+
+FIXED_MODULES = [
+    "gofr_tpu/tpu/batcher.py",        # GL001: reap outside the lock
+    "gofr_tpu/tpu/generator.py",      # GL001: retire loop outside device lock
+    "gofr_tpu/tpu/kvcache/__init__.py",  # GL101: per-leaf device_get loop
+    "gofr_tpu/wire.py",               # GL001: deferred count outside _blk
+    "gofr_tpu/grpcx/client.py",       # GL001: unlocked _closed flip
+]
+
+
+@pytest.mark.parametrize("mod", FIXED_MODULES)
+def test_fixed_module_stays_clean(mod):
+    findings, _ = gofrlint_run([REPO / mod])
+    assert [(f.line, f.code, f.msg) for f in findings] == []

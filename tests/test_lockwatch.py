@@ -1,0 +1,380 @@
+"""Tests for gofr_tpu/testutil/lockwatch.py — the runtime lock-order
+watchdog (this repo's `go test -race`, complementing gofrlint GL002).
+
+The seeded-inversion test here is the acceptance proof: a deliberate
+A->B / B->A order split across two threads MUST be detected, while the
+instrumented tier-1 threaded suite (pytest --lockwatch, wired in
+tests/conftest.py and the CI `analysis` job) must report none.
+
+Every test builds its locks EXPLICITLY via watch.lock()/watch.rlock()
+on a private LockWatch — the seeded inversions never leak into a
+session-level ambient watch running over the same process.
+"""
+
+import threading
+import time
+
+import pytest
+
+from gofr_tpu.testutil.lockwatch import (LockOrderViolation, LockWatch,
+                                         Violation)
+
+
+def run_in_thread(fn, name):
+    t = threading.Thread(target=fn, name=name)
+    t.start()
+    t.join(5)
+    assert not t.is_alive()
+
+
+def test_seeded_inversion_detected():
+    watch = LockWatch(name="seeded")
+    a = watch.lock("siteA")
+    b = watch.lock("siteB")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def backward():
+        with b:
+            with a:
+                pass
+
+    run_in_thread(forward, "fwd")
+    run_in_thread(backward, "bwd")
+
+    assert len(watch.violations) == 1
+    v = watch.violations[0]
+    assert v.edge == ("siteB", "siteA")  # the edge that closed the cycle
+    assert v.cycle[0] == "siteB" and v.cycle[-1] == "siteB"
+    assert v.thread == "bwd"
+    assert v.prior == {("siteA", "siteB"): "fwd"}
+    assert "siteA" in str(v) and "siteB" in str(v)
+    with pytest.raises(LockOrderViolation) as exc:
+        watch.check()
+    assert "1 lock-order inversion" in str(exc.value)
+
+
+def test_consistent_order_is_clean():
+    watch = LockWatch(name="clean")
+    a = watch.lock("siteA")
+    b = watch.lock("siteB")
+
+    def ordered():
+        with a:
+            with b:
+                pass
+
+    for i in range(3):
+        run_in_thread(ordered, f"t{i}")
+    assert watch.violations == []
+    assert set(watch.edges) == {("siteA", "siteB")}
+    watch.check()  # must not raise
+
+
+def test_three_lock_cycle_detected():
+    # A->B, B->C, then C->A closes a 3-cycle no single pair exhibits
+    watch = LockWatch(name="tri")
+    a, b, c = (watch.lock(s) for s in ("sA", "sB", "sC"))
+
+    def nest(outer, inner):
+        def body():
+            with outer:
+                with inner:
+                    pass
+        return body
+
+    run_in_thread(nest(a, b), "t1")
+    run_in_thread(nest(b, c), "t2")
+    assert watch.violations == []
+    run_in_thread(nest(c, a), "t3")
+    assert len(watch.violations) == 1
+    assert set(watch.violations[0].cycle) == {"sA", "sB", "sC"}
+
+
+def test_try_acquire_records_no_edge():
+    # a blocking=False acquire cannot participate in a deadlock: no
+    # edge, so the later reverse order is not an inversion
+    watch = LockWatch(name="try")
+    a = watch.lock("siteA")
+    b = watch.lock("siteB")
+
+    def trylock():
+        with a:
+            assert b.acquire(blocking=False)
+            b.release()
+
+    def reverse():
+        with b:
+            with a:
+                pass
+
+    run_in_thread(trylock, "t1")
+    run_in_thread(reverse, "t2")
+    assert ("siteA", "siteB") not in watch.edges
+    assert watch.violations == []
+
+
+def test_rlock_reentrancy_records_nothing():
+    watch = LockWatch(name="rlock")
+    r = watch.rlock("siteR")
+    with r:
+        with r:
+            pass
+    assert watch.violations == [] and watch.edges == {}
+
+
+def test_self_deadlock_on_plain_lock_recorded():
+    # blocking on a non-reentrant lock the thread already holds is a
+    # guaranteed deadlock — recorded at attempt time, before the inner
+    # acquire can hang
+    watch = LockWatch(name="self")
+    lk = watch.lock("siteL")
+    assert lk.acquire()
+    assert lk.acquire(blocking=True, timeout=0.01) is False
+    lk.release()
+    assert len(watch.violations) == 1
+    assert watch.violations[0].edge == ("siteL", "siteL")
+
+
+def test_same_site_locks_never_form_an_edge():
+    # per-connection sibling locks share a creation site and have no
+    # defined order — both nestings must stay silent
+    watch = LockWatch(name="sibling")
+    a = watch.lock("shared")
+    b = watch.lock("shared")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert watch.edges == {} and watch.violations == []
+
+
+def test_ambient_install_watches_new_locks_and_uninstall_restores():
+    watch = LockWatch(name="ambient")
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+    with watch:
+        lk = threading.Lock()
+        rl = threading.RLock()
+        assert getattr(lk, "_watch", None) is watch, "ambient lock not watched"
+        with lk:
+            with rl:
+                pass
+    # uninstall restores whatever was installed before — under a
+    # session-level `pytest --lockwatch` that is the SESSION's factory,
+    # so assert relative to the snapshot, not absolute rawness
+    assert threading.Lock is orig_lock and threading.RLock is orig_rlock
+    assert watch.acquisitions >= 2
+    # a lock created after uninstall never reports to THIS watch
+    assert getattr(threading.Lock(), "_watch", None) is not watch
+
+
+def test_condition_over_watched_rlock_wait_notify():
+    # Condition(watched_rlock) goes through _release_save /
+    # _acquire_restore: wait() must fully release and restore without
+    # corrupting the held-set bookkeeping or faking an inversion. The
+    # waiter captures its own exceptions: a bookkeeping crash inside
+    # _acquire_restore kills only the worker thread and would otherwise
+    # pass silently.
+    watch = LockWatch(name="cond")
+    r = watch.rlock("siteC")
+    cond = threading.Condition(r)
+    ready = []
+    errors = []
+
+    def waiter():
+        try:
+            with cond:
+                while not ready:
+                    cond.wait(timeout=2)
+        except BaseException as exc:  # noqa: B036 - thread boundary
+            errors.append(exc)
+
+    t = threading.Thread(target=waiter, name="cond-waiter")
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        ready.append(1)
+        cond.notify()
+    t.join(5)
+    assert not t.is_alive()
+    assert errors == []
+    assert watch.violations == []
+    assert watch._held() == []  # main thread holds nothing afterwards
+
+
+def test_condition_wait_restores_rlock_depth():
+    # wait() while holding the rlock at recursion depth 2: the saved
+    # state carries the watch-side depth through _release_save /
+    # _acquire_restore, so the two releases after wait() must land the
+    # entry at exactly zero — not pop early (depth lost) or linger
+    # (depth doubled)
+    watch = LockWatch(name="cond-depth")
+    r = watch.rlock("siteD")
+    cond = threading.Condition(r)
+    ready = []
+    errors = []
+
+    def waiter():
+        try:
+            with r:                      # depth 1
+                with cond:               # same rlock: depth 2
+                    while not ready:
+                        cond.wait(timeout=2)
+                    # restored to depth 2: one release keeps ownership
+                assert r._inner._is_owned()
+                held = watch._held()
+                assert [e[1] for e in held if e[0] is r] == [1]
+            assert not r._inner._is_owned()
+            assert all(e[0] is not r for e in watch._held())
+        except BaseException as exc:  # noqa: B036 - thread boundary
+            errors.append(exc)
+
+    t = threading.Thread(target=waiter, name="depth-waiter")
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        ready.append(1)
+        cond.notify()
+    t.join(5)
+    assert not t.is_alive()
+    assert errors == []
+    assert watch.violations == []
+
+
+def test_condition_over_watched_plain_lock_wait_notify():
+    # plain watched Lock lacks the _release_save protocol on purpose:
+    # Condition must take its fallback path, which still flows through
+    # our acquire/release
+    watch = LockWatch(name="cond-plain")
+    lk = watch.lock("siteP")
+    cond = threading.Condition(lk)
+    ready = []
+    errors = []
+
+    def waiter():
+        try:
+            with cond:
+                while not ready:
+                    cond.wait(timeout=2)
+        except BaseException as exc:  # noqa: B036 - thread boundary
+            errors.append(exc)
+
+    t = threading.Thread(target=waiter, name="cond-plain-waiter")
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        ready.append(1)
+        cond.notify()
+    t.join(5)
+    assert not t.is_alive()
+    assert errors == []
+    assert watch.violations == []
+
+
+def test_cross_thread_handoff_release_no_phantom_violation():
+    # a plain Lock used as a handoff (A acquires, B releases) is legal:
+    # the owner's stale held entry must be pruned, not read back as a
+    # self-deadlock when A re-acquires the now-free lock
+    watch = LockWatch(name="handoff")
+    lk = watch.lock("siteH")
+    assert lk.acquire()
+    run_in_thread(lk.release, "releaser")
+    assert lk.acquire(blocking=True, timeout=1)
+    lk.release()
+    assert watch.violations == []
+
+
+def test_handoff_stale_entry_contributes_no_bogus_edges():
+    # ...and the stale entry must not feed order edges for later
+    # acquisitions either
+    watch = LockWatch(name="handoff-edges")
+    lk = watch.lock("siteH")
+    m = watch.lock("siteM")
+    assert lk.acquire()
+    run_in_thread(lk.release, "releaser")
+    with m:
+        pass
+    assert ("siteH", "siteM") not in watch.edges
+    assert watch.violations == []
+
+
+def test_condition_wait_handoff_keeps_racing_owner_alive():
+    # _release_save must update bookkeeping BEFORE freeing the inner
+    # lock: a racing acquirer that wins immediately must keep its
+    # ownership (and its held entry) intact
+    watch = LockWatch(name="cond-race")
+    r = watch.rlock("siteC")
+    m = watch.lock("siteM")
+    cond = threading.Condition(r)
+    ready = []
+    errors = []
+
+    def waiter():
+        try:
+            with cond:
+                while not ready:
+                    cond.wait(timeout=2)
+        except BaseException as exc:  # noqa: B036 - thread boundary
+            errors.append(exc)
+
+    t = threading.Thread(target=waiter, name="race-waiter")
+    t.start()
+    time.sleep(0.05)
+    # while the waiter sits in wait() (inner released), acquire the
+    # SAME rlock and nest another lock under it: the edge siteC ->
+    # siteM must be recorded, proving our held entry wasn't pruned
+    with r:
+        with m:
+            pass
+    assert ("siteC", "siteM") in watch.edges
+    with cond:
+        ready.append(1)
+        cond.notify()
+    t.join(5)
+    assert not t.is_alive()
+    assert errors == []
+    assert watch.violations == []
+
+
+def test_private_rlock_does_not_leak_into_ambient_watch():
+    # with a session-style ambient watch installed, a private watch's
+    # rlock must build its inner lock from the RAW RLock — otherwise
+    # every acquisition double-reports into the session watch and a
+    # seeded inversion would fail the whole session
+    ambient = LockWatch(name="ambient-session")
+    with ambient:
+        private = LockWatch(name="private")
+        r = private.rlock("siteR")
+        before = ambient.acquisitions
+        with r:
+            pass
+        assert private.acquisitions == 1
+        assert ambient.acquisitions == before
+
+
+def test_summary_shape():
+    watch = LockWatch(name="sum")
+    a = watch.lock("sA")
+    b = watch.lock("sB")
+    with a:
+        with b:
+            pass
+    s = watch.summary()
+    assert s["watch"] == "sum"
+    assert s["acquisitions"] == 2
+    assert s["sites"] == 2 and s["edges"] == 1
+    assert s["violations"] == []
+
+
+def test_violation_str_lists_prior_edges():
+    v = Violation(["A", "B", "A"], ("A", "B"), "t-new",
+                  {("B", "A"): "t-old"})
+    text = str(v)
+    assert "A -> B -> A" in text
+    assert "new edge A -> B in thread 't-new'" in text
+    assert "prior edge B -> A in thread 't-old'" in text

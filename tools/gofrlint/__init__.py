@@ -1,0 +1,73 @@
+"""gofrlint: the repo's multi-pass static analyzer.
+
+Reference parity: the reference GoFr CI blocks on golangci-lint and
+`go test -race` (.github/workflows/go.yml:231-239). This package is the
+Python equivalent, grown from the single-file tools/lint.py fallback
+linter into three passes:
+
+  style    — the original hermetic rule set (F401/F811/E501/E711/E722/
+             B006/B011/F601/F541/W291/W191/T201/E999)
+  locks    — GL001 unguarded writes to lock-guarded attributes,
+             GL002 lock-acquisition-order cycles (potential deadlocks)
+  hotpath  — GL101 host syncs inside decode/step/dispatch loops,
+             GL102 jit recompile hazards, GL103 tracer leakage
+
+Every rule honors `# noqa` / `# noqa: CODE` line suppression (applied
+centrally). Accepted findings live in tools/gofrlint_baseline.json; CI
+runs `python -m tools.gofrlint --baseline tools/gofrlint_baseline.json`
+and fails on new findings AND on stale baseline entries. The runtime
+complement (the lock-order watchdog that is this repo's `go test
+-race`) is gofr_tpu/testutil/lockwatch.py, enabled over the threaded
+tier-1 tests with `pytest --lockwatch`.
+
+See docs/advanced-guide/static-analysis.md for the rule catalog.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from . import hotpath, locks, style
+from .base import Finding, SourceFile, collect_files
+
+__all__ = ["Finding", "SourceFile", "collect_files", "run"]
+
+_REPO = Path(__file__).resolve().parent.parent.parent
+
+
+def _rel(path: Path) -> str:
+    """Repo-relative display/baseline path: keys in
+    tools/gofrlint_baseline.json must not depend on where the checkout
+    lives or the invoking cwd. Paths outside the repo stay as given."""
+    try:
+        return path.resolve().relative_to(_REPO).as_posix()
+    except ValueError:
+        return str(path)
+
+
+def run(roots: list[Path], select: set[str] | None = None
+        ) -> tuple[list[Finding], int]:
+    """Run every pass over ``roots``. Returns (findings after noqa
+    suppression, number of files analyzed). ``select`` limits output to
+    the given codes (prefix match: "GL1" selects GL101/GL102/GL103)."""
+    files = collect_files(roots)
+    lock_pass = locks.LockPass()
+    hot_pass = hotpath.HotPathPass()
+    findings: list[Finding] = []
+    sources: dict[str, SourceFile] = {}
+    for path in files:
+        sf = SourceFile(path, _rel(path))
+        sources[sf.rel] = sf
+        findings.extend(style.run(sf))
+        lock_pass.feed(sf)
+        hot_pass.feed(sf)
+    findings.extend(lock_pass.finish())
+    findings.extend(hot_pass.findings)
+    findings = [f for f in findings
+                if f.path not in sources
+                or not sources[f.path].suppressed(f)]
+    if select:
+        findings = [f for f in findings
+                    if any(f.code.startswith(s) for s in select)]
+    findings.sort(key=lambda f: (f.path, f.line, f.code, f.msg))
+    return findings, len(files)

@@ -1,0 +1,103 @@
+"""CLI: ``python -m tools.gofrlint [paths...]``.
+
+Exit codes: 0 clean (or everything baselined), 1 new findings and/or
+stale baseline entries, 2 usage error. With ``--stats`` the LAST stdout
+line is a JSON summary (tools/README.md stdout contract: everything
+above it is human-readable progress).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter
+from pathlib import Path
+
+from . import baseline as baseline_mod
+from . import run
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.gofrlint",
+        description="multi-pass static analyzer (style + lock discipline "
+                    "+ TPU hot-path)")
+    ap.add_argument("paths", nargs="*", type=Path,
+                    help="files/dirs to analyze (default: the repo)")
+    ap.add_argument("--select", action="append", default=None,
+                    metavar="CODES",
+                    help="comma-separated code prefixes (GL0,E501,...)")
+    ap.add_argument("--baseline", type=Path, default=None,
+                    help="accepted-findings file; fail only on new "
+                         "findings and stale entries")
+    ap.add_argument("--write-baseline", type=Path, default=None,
+                    metavar="PATH",
+                    help="write the current findings as the baseline "
+                         "and exit 0")
+    ap.add_argument("--stats", action="store_true",
+                    help="emit a last-line JSON summary")
+    args = ap.parse_args(argv)
+
+    roots = args.paths or [REPO]
+    select = None
+    if args.select:
+        select = {c.strip().upper()
+                  for chunk in args.select for c in chunk.split(",")
+                  if c.strip()}
+    findings, n_files = run(roots, select)
+
+    if args.write_baseline is not None:
+        if select:
+            # a select-filtered write would silently DROP every
+            # accepted finding for the unselected codes
+            print("gofrlint: refusing --write-baseline with --select "
+                  "(the baseline must cover every code)", file=sys.stderr)
+            return 2
+        baseline_mod.write(args.write_baseline, findings)
+        print(f"wrote {len(findings)} finding(s) to {args.write_baseline}")
+        return 0
+
+    new, stale = findings, []
+    if args.baseline is not None:
+        try:
+            accepted = baseline_mod.load(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"gofrlint: cannot load baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+        if select:
+            # --select filtered the findings, so entries for UNselected
+            # codes must not read as stale (key: path::code::message)
+            accepted = type(accepted)({
+                k: v for k, v in accepted.items()
+                if any(k.split("::")[1].startswith(s) for s in select)})
+        new, stale = baseline_mod.compare(findings, accepted)
+
+    for f in new:
+        print(f)
+    for key in stale:
+        print(f"STALE baseline entry (finding fixed — delete it): {key}")
+    failed = bool(new or stale)
+    if not args.stats:
+        print(f"{len(new)} new finding(s), {len(stale)} stale baseline "
+              f"entr(ies), {n_files} file(s)", file=sys.stderr)
+    else:
+        by_code = Counter(f.code for f in findings)
+        print(json.dumps({
+            "tool": "gofrlint",
+            "files": n_files,
+            "findings": len(findings),
+            "new": len(new),
+            "stale_baseline": len(stale),
+            "baselined": len(findings) - len(new),
+            "by_code": {k: by_code[k] for k in sorted(by_code)},
+            "ok": not failed,
+        }, sort_keys=False))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,159 @@
+"""Shared plumbing for the gofrlint passes.
+
+Findings, source-file discovery, the parsed-file container every pass
+consumes, and `# noqa` suppression. Suppression is CENTRAL: a pass
+emits every finding unconditionally and the runner filters against the
+file's comment map, so `# noqa` / `# noqa: CODE` behave identically
+for every rule (style, lock-discipline, TPU hot-path) instead of each
+rule growing its own half-implementation.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from pathlib import Path
+
+MAX_LINE = 100
+# lintfixtures: the analyzer's own seeded-positive test corpus
+# (tests/lintfixtures/) — never part of a repo-wide run
+SKIP_DIRS = {".git", "__pycache__", ".ruff_cache", "node_modules",
+             ".pytest_cache", "build", "dist", "lintfixtures"}
+
+# `# noqa` (bare: every code) or `# noqa: GL001, E501` (listed codes),
+# optionally followed by prose (`# noqa: T201 — command output`).
+# Case-insensitive on the marker, but it must open a `#` segment of the
+# comment — `noqa` appearing in prose ("see the noqa docs") does not
+# suppress anything.
+_NOQA_RE = re.compile(
+    r"#+\s*noqa\b(?::\s*(?P<codes>[A-Z][A-Z0-9]*(?:\s*,\s*[A-Z][A-Z0-9]*)*))?",
+    re.IGNORECASE)
+
+
+class Finding:
+    __slots__ = ("path", "line", "code", "msg")
+
+    def __init__(self, path: str, line: int, code: str, msg: str):
+        self.path, self.line, self.code, self.msg = path, line, code, msg
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.msg}"
+
+    def key(self) -> str:
+        """Line-independent identity used by the baseline: edits above a
+        finding must not churn baseline entries. Digits in the message
+        are normalized away too — several messages embed line numbers
+        ('redefinition ... from line N') or site counts ('at N other
+        site(s)') that move with unrelated edits."""
+        return f"{self.path}::{self.code}::" \
+               f"{re.sub(r'[0-9]+', '#', self.msg)}"
+
+
+class SourceFile:
+    """One parsed source file, shared by every pass (parse once)."""
+
+    def __init__(self, path: Path, rel: str):
+        self.path = path
+        self.rel = rel
+        self.source = path.read_text(encoding="utf-8", errors="replace")
+        self.tree: ast.AST | None = None
+        self.syntax_error: SyntaxError | None = None
+        try:
+            self.tree = ast.parse(self.source, filename=rel)
+        except SyntaxError as e:
+            self.syntax_error = e
+        self._comments: dict[int, str] | None = None
+
+    # -- comments / noqa ---------------------------------------------------
+    @property
+    def comments(self) -> dict[int, str]:
+        """lineno -> comment token text. tokenize, not a '#' scan: a '#'
+        inside a string literal is not a comment and grants nothing."""
+        if self._comments is None:
+            self._comments = {}
+            try:
+                for tok in tokenize.generate_tokens(
+                        io.StringIO(self.source).readline):
+                    if tok.type == tokenize.COMMENT:
+                        self._comments[tok.start[0]] = tok.string
+            except (tokenize.TokenError, IndentationError, SyntaxError):
+                pass
+        return self._comments
+
+    def noqa_codes(self, line: int) -> frozenset[str] | None:
+        """None = no noqa on this line; empty frozenset = bare `# noqa`
+        (suppress everything); otherwise the listed codes (uppercased)."""
+        m = _NOQA_RE.search(self.comments.get(line, ""))
+        if m is None:
+            return None
+        codes = m.group("codes")
+        if codes is None:
+            return frozenset()
+        return frozenset(c.strip().upper() for c in codes.split(",")
+                         if c.strip())
+
+    def suppressed(self, finding: Finding) -> bool:
+        if finding.code == "E999":
+            # tokenize lexes comments even in files that do not PARSE,
+            # but a syntax error blinds every AST pass — a file that
+            # cannot be analyzed is never clean, noqa or not
+            return False
+        codes = self.noqa_codes(finding.line)
+        if codes is None:
+            return False
+        return not codes or finding.code in codes
+
+
+def _self_attr(node: ast.expr) -> str | None:
+    """``self.X`` -> ``"X"``, else None — shared by the lock and
+    hot-path passes so both agree on what counts as a self-write."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def project_parts(path: Path) -> tuple[str, ...]:
+    """Path components relative to the enclosing project root (nearest
+    pyproject.toml ancestor). Scope checks anchor here so a checkout at
+    e.g. /home/tpu/work/repo — or one itself named gofr_tpu, the
+    natural clone name — does not change what any rule applies to."""
+    p = path.resolve()
+    for anc in p.parents:
+        if (anc / "pyproject.toml").is_file():
+            return p.relative_to(anc).parts
+    return p.parts
+
+
+def in_framework(path: Path) -> bool:
+    """Is this file part of the gofr_tpu PACKAGE?"""
+    return "gofr_tpu" in project_parts(path)
+
+
+def collect_files(roots: list[Path]) -> list[Path]:
+    # dedupe on resolved paths: overlapping roots (`gofrlint gofr_tpu
+    # gofr_tpu/tpu`) must not analyze a file twice — the duplicate
+    # findings would double-count against the baseline multiset and
+    # report phantom regressions
+    files: list[Path] = []
+    seen: set[Path] = set()
+
+    def add(p: Path) -> None:
+        rp = p.resolve()
+        if rp not in seen:
+            seen.add(rp)
+            files.append(p)
+
+    for r in roots:
+        if r.is_file():
+            add(r)
+            continue
+        for p in sorted(r.rglob("*.py")):
+            if any(part in SKIP_DIRS for part in p.parts):
+                continue
+            if p.name.endswith("_pb2.py"):  # protoc-generated
+                continue
+            add(p)
+    return files

@@ -1,0 +1,54 @@
+"""Baseline: accepted findings checked into the repo.
+
+The baseline is a multiset of line-INDEPENDENT finding keys
+(``path::code::message``) so edits above an accepted finding do not
+churn entries. CI fails on BOTH directions of drift:
+
+  - a current finding with no baseline entry  -> new (regression);
+  - a baseline entry with no current finding  -> stale (the finding was
+    fixed — delete the entry so it cannot mask a future regression).
+
+``--write-baseline`` regenerates the file from the current findings.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+
+from .base import Finding
+
+VERSION = 1
+
+
+def load(path: Path) -> Counter:
+    data = json.loads(path.read_text(encoding="utf-8"))
+    if data.get("version") != VERSION:
+        raise ValueError(f"unsupported baseline version: {data.get('version')}")
+    return Counter(data.get("findings", {}))
+
+
+def write(path: Path, findings: list[Finding]) -> None:
+    counts = Counter(f.key() for f in findings)
+    data = {
+        "version": VERSION,
+        "comment": "accepted gofrlint findings; regenerate with "
+                   "`python -m tools.gofrlint --write-baseline`",
+        "findings": {k: counts[k] for k in sorted(counts)},
+    }
+    path.write_text(json.dumps(data, indent=1) + "\n", encoding="utf-8")
+
+
+def compare(findings: list[Finding], accepted: Counter
+            ) -> tuple[list[Finding], list[str]]:
+    """(new findings not in the baseline, stale baseline keys)."""
+    remaining = Counter(accepted)
+    new: list[Finding] = []
+    for f in findings:
+        if remaining[f.key()] > 0:
+            remaining[f.key()] -= 1
+        else:
+            new.append(f)
+    stale = sorted(k for k, n in remaining.items() if n > 0 for _ in range(n))
+    return new, stale

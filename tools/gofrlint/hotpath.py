@@ -1,0 +1,451 @@
+"""TPU hot-path pass: GL101 (host syncs in loops), GL102 (jit recompile
+hazards), GL103 (tracer leakage).
+
+GL101 — scope ``gofr_tpu/tpu/``. One host synchronization inside a
+decode/step/dispatch loop turns an async dispatch pipeline into a
+lockstep crawl; the serving-loop contract is ONE transfer per dispatched
+block (tools/README.md timing conventions). Flagged inside any
+``for``/``while``/comprehension body:
+
+  - ``jax.device_get(...)`` / ``<x>.device_get(...)``
+  - ``jax.block_until_ready(...)``
+  - ``<x>.item()``
+  - ``np.asarray/np.array/float/int`` over an expression that touches a
+    DEVICE-resident attribute (attrs assigned from ``*_jit`` calls,
+    ``jax.device_put``, ``jnp.*`` constructors, ``PRNGKey``) or the
+    direct result of a ``*_jit`` call.
+
+Cold paths are exempt: functions named warmup/close/drain/stats/
+health_check (+ ``_warm*``/``load_*``), ``__init__``, and everything
+inside ``except`` handlers (recovery is allowed to block).
+
+GL102 — scope ``gofr_tpu/``. Two recompile/trace hazards around
+``jax.jit``: (a) a Python ``if``/``while`` on a traced parameter inside
+a jitted function (TracerBoolConversionError at best, silent per-value
+recompiles via static fallbacks at worst) — parameters bound static via
+``static_argnums/static_argnames`` or ``functools.partial`` are
+excluded, as are shape/dtype/ndim/len() tests (static under trace) and
+``is None`` pytree-structure checks; (b) a list/dict/set literal passed
+at a static position of a known-jitted callable — unhashable statics
+raise on every call.
+
+GL103 — scope ``gofr_tpu/``. Writes that escape a traced function:
+assigning a module global (or mutating a module-level container, or
+setting ``self.X``) inside a jitted function stores a tracer that
+outlives the trace.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .base import Finding, SourceFile, _self_attr, in_framework, \
+    project_parts
+
+_COLD_NAMES = {"warmup", "close", "drain", "stats", "health_check",
+               "__init__", "__del__", "__repr__"}
+# matched against the name AFTER leading underscores are stripped, so
+# `_warm_pool` and `warm_cache` are both cold
+_COLD_PREFIXES = ("warm", "load_")
+_DEVICE_CTORS = {"device_put", "PRNGKey", "block_until_ready"}
+_JNP_CTORS = {"asarray", "array", "zeros", "ones", "full", "arange"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_MUTATORS = {"append", "extend", "insert", "update", "add", "setdefault"}
+
+
+def _callee_last(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _callee_root(node: ast.expr) -> str | None:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _is_device_producer(call: ast.expr) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    last = _callee_last(call.func)
+    if last is None:
+        return False
+    if "jit" in last:
+        return True
+    if last in _DEVICE_CTORS:
+        return True
+    root = _callee_root(call.func)
+    return root in ("jnp", "jax") and last in _JNP_CTORS
+
+
+def _device_attrs(cls: ast.ClassDef) -> set[str]:
+    """Attributes that hold device arrays: targets of assignments whose
+    RHS is a jit dispatch / device_put / jnp constructor / PRNGKey."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not _is_device_producer(node.value):
+            continue
+        stack = list(node.targets)
+        while stack:
+            t = stack.pop()
+            if isinstance(t, (ast.Tuple, ast.List)):
+                stack.extend(t.elts)
+                continue
+            if isinstance(t, ast.Subscript):
+                t = t.value
+            a = _self_attr(t)
+            if a is not None:
+                out.add(a)
+    return out
+
+
+class _JitInfo:
+    """One jit-traced function: its def node + static parameter names."""
+
+    def __init__(self, fn: ast.AST, static_names: set[str],
+                 static_nums: set[int]):
+        self.fn = fn
+        self.static_names = static_names
+        self.static_nums = static_nums
+
+
+def _jit_call_statics(call: ast.Call) -> tuple[set[str], set[int]]:
+    names: set[str] = set()
+    nums: set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames" and isinstance(
+                kw.value, (ast.Tuple, ast.List, ast.Constant)):
+            elts = kw.value.elts if not isinstance(kw.value, ast.Constant) \
+                else [kw.value]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    names.add(e.value)
+        if kw.arg == "static_argnums":
+            elts = [kw.value]
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                elts = list(kw.value.elts)
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    nums.add(e.value)
+    return names, nums
+
+
+def _is_jit_name(node: ast.expr) -> bool:
+    return _callee_last(node) == "jit"
+
+
+class HotPathPass:
+    def __init__(self):
+        self.findings: list[Finding] = []
+
+    def feed(self, sf: SourceFile) -> None:
+        if sf.tree is None or not in_framework(sf.path):
+            return
+        # anchored at the project root like in_framework: an absolute-
+        # path check would turn a checkout under /home/tpu/ into
+        # all-GL101-everywhere
+        in_tpu = "tpu" in project_parts(sf.path)
+        defs = self._collect_defs(sf.tree)
+        jitted, jit_targets = self._collect_jitted(sf.tree, defs)
+        if in_tpu:
+            self._gl101(sf, jitted)
+        self._gl102_branches(sf, jitted)
+        self._gl102_static_args(sf, jit_targets)
+        self._gl103(sf, jitted)
+
+    # -- jit discovery -----------------------------------------------------
+    def _collect_defs(self, tree: ast.AST) -> dict[str, ast.AST]:
+        return {n.name: n for n in ast.walk(tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    def _collect_jitted(self, tree: ast.AST, defs: dict[str, ast.AST]
+                        ) -> tuple[list[_JitInfo], dict[str, _JitInfo]]:
+        """(jit-traced function infos, jitted-callable-name -> info)."""
+        jitted: dict[int, _JitInfo] = {}
+        targets: dict[str, _JitInfo] = {}
+        # partial aliases: name -> (fn name, kw-bound param names)
+        partials: dict[str, tuple[str, set[str]]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                call = node.value
+                if _callee_last(call.func) == "partial" and call.args and \
+                        not _is_jit_name(call.args[0]):
+                    inner = _callee_last(call.args[0])
+                    if inner in defs:
+                        bound = {kw.arg for kw in call.keywords if kw.arg}
+                        for t in node.targets:
+                            nm = _self_attr(t) or (
+                                t.id if isinstance(t, ast.Name) else None)
+                            if nm:
+                                partials[nm] = (inner, bound)
+        for node in ast.walk(tree):
+            # decorators: @jax.jit / @functools.partial(jax.jit, ...)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    names: set[str] = set()
+                    nums: set[int] = set()
+                    hit = False
+                    if _is_jit_name(dec):
+                        hit = True
+                    elif isinstance(dec, ast.Call):
+                        if _is_jit_name(dec.func):
+                            hit = True
+                            names, nums = _jit_call_statics(dec)
+                        elif _callee_last(dec.func) == "partial" and \
+                                dec.args and _is_jit_name(dec.args[0]):
+                            hit = True
+                            names, nums = _jit_call_statics(dec)
+                    if hit:
+                        jitted[id(node)] = _JitInfo(node, names, nums)
+            # wrap calls: X = jax.jit(fn, ...)
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _is_jit_name(node.value.func) and node.value.args:
+                names, nums = _jit_call_statics(node.value)
+                fn_name = _callee_last(node.value.args[0])
+                bound: set[str] = set()
+                if fn_name in partials:
+                    fn_name, bound = partials[fn_name]
+                fn = defs.get(fn_name)
+                info = _JitInfo(fn, names | bound, nums)
+                if fn is not None:
+                    jitted[id(fn)] = info
+                for t in node.targets:
+                    nm = _self_attr(t) or (
+                        t.id if isinstance(t, ast.Name) else None)
+                    if nm:
+                        targets[nm] = info
+        return list(jitted.values()), targets
+
+    # -- GL101 -------------------------------------------------------------
+    def _gl101(self, sf: SourceFile, jitted: list[_JitInfo]) -> None:
+        jit_ids = {id(j.fn) for j in jitted if j.fn is not None}
+        for cls_or_mod in ast.walk(sf.tree):
+            if isinstance(cls_or_mod, ast.ClassDef):
+                dev = _device_attrs(cls_or_mod)
+                for m in cls_or_mod.body:
+                    if isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                        self._gl101_fn(sf, m, dev, jit_ids)
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._gl101_fn(sf, node, set(), jit_ids)
+
+    def _gl101_fn(self, sf: SourceFile, fn: ast.AST, dev: set[str],
+                  jit_ids: set[int]) -> None:
+        if fn.name in _COLD_NAMES or \
+                fn.name.lstrip("_").startswith(_COLD_PREFIXES) or \
+                id(fn) in jit_ids:
+            return  # cold path, or device-side (traced) code
+
+        def scan(node: ast.AST, in_loop: bool) -> None:
+            if isinstance(node, ast.ExceptHandler):
+                return  # recovery paths may block
+            if isinstance(node, ast.ClassDef):
+                return  # methods are scanned by the ClassDef walk in
+                        # _gl101 (with the class's device attrs) — a
+                        # second pass here would duplicate findings
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if id(node) not in jit_ids:
+                    self._gl101_fn(sf, node, dev, jit_ids)
+                return
+            if in_loop and isinstance(node, ast.Call):
+                self._gl101_call(sf, fn, node, dev)
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                # the ITERABLE is evaluated once per loop entry — a sync
+                # there is the 'fetch the batch once' pattern the rule
+                # recommends, not a per-iteration sync
+                scan(node.iter, in_loop)
+                scan(node.target, True)
+                for s in node.body + node.orelse:
+                    scan(s, True)
+                return
+            if isinstance(node, ast.While):
+                scan(node.test, True)  # the test DOES run per iteration
+                for s in node.body + node.orelse:
+                    scan(s, True)
+                return
+            if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                 ast.GeneratorExp)):
+                for i, g in enumerate(node.generators):
+                    # the first generator's source evaluates once; every
+                    # later generator re-iterates per outer element
+                    scan(g.iter, True if i else in_loop)
+                    scan(g.target, True)
+                    for cond in g.ifs:
+                        scan(cond, True)
+                elts = ([node.key, node.value]
+                        if isinstance(node, ast.DictComp) else [node.elt])
+                for e in elts:
+                    scan(e, True)
+                return
+            for child in ast.iter_child_nodes(node):
+                scan(child, in_loop)
+
+        for child in ast.iter_child_nodes(fn):
+            scan(child, False)
+
+    def _gl101_call(self, sf: SourceFile, fn: ast.AST, call: ast.Call,
+                    dev: set[str]) -> None:
+        last = _callee_last(call.func)
+        if last in ("device_get", "block_until_ready"):
+            self.findings.append(Finding(
+                sf.rel, call.lineno, "GL101",
+                f"{last}() inside a loop in {fn.name} — one host sync "
+                f"per iteration serializes the device pipeline"))
+            return
+        if last == "item" and not call.args and \
+                isinstance(call.func, ast.Attribute):
+            self.findings.append(Finding(
+                sf.rel, call.lineno, "GL101",
+                f".item() inside a loop in {fn.name} — per-element "
+                f"device->host transfer; fetch the batch once"))
+            return
+        if last in ("asarray", "array", "float", "int") and call.args:
+            root = _callee_root(call.func)
+            if last in ("float", "int") and root != last:
+                return  # someobj.float(...) — not the builtin
+            if root == "jnp":
+                return  # host->device: async, not a sync
+            arg = call.args[0]
+            touches_dev = any(
+                (a := _self_attr(n)) is not None and a in dev
+                for n in ast.walk(arg))
+            if touches_dev or _is_device_producer(arg):
+                self.findings.append(Finding(
+                    sf.rel, call.lineno, "GL101",
+                    f"{last}() over device-resident data inside a loop "
+                    f"in {fn.name} — implicit device->host sync per "
+                    f"iteration"))
+
+    # -- GL102 -------------------------------------------------------------
+    def _gl102_branches(self, sf: SourceFile, jitted: list[_JitInfo]
+                        ) -> None:
+        for info in jitted:
+            if info.fn is None:
+                continue
+            params = [a.arg for a in info.fn.args.posonlyargs
+                      + info.fn.args.args + info.fn.args.kwonlyargs]
+            traced = {p for i, p in enumerate(params)
+                      if p not in ("self", "cls")
+                      and p not in info.static_names
+                      and i not in info.static_nums}
+            for node in ast.walk(info.fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    name = self._traced_name_in_test(node.test, traced)
+                    if name is not None:
+                        self.findings.append(Finding(
+                            sf.rel, node.lineno, "GL102",
+                            f"Python branch on traced parameter "
+                            f"{name!r} inside jitted {info.fn.name} — "
+                            f"trace error / per-value recompile; use "
+                            f"lax.cond/jnp.where or mark it static"))
+
+    def _traced_name_in_test(self, test: ast.expr,
+                             traced: set[str]) -> str | None:
+        """First traced param referenced by ``test``, after pruning
+        trace-static contexts (.shape/.dtype/len()/`is None`)."""
+        skip: set[int] = set()
+        for n in ast.walk(test):
+            if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+                for sub in ast.walk(n):
+                    skip.add(id(sub))
+            if isinstance(n, ast.Call) and \
+                    _callee_last(n.func) in ("len", "isinstance",
+                                             "getattr", "hasattr"):
+                for sub in ast.walk(n):
+                    skip.add(id(sub))
+            if isinstance(n, ast.Compare) and any(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops):
+                for sub in ast.walk(n):
+                    skip.add(id(sub))
+        for n in ast.walk(test):
+            if id(n) in skip:
+                continue
+            if isinstance(n, ast.Name) and n.id in traced and \
+                    isinstance(n.ctx, ast.Load):
+                return n.id
+        return None
+
+    def _gl102_static_args(self, sf: SourceFile,
+                           jit_targets: dict[str, _JitInfo]) -> None:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            nm = _self_attr(node.func) or (
+                node.func.id if isinstance(node.func, ast.Name) else None)
+            info = jit_targets.get(nm or "")
+            if info is None:
+                continue
+            for i, arg in enumerate(node.args):
+                if i in info.static_nums and \
+                        isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                    self.findings.append(Finding(
+                        sf.rel, arg.lineno, "GL102",
+                        f"unhashable {type(arg).__name__.lower()} literal "
+                        f"at static_argnums position {i} of jitted "
+                        f"{nm} — raises on every call; pass a tuple"))
+            for kw in node.keywords:
+                if kw.arg in info.static_names and \
+                        isinstance(kw.value, (ast.List, ast.Dict, ast.Set)):
+                    self.findings.append(Finding(
+                        sf.rel, kw.value.lineno, "GL102",
+                        f"unhashable {type(kw.value).__name__.lower()} "
+                        f"literal for static arg {kw.arg!r} of jitted "
+                        f"{nm} — raises on every call; pass a tuple"))
+
+    # -- GL103 -------------------------------------------------------------
+    def _gl103(self, sf: SourceFile, jitted: list[_JitInfo]) -> None:
+        module_containers = {
+            t.id
+            for node in sf.tree.body if isinstance(node, ast.Assign)
+            for t in node.targets if isinstance(t, ast.Name)
+            and isinstance(node.value, (ast.List, ast.Dict, ast.Set,
+                                        ast.ListComp, ast.DictComp))
+        }
+        for info in jitted:
+            if info.fn is None:
+                continue
+            globals_declared: set[str] = set()
+            for node in ast.walk(info.fn):
+                if isinstance(node, ast.Global):
+                    globals_declared.update(node.names)
+            for node in ast.walk(info.fn):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (node.targets if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        base = t.value if isinstance(t, ast.Subscript) else t
+                        if isinstance(base, ast.Name) and (
+                                base.id in globals_declared
+                                or (isinstance(t, ast.Subscript)
+                                    and base.id in module_containers)):
+                            self.findings.append(Finding(
+                                sf.rel, t.lineno, "GL103",
+                                f"write to module global {base.id!r} "
+                                f"inside jitted {info.fn.name} — leaks a "
+                                f"tracer past the trace"))
+                        a = _self_attr(base)
+                        if a is not None:
+                            self.findings.append(Finding(
+                                sf.rel, t.lineno, "GL103",
+                                f"write to self.{a} inside jitted "
+                                f"{info.fn.name} — runs at trace time "
+                                f"only and leaks a tracer"))
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _MUTATORS and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id in module_containers:
+                    self.findings.append(Finding(
+                        sf.rel, node.lineno, "GL103",
+                        f"mutation of module container "
+                        f"{node.func.value.id!r} inside jitted "
+                        f"{info.fn.name} — leaks a tracer past the "
+                        f"trace"))

@@ -1,0 +1,590 @@
+"""Lock-discipline pass: GL001 (unguarded writes) + GL002 (order cycles).
+
+GL001 — per-class guard inference. For every class the pass records
+which ``threading.Lock/RLock/Condition`` attributes exist (a Condition
+constructed over ``self._lock`` is an ALIAS: holding it is holding the
+lock) and which locks are held, lexically, at every write to a ``self``
+attribute. Holding is tracked through:
+
+  - ``with self._lock:`` regions (any nesting, multiple items);
+  - the bare ``self._lock.acquire()`` statement (held until a
+    statement-level ``release()`` or the end of the suite; a
+    ``try/finally`` whose finally releases covers the classic pattern);
+  - the ``if not self._lock.acquire(False): ...return`` idiom (held
+    after the early-out branch);
+  - interprocedural inheritance: a private method called ONLY from
+    sites that hold L is analyzed as holding L (fixpoint over the
+    in-class call graph; methods whose reference escapes — stored,
+    passed to partial(), exported — inherit nothing);
+  - an explicit annotation ``# gl: holds self._lock`` on the ``def``
+    line, for callbacks invoked under a lock the analyzer cannot see
+    through (e.g. a closure handed to another thread's executor);
+  - methods named ``*_locked`` are the caller-holds-the-lock
+    convention: their bodies are exempt from GL001 entirely.
+
+An attribute written at least once under a lock and at least once
+under none — with the guarded sites in the majority — is flagged at
+each naked site. Writes under DIFFERENT locks with no common guard are
+flagged as inconsistent. ``__init__``/``__del__`` writes are exempt
+(the object is not shared yet/anymore), as are attributes that are
+themselves synchronization or thread-safe-by-construction objects
+(locks, Events, queue.Queue).
+
+GL002 — the cross-module lock-order graph. Acquiring B while holding A
+adds the edge A -> B, where nodes are (class, attribute) — the lock's
+DECLARATION, so order is checked per lock class like lockdep, across
+every module in the run. One level of cross-object calls is followed:
+``x.m()`` under a held lock adds edges to the locks ``m`` may acquire,
+when ``m`` resolves to at most two lock-acquiring classes. Any cycle in
+the final graph is a potential deadlock and is reported once, on its
+lexically first edge.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .base import Finding, SourceFile, _self_attr, in_framework
+
+_LOCK_CTORS = {"Lock", "RLock"}
+_COND_CTORS = {"Condition"}
+# Thread-safe-by-construction (or synchronization primitives): writes
+# to these attrs are exempt from GL001 — mutating an Event or a
+# queue.Queue needs no caller-side lock.
+_EXEMPT_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+                 "BoundedSemaphore", "Event", "Barrier", "Queue",
+                 "SimpleQueue", "LifoQueue", "PriorityQueue", "local"}
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+             "pop", "popleft", "remove", "clear", "update", "add",
+             "discard", "setdefault", "popitem"}
+_GL_HOLDS_RE = re.compile(r"#\s*gl:\s*holds\s+(?P<locks>[\w.,\s]+)")
+
+
+def _ctor_name(node: ast.expr) -> str | None:
+    """Last segment of a constructor callee: threading.Lock -> Lock."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+class _Method:
+    def __init__(self, name: str, node: ast.AST):
+        self.name = name
+        self.node = node
+        # (attr, lineno, lexical-held frozenset)
+        self.writes: list[tuple[str, int, frozenset[str]]] = []
+        # (callee-method-name, lexical-held, lineno)
+        self.self_calls: list[tuple[str, frozenset[str], int]] = []
+        # (method-name, lexical-held, lineno) on a non-self receiver
+        self.obj_calls: list[tuple[str, frozenset[str], int]] = []
+        # (lock, held-before frozenset, lineno)
+        self.acquires: list[tuple[str, frozenset[str], int]] = []
+        self.annotated: frozenset[str] = frozenset()
+        self.inherited: frozenset[str] = frozenset()
+        self.construction_only = False  # called only from __init__/__del__
+
+    @property
+    def exempt(self) -> bool:
+        return self.name in ("__init__", "__del__") or \
+            self.name.endswith("_locked") or self.construction_only
+
+
+class _Class:
+    def __init__(self, module: str, name: str, bases: list[str]):
+        self.module = module
+        self.name = name
+        self.bases = bases
+        self.locks: dict[str, str] = {}    # attr -> canonical attr
+        self.exempt_attrs: set[str] = set()
+        self.methods: dict[str, _Method] = {}
+        self.escaped: set[str] = set()     # method names whose ref escapes
+
+    def node_id(self, lock_attr: str) -> str:
+        if lock_attr.startswith("<module"):
+            # a module-level lock is ONE lock shared by every class in
+            # the module: per-class prefixing would split it into
+            # distinct graph nodes and hide real cross-class cycles
+            return lock_attr
+        return f"{self.name}.{self.locks.get(lock_attr, lock_attr)}"
+
+
+class _MethodWalker:
+    """Statement-ordered walk of one method body, tracking held locks."""
+
+    def __init__(self, cls: _Class, meth: _Method, module_locks: set[str],
+                 sf: SourceFile):
+        self.cls = cls
+        self.meth = meth
+        self.module_locks = module_locks
+        self.sf = sf
+
+    def _lock_of(self, expr: ast.expr) -> str | None:
+        """Canonical lock name for an acquired context expr, or None."""
+        a = _self_attr(expr)
+        if a is not None and a in self.cls.locks:
+            return self.cls.locks[a]
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            # qualified by the declaring module: same-named locks in
+            # different files must not collapse into one node
+            return f"<module {self.cls.module}>.{expr.id}"
+        return None
+
+    def _acquire_call(self, call: ast.expr, want: str) -> str | None:
+        """The canonical lock when ``call`` is ``<lock>.acquire()`` /
+        ``.release()`` (want selects which)."""
+        if isinstance(call, ast.Call) and \
+                isinstance(call.func, ast.Attribute) and \
+                call.func.attr == want:
+            return self._lock_of(call.func.value)
+        return None
+
+    def walk(self, body: list[ast.stmt], held: frozenset[str]) -> None:
+        """Walk ``body`` in order; ``held`` is the entry lock set.
+        Acquire/release statements mutate the running set."""
+        for stmt in body:
+            held = self._stmt(stmt, held)
+
+    def _stmt(self, stmt: ast.stmt, held: frozenset[str]) -> frozenset[str]:
+        if isinstance(stmt, ast.With):
+            inner = set(held)
+            for item in stmt.items:
+                lk = self._lock_of(item.context_expr)
+                if lk is not None:
+                    self.meth.acquires.append(
+                        (lk, frozenset(inner), stmt.lineno))
+                    inner.add(lk)
+                else:
+                    self._expr(item.context_expr, held)
+            self.walk(stmt.body, frozenset(inner))
+            return held
+        if isinstance(stmt, ast.Expr):
+            lk = self._acquire_call(stmt.value, "acquire")
+            if lk is not None:
+                self.meth.acquires.append((lk, held, stmt.lineno))
+                return held | {lk}
+            lk = self._acquire_call(stmt.value, "release")
+            if lk is not None:
+                return held - {lk}
+            self._expr(stmt.value, held)
+            return held
+        if isinstance(stmt, ast.If):
+            # `if not X.acquire(...): <terminating body>` — the fall-
+            # through path holds X
+            test = stmt.test
+            acquired = None
+            if isinstance(test, ast.UnaryOp) and \
+                    isinstance(test.op, ast.Not):
+                acquired = self._acquire_call(test.operand, "acquire")
+            terminates = bool(stmt.body) and isinstance(
+                stmt.body[-1], (ast.Return, ast.Raise, ast.Continue,
+                                ast.Break))
+            if acquired is not None and terminates and not stmt.orelse:
+                self.meth.acquires.append((acquired, held, stmt.lineno))
+                self.walk(stmt.body, held)
+                return held | {acquired}
+            self._expr(test, held)
+            self.walk(stmt.body, held)
+            self.walk(stmt.orelse, held)
+            return held
+        if isinstance(stmt, ast.Try):
+            # a finally that releases X covers the acquire/try/finally
+            # idiom: the try body holds X, statements after the Try
+            # do not
+            released: set[str] = set()
+            for fs in stmt.finalbody:
+                if isinstance(fs, ast.Expr):
+                    lk = self._acquire_call(fs.value, "release")
+                    if lk is not None:
+                        released.add(lk)
+            self.walk(stmt.body, held)
+            for h in stmt.handlers:
+                self.walk(h.body, held)
+            self.walk(stmt.orelse, held)
+            self.walk(stmt.finalbody, held - released)
+            return held - released
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter, held)
+            self._target_write(stmt.target, held)
+            self.walk(stmt.body, held)
+            self.walk(stmt.orelse, held)
+            return held
+        if isinstance(stmt, ast.While):
+            self._expr(stmt.test, held)
+            self.walk(stmt.body, held)
+            self.walk(stmt.orelse, held)
+            return held
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def runs later, in an unknown lock context
+            self.walk(stmt.body, frozenset())
+            return held
+        if isinstance(stmt, ast.ClassDef):
+            return held
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for t in targets:
+                self._target_write(t, held)
+            if stmt.value is not None:
+                self._expr(stmt.value, held)
+            return held
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._target_write(t, held)
+            return held
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child, held)
+            elif isinstance(child, ast.stmt):
+                held = self._stmt(child, held)
+        return held
+
+    def _target_write(self, t: ast.expr, held: frozenset[str]) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target_write(e, held)
+            return
+        base = t
+        if isinstance(t, ast.Subscript):
+            base = t.value
+            self._expr(t.slice, held)
+        attr = _self_attr(base)
+        if attr is not None:
+            self.meth.writes.append((attr, t.lineno, held))
+        else:
+            self._expr(base, held)
+
+    def _expr(self, node: ast.expr | None, held: frozenset[str]) -> None:
+        if node is None:
+            return
+        call_funcs: set[int] = set()
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            call_funcs.add(id(n.func))
+            f = n.func
+            if isinstance(f, ast.Attribute):
+                recv_attr = _self_attr(f.value)
+                if isinstance(f.value, ast.Name) and f.value.id == "self":
+                    self.meth.self_calls.append((f.attr, held, n.lineno))
+                elif f.attr in _MUTATORS and recv_attr is not None:
+                    # self.X.append(...) — a content write to self.X
+                    self.meth.writes.append((recv_attr, n.lineno, held))
+                else:
+                    self.meth.obj_calls.append((f.attr, held, n.lineno))
+        # self.m referenced as a VALUE (stored, passed to partial(),
+        # handed to an executor) escapes lock inference; self.m(...)
+        # invoked directly — even nested inside another call's argument
+        # list — does not.
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            for arg in list(n.args) + [k.value for k in n.keywords]:
+                for sub in ast.walk(arg):
+                    a = _self_attr(sub)
+                    if a is not None and isinstance(sub.ctx, ast.Load) \
+                            and id(sub) not in call_funcs:
+                        self.cls.escaped.add(a)
+
+
+class LockPass:
+    """Whole-run lock analysis. feed() per file, finish() at the end."""
+
+    def __init__(self):
+        self.classes: list[_Class] = []
+        self.findings: list[Finding] = []
+        # rel-path per class for reporting
+        self._class_file: dict[int, str] = {}
+
+    # -- per-file ----------------------------------------------------------
+    def feed(self, sf: SourceFile) -> None:
+        if sf.tree is None or not in_framework(sf.path):
+            return
+        # rel path, not the stem: every package has an __init__.py, and
+        # stem-keyed module locks would merge across packages
+        module = sf.rel
+        module_locks = {
+            t.id
+            for node in sf.tree.body if isinstance(node, ast.Assign)
+            for t in node.targets if isinstance(t, ast.Name)
+            and _ctor_name(node.value) in (_LOCK_CTORS | _COND_CTORS)
+        }
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                self._feed_class(sf, node, module, module_locks)
+
+    def _feed_class(self, sf: SourceFile, node: ast.ClassDef, module: str,
+                    module_locks: set[str]) -> None:
+        bases = []
+        for b in node.bases:
+            if isinstance(b, ast.Name):
+                bases.append(b.id)
+            elif isinstance(b, ast.Attribute):
+                bases.append(b.attr)
+        cls = _Class(module, node.name, bases)
+        # lock/exempt attribute discovery, over every method
+        for m in node.body:
+            if not isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for st in ast.walk(m):
+                if not isinstance(st, ast.Assign):
+                    continue
+                ctor = _ctor_name(st.value)
+                if ctor is None:
+                    continue
+                for t in st.targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    if ctor in _LOCK_CTORS:
+                        cls.locks[attr] = attr
+                    elif ctor in _COND_CTORS:
+                        arg = st.value.args[0] if st.value.args else None
+                        under = _self_attr(arg) if arg is not None else None
+                        # Condition(self._lock) aliases the lock;
+                        # Condition() owns its (R)Lock
+                        cls.locks[attr] = cls.locks.get(under, under) \
+                            if under else attr
+                    if ctor in _EXEMPT_CTORS:
+                        cls.exempt_attrs.add(attr)
+        for m in node.body:
+            if not isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            meth = _Method(m.name, m)
+            meth.annotated = self._annotation(sf, cls, m)
+            cls.methods[m.name] = meth
+            _MethodWalker(cls, meth, module_locks, sf).walk(
+                m.body, frozenset())
+        self.classes.append(cls)
+        self._class_file[id(cls)] = sf.rel
+
+    def _annotation(self, sf: SourceFile, cls: _Class,
+                    m: ast.AST) -> frozenset[str]:
+        """`# gl: holds self._lock[, self._other]` on the def line (or
+        the line above it) grants held locks the analyzer cannot see."""
+        out: set[str] = set()
+        for line in (m.lineno, m.lineno - 1):
+            g = _GL_HOLDS_RE.search(sf.comments.get(line, ""))
+            if g is None:
+                continue
+            for name in re.split(r"[\s,]+", g.group("locks").strip()):
+                name = name.split(".")[-1]
+                if name:
+                    out.add(cls.locks.get(name, name))
+        return frozenset(out)
+
+    # -- whole-run ---------------------------------------------------------
+    def finish(self) -> list[Finding]:
+        self._merge_inherited_locks()
+        for cls in self.classes:
+            self._propagate(cls)
+            self._check_gl001(cls)
+        self._check_gl002()
+        self.findings.sort(key=lambda f: (f.path, f.line, f.code))
+        return self.findings
+
+    def _merge_inherited_locks(self) -> None:
+        by_name: dict[str, list[_Class]] = {}
+        for c in self.classes:
+            by_name.setdefault(c.name, []).append(c)
+        for c in self.classes:
+            for b in c.bases:
+                for base in by_name.get(b, []):
+                    for attr, canon in base.locks.items():
+                        c.locks.setdefault(attr, canon)
+                    c.exempt_attrs |= base.exempt_attrs
+
+    def _propagate(self, cls: _Class) -> None:
+        """Fixpoint: a private, non-escaped method called only under L
+        is analyzed as holding L. Call sites inside __init__/__del__
+        (or methods reachable only from them) don't constrain the
+        intersection — the object is not shared during construction —
+        and a method whose EVERY caller is construction-time is itself
+        construction-exempt."""
+        top = frozenset(set(cls.locks.values()))
+        eligible = {
+            n for n, m in cls.methods.items()
+            if n.startswith("_") and not n.startswith("__")
+            and n not in cls.escaped
+            and any(n == c for meth in cls.methods.values()
+                    for c, _, _ in meth.self_calls)
+        }
+        # construction-only fixpoint first: exempt status feeds the
+        # lock-inheritance intersection below
+        for _ in range(len(cls.methods) + 1):
+            changed = False
+            for n in eligible:
+                callers = {meth.name for meth in cls.methods.values()
+                           if any(c == n for c, _, _ in meth.self_calls)
+                           and meth.name != n}
+                only_ctor = bool(callers) and all(
+                    cls.methods[c].exempt and not c.endswith("_locked")
+                    for c in callers if c in cls.methods)
+                if only_ctor != cls.methods[n].construction_only:
+                    cls.methods[n].construction_only = only_ctor
+                    changed = True
+            if not changed:
+                break
+        inherited = {n: top for n in eligible}
+        for _ in range(len(cls.methods) + 1):
+            changed = False
+            for n in eligible:
+                seen: frozenset[str] | None = None
+                for meth in cls.methods.values():
+                    if meth.name in ("__init__", "__del__") or \
+                            meth.construction_only:
+                        continue  # pre-sharing call sites don't count
+                    eff_caller = self._effective(cls, meth, inherited)
+                    for callee, held, _ in meth.self_calls:
+                        if callee != n:
+                            continue
+                        site = held | eff_caller
+                        seen = site if seen is None else (seen & site)
+                new = seen if seen is not None else frozenset()
+                if new != inherited[n]:
+                    inherited[n] = new
+                    changed = True
+            if not changed:
+                break
+        for n, m in cls.methods.items():
+            m.inherited = inherited.get(n, frozenset()) | m.annotated
+
+    def _effective(self, cls: _Class, meth: _Method,
+                   inherited: dict[str, frozenset[str]]) -> frozenset[str]:
+        return inherited.get(meth.name, frozenset()) | meth.annotated
+
+    def _check_gl001(self, cls: _Class) -> None:
+        if not cls.locks:
+            return
+        rel = self._class_file[id(cls)]
+        sites: dict[str, list[tuple[int, frozenset[str], str]]] = {}
+        for m in cls.methods.values():
+            if m.exempt:
+                continue
+            for attr, line, held in m.writes:
+                if attr in cls.exempt_attrs or attr in cls.locks:
+                    continue
+                eff = frozenset(held | m.inherited)
+                sites.setdefault(attr, []).append((line, eff, m.name))
+        for attr, ws in sorted(sites.items()):
+            if len(ws) < 2:
+                continue
+            guarded = [w for w in ws if w[1]]
+            naked = [w for w in ws if not w[1]]
+            if not guarded:
+                continue
+            locks_used = sorted({lk for _, h, _ in guarded for lk in h})
+            if naked and len(guarded) >= len(naked):
+                for line, _, mname in sorted(naked):
+                    self.findings.append(Finding(
+                        rel, line, "GL001",
+                        f"write to self.{attr} in {cls.name}.{mname} "
+                        f"outside any lock (guarded by "
+                        f"{'/'.join(locks_used)} at {len(guarded)} other "
+                        f"site(s))"))
+                continue
+            if naked:
+                continue  # mostly-naked attr: not lock-associated
+            common = frozenset.intersection(*(h for _, h, _ in guarded))
+            if common:
+                continue
+            # inconsistent guards: no single lock covers every write —
+            # flag the sites missing the best-covering lock
+            cover = sorted(
+                ((sum(1 for _, h, _ in guarded if lk in h), lk)
+                 for lk in locks_used), key=lambda t: (-t[0], t[1]))
+            best = cover[0][1]
+            for line, h, mname in sorted(guarded):
+                if best not in h:
+                    self.findings.append(Finding(
+                        rel, line, "GL001",
+                        f"write to self.{attr} in {cls.name}.{mname} "
+                        f"holds {'/'.join(sorted(h))} but not {best}, "
+                        f"which guards {cover[0][0]} other write(s) "
+                        f"(no common lock)"))
+
+    # -- GL002 --------------------------------------------------------------
+    def _lock_summary(self) -> dict[int, frozenset[str]]:
+        """Per-class transitive 'locks this class may acquire' node ids."""
+        out: dict[int, frozenset[str]] = {}
+        for cls in self.classes:
+            acq = {cls.node_id(lk)
+                   for m in cls.methods.values() for lk, _, _ in m.acquires}
+            out[id(cls)] = frozenset(acq)
+        return out
+
+    def _check_gl002(self) -> None:
+        edges: dict[tuple[str, str], tuple[str, int]] = {}
+
+        def add_edge(a: str, b: str, rel: str, line: int) -> None:
+            if a != b and (a, b) not in edges:
+                edges[(a, b)] = (rel, line)
+
+        # methods-by-name with per-class lock summaries, for one level
+        # of cross-object resolution
+        method_locks: dict[str, list[tuple[_Class, frozenset[str]]]] = {}
+        for cls in self.classes:
+            for n, m in cls.methods.items():
+                acq = frozenset(cls.node_id(lk) for lk, _, _ in m.acquires)
+                if acq:
+                    method_locks.setdefault(n, []).append((cls, acq))
+        for cls in self.classes:
+            rel = self._class_file[id(cls)]
+            for m in cls.methods.values():
+                base = m.inherited
+                for lk, held, line in m.acquires:
+                    for h in held | base:
+                        add_edge(cls.node_id(h), cls.node_id(lk), rel, line)
+                for name, held, line in m.obj_calls:
+                    eff = held | base
+                    if not eff:
+                        continue
+                    owners = method_locks.get(name, [])
+                    if not owners or len(owners) > 2:
+                        continue  # unknown or too generic to resolve
+                    for other, acq in owners:
+                        if other is cls:
+                            continue
+                        for h in eff:
+                            for b in acq:
+                                add_edge(cls.node_id(h), b, rel, line)
+        # cycle detection (DFS over the edge set)
+        graph: dict[str, list[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, []).append(b)
+        reported: set[frozenset[str]] = set()
+        for start in sorted(graph):
+            path: list[str] = []
+            on_path: set[str] = set()
+
+            def dfs(node: str) -> None:
+                if node in on_path:
+                    cyc = path[path.index(node):] + [node]
+                    key = frozenset(cyc)
+                    if key in reported:
+                        return
+                    reported.add(key)
+                    first = min(
+                        (edges[(cyc[i], cyc[i + 1])], i)
+                        for i in range(len(cyc) - 1))
+                    (rel, line), _ = first
+                    self.findings.append(Finding(
+                        rel, line, "GL002",
+                        "lock-order cycle (potential deadlock): "
+                        + " -> ".join(cyc)))
+                    return
+                if node not in graph:
+                    return
+                on_path.add(node)
+                path.append(node)
+                for nxt in sorted(graph[node]):
+                    dfs(nxt)
+                path.pop()
+                on_path.discard(node)
+
+            dfs(start)

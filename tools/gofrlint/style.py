@@ -1,0 +1,236 @@
+"""Style/correctness pass: the original hermetic-linter rule set.
+
+  F401  unused import (module scope; __init__.py re-exports exempt)
+  F811  redefinition of a top-level def/class by another def/class
+  E501  line longer than MAX_LINE columns
+  E711  comparison to None with ==/!=
+  E722  bare `except:`
+  B006  mutable default argument (list/dict/set literal or call)
+  B011  assert on a non-empty tuple literal (always true)
+  F601  duplicate literal key in a dict display
+  F541  f-string without any placeholder
+  W291  trailing whitespace / W191 tab indentation
+  T201  bare `print(` inside gofr_tpu/ — framework output must go
+        through glog so every line carries trace correlation; CLI
+        command output may opt out with `# noqa: T201`
+  E999  syntax error
+
+Findings are emitted UNFILTERED; `# noqa` suppression happens once, in
+the runner (base.SourceFile.suppressed), for every rule alike.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .base import MAX_LINE, Finding, SourceFile, in_framework
+
+
+def _is_mutable_default(node: ast.expr) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in {"list", "dict", "set", "bytearray"}
+    return False
+
+
+class Checker(ast.NodeVisitor):
+    """AST-level style checks. The constructor signature is stable API:
+    tools/lint.py (the CI fallback shim) and its tests build Checkers
+    directly."""
+
+    def __init__(self, path: str, tree: ast.AST, is_init: bool,
+                 source: str, in_framework: bool = False):
+        self.path = path
+        self.is_init = is_init
+        self.in_framework = in_framework  # file lives under gofr_tpu/
+        self.findings: list[Finding] = []
+        self.imported: dict[str, int] = {}       # name -> lineno
+        self.used: set[str] = set()
+        self.dunder_all: set[str] = set()
+        self._toplevel_defs: dict[str, int] = {}
+        self._source = source
+        self._in_format_spec = False
+        self.visit(tree)
+
+    def add(self, node, code, msg):
+        self.findings.append(Finding(self.path, node.lineno, code, msg))
+
+    # -- imports ----------------------------------------------------------
+    def _record_import(self, alias: ast.alias, node):
+        name = alias.asname or alias.name.split(".")[0]
+        if name == "*":
+            return
+        # "import x as x" / "from y import x as x" is the PEP 484
+        # re-export idiom — exempt, like ruff's F401 convention
+        if alias.asname is not None and alias.asname == alias.name:
+            return
+        self.imported[name] = node.lineno
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self._record_import(a, node)
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return
+        for a in node.names:
+            self._record_import(a, node)
+
+    # -- usages -----------------------------------------------------------
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == "__all__" and \
+                    isinstance(node.value, (ast.List, ast.Tuple)):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) and \
+                            isinstance(elt.value, str):
+                        self.dunder_all.add(elt.value)
+        self.generic_visit(node)
+
+    # -- defs -------------------------------------------------------------
+    def _check_defaults(self, node):
+        for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            if _is_mutable_default(d):
+                self.add(d, "B006",
+                         "mutable default argument (shared across calls)")
+
+    def _check_redef(self, node):
+        # only flag UNdecorated def/class shadowing another at the SAME
+        # module top level — decorators (@overload, @singledispatch
+        # registrations, property setters) legitimately re-bind a name
+        if node.col_offset != 0 or node.decorator_list:
+            return
+        prev = self._toplevel_defs.get(node.name)
+        if prev is not None:
+            self.add(node, "F811",
+                     f"redefinition of {node.name!r} from line {prev}")
+        self._toplevel_defs[node.name] = node.lineno
+
+    def visit_FunctionDef(self, node):
+        self._check_defaults(node)
+        self._check_redef(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._check_defaults(node)
+        self._check_redef(node)
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node):
+        self._check_redef(node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        # T201: framework code must log through glog (trace-correlated
+        # structured lines), never print to raw stdout/stderr. CLI
+        # command OUTPUT — the command's product, not logging — opts
+        # out per line with `# noqa: T201` (central suppression).
+        if self.in_framework and isinstance(node.func, ast.Name) \
+                and node.func.id == "print":
+            self.add(node, "T201",
+                     "bare print() in framework code; use glog (or "
+                     "`# noqa: T201` for CLI command output)")
+        self.generic_visit(node)
+
+    # -- misc -------------------------------------------------------------
+    def visit_Compare(self, node):
+        for op, comp in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Eq, ast.NotEq)) and \
+                    isinstance(comp, ast.Constant) and comp.value is None:
+                self.add(node, "E711", "comparison to None; use `is None`")
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node):
+        if node.type is None:
+            self.add(node, "E722", "bare `except:`; catch something")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        if isinstance(node.test, ast.Tuple) and node.test.elts:
+            self.add(node, "B011", "assert on a tuple is always true")
+        self.generic_visit(node)
+
+    def visit_Dict(self, node):
+        seen: dict[object, int] = {}
+        for k in node.keys:
+            if isinstance(k, ast.Constant):
+                try:
+                    key = (type(k.value).__name__, k.value)
+                except TypeError:
+                    continue
+                if key in seen:
+                    self.add(k, "F601",
+                             f"duplicate dict key {k.value!r} "
+                             f"(first at line {seen[key]})")
+                else:
+                    seen[key] = k.lineno
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node):
+        # F541 is suppressed inside a format spec: `{x:.2f}` parses as a
+        # nested placeholder-less JoinedStr there, which is not an
+        # f-string the author wrote
+        if not self._in_format_spec and \
+                not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self.add(node, "F541", "f-string without placeholders")
+        self.generic_visit(node)
+
+    def visit_FormattedValue(self, node):
+        self.visit(node.value)
+        if node.format_spec is not None:
+            # names inside nested format specs (f"{x:{width}}") are real
+            # usages — F401 must see them; only the F541 check is muted
+            prev = self._in_format_spec
+            self._in_format_spec = True
+            try:
+                self.visit(node.format_spec)
+            finally:
+                self._in_format_spec = prev
+
+    # -- finish -----------------------------------------------------------
+    def finish(self):
+        if self.is_init:
+            return  # __init__.py imports are the public re-export surface
+        for name, line in self.imported.items():
+            if name in self.used or name in self.dunder_all:
+                continue
+            # a bare name can still be referenced from a doctest or
+            # __getattr__ string table — only flag when the identifier
+            # appears nowhere else in the source text. Word-boundary
+            # match: a substring count would let `time` hide inside
+            # `settimeout` and exempt every short import name
+            hits = len(re.findall(rf"\b{re.escape(name)}\b", self._source))
+            if hits <= 1:
+                self.findings.append(Finding(
+                    self.path, line, "F401", f"unused import {name!r}"))
+
+
+def run(sf: SourceFile) -> list[Finding]:
+    """The style pass over one parsed file (line checks included)."""
+    if sf.syntax_error is not None:
+        e = sf.syntax_error
+        return [Finding(sf.rel, e.lineno or 0, "E999",
+                        f"syntax error: {e.msg}")]
+    c = Checker(sf.rel, sf.tree, sf.path.name == "__init__.py", sf.source,
+                in_framework=in_framework(sf.path))
+    c.finish()
+    for i, line in enumerate(sf.source.splitlines(), 1):
+        if len(line) > MAX_LINE:
+            c.findings.append(Finding(sf.rel, i, "E501",
+                                      f"line too long ({len(line)} > "
+                                      f"{MAX_LINE})"))
+        if line != line.rstrip():
+            c.findings.append(Finding(sf.rel, i, "W291",
+                                      "trailing whitespace"))
+        stripped_len = len(line) - len(line.lstrip())
+        if "\t" in line[:stripped_len]:
+            c.findings.append(Finding(sf.rel, i, "W191", "tab indentation"))
+    return c.findings

@@ -1,12 +1,15 @@
 #!/usr/bin/env python
-"""Hermetic lint gate (stdlib-only) for gofr_tpu.
+"""Hermetic lint gate (stdlib-only) for gofr_tpu — style-pass shim.
 
 Reference parity: the reference CI blocks on golangci-lint
 (.github/workflows/go.yml:231-239 in the reference repo). This repo's
 CI lint job prefers `ruff check .` (config in pyproject.toml); this
 tool is the zero-dependency fallback that runs in hermetic
-environments where ruff cannot be installed — it enforces the
-highest-signal subset via the stdlib `ast` module:
+environments where ruff cannot be installed.
+
+The rule implementations live in tools/gofrlint/ (the multi-pass
+analyzer: style + lock discipline + TPU hot-path); this entry point
+runs JUST the style pass with the same `# noqa` semantics:
 
   F401  unused import (module scope; __init__.py re-exports exempt)
   F811  redefinition of a top-level def/class by another def/class
@@ -19,8 +22,14 @@ highest-signal subset via the stdlib `ast` module:
   F541  f-string without any placeholder
   W291  trailing whitespace / W191 tab indentation
   T201  bare `print(` inside gofr_tpu/ — framework output must go
-        through glog so every line carries trace correlation; CLI
-        command output may opt out with `# noqa: T201`
+        through glog so every line carries trace correlation
+  E999  syntax error
+
+EVERY rule honors `# noqa` (suppress the line) and `# noqa: CODE[,..]`
+(suppress the listed codes) — suppression is applied centrally in
+gofrlint, not per rule. For the full analyzer (lock discipline GL001/
+GL002, TPU hot-path GL101-GL103, baseline workflow) run
+`python -m tools.gofrlint` — see docs/advanced-guide/static-analysis.md.
 
 Usage: python tools/lint.py [paths...]   (default: the repo)
 Exit code 1 when any finding is reported.
@@ -28,286 +37,36 @@ Exit code 1 when any finding is reported.
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
-MAX_LINE = 100
-SKIP_DIRS = {".git", "__pycache__", ".ruff_cache", "node_modules",
-             ".pytest_cache", "build", "dist"}
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+from tools.gofrlint import style                      # noqa: E402
+from tools.gofrlint.base import (                     # noqa: E402
+    MAX_LINE, SKIP_DIRS, Finding, SourceFile, collect_files)
 
-class Finding:
-    __slots__ = ("path", "line", "code", "msg")
+# Stable API for tests and embedders: the Checker class (AST rules,
+# constructor signature pinned by tests/test_lint_tool.py) is the
+# gofrlint style checker.
+Checker = style.Checker
 
-    def __init__(self, path, line, code, msg):
-        self.path, self.line, self.code, self.msg = path, line, code, msg
-
-    def __str__(self):
-        return f"{self.path}:{self.line}: {self.code} {self.msg}"
-
-
-def _is_mutable_default(node: ast.expr) -> bool:
-    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
-        return True
-    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
-        return node.func.id in {"list", "dict", "set", "bytearray"}
-    return False
-
-
-class Checker(ast.NodeVisitor):
-    def __init__(self, path: str, tree: ast.AST, is_init: bool,
-                 source: str, in_framework: bool = False):
-        self.path = path
-        self.is_init = is_init
-        self.in_framework = in_framework  # file lives under gofr_tpu/
-        self.findings: list[Finding] = []
-        self.imported: dict[str, int] = {}       # name -> lineno
-        self.used: set[str] = set()
-        self.dunder_all: set[str] = set()
-        self._toplevel_defs: dict[str, int] = {}
-        self._source = source
-        self._comments: dict[int, str] | None = None  # built on first _noqa
-        self._in_format_spec = False
-        self.visit(tree)
-
-    def add(self, node, code, msg):
-        self.findings.append(Finding(self.path, node.lineno, code, msg))
-
-    # -- imports ----------------------------------------------------------
-    def _record_import(self, alias: ast.alias, node):
-        name = alias.asname or alias.name.split(".")[0]
-        if name == "*":
-            return
-        # "import x as x" / "from y import x as x" is the PEP 484
-        # re-export idiom — exempt, like ruff's F401 convention
-        if alias.asname is not None and alias.asname == alias.name:
-            return
-        self.imported[name] = node.lineno
-
-    def visit_Import(self, node):
-        for a in node.names:
-            self._record_import(a, node)
-
-    def visit_ImportFrom(self, node):
-        if node.module == "__future__":
-            return
-        for a in node.names:
-            self._record_import(a, node)
-
-    # -- usages -----------------------------------------------------------
-    def visit_Name(self, node):
-        if isinstance(node.ctx, ast.Load):
-            self.used.add(node.id)
-        self.generic_visit(node)
-
-    def visit_Attribute(self, node):
-        self.generic_visit(node)
-
-    def visit_Assign(self, node):
-        for t in node.targets:
-            if isinstance(t, ast.Name) and t.id == "__all__" and \
-                    isinstance(node.value, (ast.List, ast.Tuple)):
-                for elt in node.value.elts:
-                    if isinstance(elt, ast.Constant) and \
-                            isinstance(elt.value, str):
-                        self.dunder_all.add(elt.value)
-        self.generic_visit(node)
-
-    # -- defs -------------------------------------------------------------
-    def _check_defaults(self, node):
-        for d in list(node.args.defaults) + [
-                d for d in node.args.kw_defaults if d is not None]:
-            if _is_mutable_default(d):
-                self.add(d, "B006",
-                         "mutable default argument (shared across calls)")
-
-    def _check_redef(self, node):
-        # only flag UNdecorated def/class shadowing another at the SAME
-        # module top level — decorators (@overload, @singledispatch
-        # registrations, property setters) legitimately re-bind a name
-        if node.col_offset != 0 or node.decorator_list:
-            return
-        prev = self._toplevel_defs.get(node.name)
-        if prev is not None:
-            self.add(node, "F811",
-                     f"redefinition of {node.name!r} from line {prev}")
-        self._toplevel_defs[node.name] = node.lineno
-
-    def visit_FunctionDef(self, node):
-        self._check_defaults(node)
-        self._check_redef(node)
-        self.generic_visit(node)
-
-    def visit_AsyncFunctionDef(self, node):
-        self._check_defaults(node)
-        self._check_redef(node)
-        self.generic_visit(node)
-
-    def visit_ClassDef(self, node):
-        self._check_redef(node)
-        self.generic_visit(node)
-
-    def _comment_on(self, lineno: int) -> str:
-        """The actual comment token on ``lineno`` (tokenize, not a '#'
-        scan — a '#' inside a string literal is not a comment and must
-        not grant exemptions)."""
-        if self._comments is None:
-            import io
-            import tokenize
-
-            self._comments = {}
-            try:
-                for tok in tokenize.generate_tokens(
-                        io.StringIO(self._source).readline):
-                    if tok.type == tokenize.COMMENT:
-                        self._comments[tok.start[0]] = tok.string
-            except (tokenize.TokenError, IndentationError, SyntaxError):
-                pass
-        return self._comments.get(lineno, "")
-
-    def _noqa(self, node, code: str) -> bool:
-        comment = self._comment_on(node.lineno)
-        return "noqa" in comment and code in comment
-
-    def visit_Call(self, node):
-        # T201: framework code must log through glog (trace-correlated
-        # structured lines), never print to raw stdout/stderr. CLI
-        # command OUTPUT — the command's product, not logging — opts
-        # out per line with `# noqa: T201`.
-        if self.in_framework and isinstance(node.func, ast.Name) \
-                and node.func.id == "print" and not self._noqa(node, "T201"):
-            self.add(node, "T201",
-                     "bare print() in framework code; use glog (or "
-                     "`# noqa: T201` for CLI command output)")
-        self.generic_visit(node)
-
-    # -- misc -------------------------------------------------------------
-    def visit_Compare(self, node):
-        for op, comp in zip(node.ops, node.comparators):
-            if isinstance(op, (ast.Eq, ast.NotEq)) and \
-                    isinstance(comp, ast.Constant) and comp.value is None:
-                self.add(node, "E711", "comparison to None; use `is None`")
-        self.generic_visit(node)
-
-    def visit_ExceptHandler(self, node):
-        if node.type is None:
-            self.add(node, "E722", "bare `except:`; catch something")
-        self.generic_visit(node)
-
-    def visit_Assert(self, node):
-        if isinstance(node.test, ast.Tuple) and node.test.elts:
-            self.add(node, "B011", "assert on a tuple is always true")
-        self.generic_visit(node)
-
-    def visit_Dict(self, node):
-        seen: dict[object, int] = {}
-        for k in node.keys:
-            if isinstance(k, ast.Constant):
-                try:
-                    key = (type(k.value).__name__, k.value)
-                except TypeError:
-                    continue
-                if key in seen:
-                    self.add(k, "F601",
-                             f"duplicate dict key {k.value!r} "
-                             f"(first at line {seen[key]})")
-                else:
-                    seen[key] = k.lineno
-        self.generic_visit(node)
-
-    def visit_JoinedStr(self, node):
-        # F541 is suppressed inside a format spec: `{x:.2f}` parses as a
-        # nested placeholder-less JoinedStr there, which is not an
-        # f-string the author wrote
-        if not self._in_format_spec and \
-                not any(isinstance(v, ast.FormattedValue) for v in node.values):
-            self.add(node, "F541", "f-string without placeholders")
-        self.generic_visit(node)
-
-    def visit_FormattedValue(self, node):
-        self.visit(node.value)
-        if node.format_spec is not None:
-            # names inside nested format specs (f"{x:{width}}") are real
-            # usages — F401 must see them; only the F541 check is muted
-            prev = self._in_format_spec
-            self._in_format_spec = True
-            try:
-                self.visit(node.format_spec)
-            finally:
-                self._in_format_spec = prev
-
-    # -- finish -----------------------------------------------------------
-    def finish(self):
-        if self.is_init:
-            return  # __init__.py imports are the public re-export surface
-        import re
-
-        for name, line in self.imported.items():
-            if name in self.used or name in self.dunder_all:
-                continue
-            # a bare name can still be referenced from a doctest or
-            # __getattr__ string table — only flag when the identifier
-            # appears nowhere else in the source text. Word-boundary
-            # match: a substring count would let `time` hide inside
-            # `settimeout` and exempt every short import name
-            hits = len(re.findall(rf"\b{re.escape(name)}\b", self._source))
-            if hits <= 1:
-                self.findings.append(Finding(
-                    self.path, line, "F401", f"unused import {name!r}"))
-
-
-def _in_framework(path: Path) -> bool:
-    """Is this file part of the gofr_tpu PACKAGE (T201 scope)? Anchor at
-    the enclosing project root (nearest pyproject.toml ancestor) so a
-    checkout directory itself named gofr_tpu — the natural clone name —
-    does not classify tests/tools/examples as framework code."""
-    p = path.resolve()
-    for anc in p.parents:
-        if (anc / "pyproject.toml").is_file():
-            return "gofr_tpu" in p.relative_to(anc).parts
-    return "gofr_tpu" in p.parts
+__all__ = ["Checker", "Finding", "MAX_LINE", "SKIP_DIRS", "lint_file",
+           "main"]
 
 
 def lint_file(path: Path) -> list[Finding]:
-    src = path.read_text(encoding="utf-8", errors="replace")
-    rel = str(path)
-    try:
-        tree = ast.parse(src, filename=rel)
-    except SyntaxError as e:
-        return [Finding(rel, e.lineno or 0, "E999", f"syntax error: {e.msg}")]
-    c = Checker(rel, tree, path.name == "__init__.py", src,
-                in_framework=_in_framework(path))
-    c.finish()
-    for i, line in enumerate(src.splitlines(), 1):
-        if len(line) > MAX_LINE:
-            c.findings.append(Finding(rel, i, "E501",
-                                      f"line too long ({len(line)} > "
-                                      f"{MAX_LINE})"))
-        if line != line.rstrip():
-            c.findings.append(Finding(rel, i, "W291", "trailing whitespace"))
-        stripped_len = len(line) - len(line.lstrip())
-        if "\t" in line[:stripped_len]:
-            c.findings.append(Finding(rel, i, "W191", "tab indentation"))
-    return c.findings
+    sf = SourceFile(path, str(path))
+    return [f for f in style.run(sf) if not sf.suppressed(f)]
 
 
 def main(argv: list[str]) -> int:
     roots = [Path(a) for a in argv] or [Path(__file__).resolve().parent.parent]
-    files: list[Path] = []
-    for r in roots:
-        if r.is_file():
-            files.append(r)
-        else:
-            for p in sorted(r.rglob("*.py")):
-                if any(part in SKIP_DIRS for part in p.parts):
-                    continue
-                if p.name.endswith("_pb2.py"):  # protoc-generated
-                    continue
-                files.append(p)
+    files = collect_files(roots)
     findings: list[Finding] = []
     for f in files:
         findings.extend(lint_file(f))
+    findings.sort(key=lambda fi: (fi.path, fi.line, fi.code))
     for fi in findings:
         print(fi)
     print(f"{len(findings)} finding(s) in {len(files)} file(s)",
