@@ -308,6 +308,12 @@ def register_framework_metrics(m: Manager) -> None:
     m.new_gauge("app_tpu_devices", "number of visible TPU devices")
     m.new_counter("app_tpu_paged_evictions_total",
                   "streams truncated early by paged KV pool exhaustion")
+    # device-memory accounting (gofr_tpu/tpu/hbm.py): bytes each
+    # serving subsystem DECLARES it holds on device — the arbiter's
+    # visibility substrate; pushed by the registry on every change
+    m.new_gauge("app_tpu_device_bytes",
+                "declared live device bytes, by serving subsystem "
+                "(engine, kvcache-t0, lora, spec-decode, batcher)")
 
     # overload-safety family (gofr_tpu/resilience: deadlines, admission
     # control, brownout — see docs/advanced-guide/resilience.md)

@@ -182,6 +182,16 @@ def install_debug_routes(router, app) -> None:
             "inflight": len(observe.requests),
             "recorder": observe.recorder.stats(),
         }
+        # per-subsystem declared device bytes (hbm accounting — the
+        # same figures the app_tpu_device_bytes gauges export). Module
+        # looked up, not imported: an app with no TPU configured must
+        # not pay the jax import for a debug page.
+        hbm = sys.modules.get("gofr_tpu.tpu.hbm")
+        if hbm is not None:
+            try:
+                payload["device_memory"] = hbm.live_bytes()
+            except Exception:
+                pass
         tpu = app.container.tpu
         if tpu is not None:
             engine: dict = {

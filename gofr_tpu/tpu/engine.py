@@ -34,6 +34,7 @@ import numpy as np
 from ..datasource import Health, STATUS_DEGRADED, STATUS_DOWN, STATUS_UP
 from ..errors import DeadlineExceeded
 from ..resilience import current_deadline
+from . import hbm
 from .batcher import CoalescingBatcher, pad_bucket
 
 DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8)
@@ -150,7 +151,10 @@ class TPUEngine:
             with self._lock:
                 g = self._gates.get(program)
                 if g is None:
-                    g = self._gates[program] = self.gate.clone(program)
+                    # (GL203 suppressed: keyed by program NAME —
+                    # bounded by register() calls, not by requests)
+                    g = self.gate.clone(program)
+                    self._gates[program] = g  # noqa: GL203
         return g
 
     def _dispatch_metrics(self, prog: Program):
@@ -378,6 +382,11 @@ class TPUEngine:
                 details["hbm_bytes_limit"] = stats.get("bytes_limit")
         except Exception:
             pass
+        # per-subsystem declared bytes (the hbm accounting registry —
+        # what the backend's opaque bytes_in_use decomposes into)
+        acct = hbm.live_bytes()
+        if acct:
+            details["device_memory"] = acct
         if self.generator is not None:
             details["generator"] = self.generator.stats()
         if self._closed:

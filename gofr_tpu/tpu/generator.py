@@ -46,6 +46,7 @@ from ..models import llama
 from ..models.common import ModelConfig
 from ..resilience import current_deadline
 from ..wire import PushStream
+from . import hbm
 from .batcher import pad_bucket
 from .kvcache import HostKV, clamp_restore_len
 
@@ -258,6 +259,7 @@ class GenerationEngine:
 
                     stacks = jax.device_put(stacks,
                                             shardings_for(stacks, mesh))
+                stacks = hbm.account("lora", stacks, owner=self)
                 self.params = {**params, "layers": {
                     **params["layers"], **stacks}}
             else:
@@ -339,6 +341,10 @@ class GenerationEngine:
                                       or self.prompt_buckets[-1])
         self.logger = logger
         self.metrics = metrics
+        if metrics is not None:
+            # device-byte attribution gauges (app_tpu_device_bytes):
+            # the hbm registry pushes every accounting change
+            hbm.set_metrics(metrics)
         # resilience.AdmissionGate fronting the pending queue (None =
         # admit everything): sheds with TooManyRequests under overload
         # and caps max_new_tokens in its brownout band; fed with each
@@ -355,14 +361,21 @@ class GenerationEngine:
         self._kv_dtype = kv_dtype
         self._cache_sh = None  # set below for mesh engines
         self.down: str | None = None  # set when the device loop is bricked
+        # every persistent device buffer flows through hbm.account (the
+        # arbiter's accounting choke point — gofrlint GL202); keyed to
+        # this instance so close() releases exactly our bytes
         if self._paged:
             from ..models.paged_llama import init_paged_cache
 
-            self.cache = init_paged_cache(cfg, slots, paged_blocks,
-                                          self._block_t, dtype=kv_dtype)
+            self.cache = hbm.account(
+                "engine", init_paged_cache(cfg, slots, paged_blocks,
+                                           self._block_t, dtype=kv_dtype),
+                owner=self, tag="cache")
         else:
-            self.cache = llama.init_cache(cfg, slots, self.max_seq,
-                                          dtype=kv_dtype)
+            self.cache = hbm.account(
+                "engine", llama.init_cache(cfg, slots, self.max_seq,
+                                           dtype=kv_dtype),
+                owner=self, tag="cache")
         self._slots = [_Slot() for _ in range(slots)]
         self._last_tokens = np.zeros((slots,), np.int32)
         self._active = np.zeros((slots,), bool)
@@ -419,8 +432,11 @@ class GenerationEngine:
                         except Exception:
                             pass
                     opts = dataclasses.replace(opts, host_mb=0, redis=None)
-                self._pool = llama.init_cache(cfg, prefix_cache_slots,
-                                              self.max_seq, dtype=kv_dtype)
+                self._pool = hbm.account(
+                    "kvcache-t0", llama.init_cache(cfg, prefix_cache_slots,
+                                                   self.max_seq,
+                                                   dtype=kv_dtype),
+                    owner=self, tag="pool")
                 layout = KVLayout(cfg.n_layers, cfg.n_kv_heads,
                                   cfg.head_dim, self._pool.quantized,
                                   np.dtype(self._pool.k.dtype),
@@ -498,7 +514,11 @@ class GenerationEngine:
 
             cache_sh = kv_cache_specs(mesh, self.cache)
             self._cache_sh = cache_sh
-            self.cache = jax.device_put(self.cache, cache_sh)
+            # re-placement consumes the unsharded buffers; account's
+            # set semantics replace the figure instead of adding
+            self.cache = hbm.account(
+                "engine", jax.device_put(self.cache, cache_sh),
+                owner=self, tag="cache")
             rep = replicated(mesh)
             self._rep_sh = rep
             # commit the seed key to the replicated sharding NOW: the
@@ -506,8 +526,10 @@ class GenerationEngine:
             # dispatch with an UNCOMMITTED key would occupy a different
             # jit cache entry than every later one — warming one
             # signature and serving the other re-lowers the program
-            # mid-serving under the device lock
-            self._key = jax.device_put(self._key, rep)
+            # mid-serving under the device lock. (GL202 suppressed: a
+            # 16-byte PRNG key sits below accounting granularity — the
+            # arbiter leases buffers, not scalars.)
+            self._key = jax.device_put(self._key, rep)  # noqa: GL202
             # outputs: (token, logprob, next_key, cache) for prefill/
             # final-chunk, (tokens, logprobs, next_key, cache) for the
             # fused step — the PRNG key chains through every sampling
@@ -530,7 +552,9 @@ class GenerationEngine:
                 # out_shardings keeps donation aliasing across copies
                 pool_sh = kv_cache_specs(mesh, self._pool)
                 self._pool_sh = pool_sh
-                self._pool = jax.device_put(self._pool, pool_sh)
+                self._pool = hbm.account(
+                    "kvcache-t0", jax.device_put(self._pool, pool_sh),
+                    owner=self, tag="pool")
                 self._pool_load_jit = jax.jit(_copy_row_masked,
                                               donate_argnums=(0,),
                                               out_shardings=cache_sh)
@@ -560,8 +584,10 @@ class GenerationEngine:
                 from ..models.paged_llama import (read_blocks_to_row,
                                                   write_row_to_blocks)
 
-                self._scratch = llama.init_cache(cfg, 1, self.max_seq,
-                                                 dtype=kv_dtype)
+                self._scratch = hbm.account(
+                    "engine", llama.init_cache(cfg, 1, self.max_seq,
+                                               dtype=kv_dtype),
+                    owner=self, tag="scratch")
                 self._chunk_mid_jit = jax.jit(self._chunk_mid,
                                               donate_argnums=(0,))
                 self._chunk_final_jit = jax.jit(self._chunk_final,
@@ -1247,6 +1273,10 @@ class GenerationEngine:
             self._closed = True
         self._work.set()
         self._thread.join(timeout=10.0)
+        # the registry must not keep claiming bytes for a closed engine
+        # (hbmwatch reconciles accounted vs live bytes; the buffers
+        # themselves die with this instance's last reference)
+        hbm.release(owner=self)
         if self._kvc is not None and self._kvc.redis is not None:
             try:  # the engine owns the T2 client (KVCacheOptions.redis)
                 self._kvc.redis.client.close()
@@ -2122,8 +2152,9 @@ class GenerationEngine:
                         self._key = jax.random.PRNGKey(
                             self._seed + self._recoveries)
                         if self._rep_sh is not None:
-                            self._key = jax.device_put(self._key,
-                                                       self._rep_sh)
+                            # (GL202 suppressed: 16-byte key — see
+                            # the mesh-init placement above)
+                            self._key = jax.device_put(self._key, self._rep_sh)  # noqa: GL202, E501
                         if self._pool is not None:
                             # _pool_store_jit donates the pool buffer —
                             # a failed store leaves it consumed/poisoned
@@ -2132,7 +2163,11 @@ class GenerationEngine:
                                 self.max_seq, dtype=self._kv_dtype)
                             if self._pool_sh is not None:
                                 pool = jax.device_put(pool, self._pool_sh)
-                            self._pool = jax.block_until_ready(pool)
+                            # re-account (set semantics): the donated
+                            # old pool died with the failed dispatch
+                            self._pool = hbm.account(
+                                "kvcache-t0", jax.block_until_ready(pool),
+                                owner=self, tag="pool")
                         if self._paged:
                             from ..models.paged_llama import init_paged_cache
 
@@ -2145,17 +2180,21 @@ class GenerationEngine:
                                 # too — a failed chunk dispatch leaves it
                                 # consumed, bricking every later
                                 # long-prompt admission
-                                self._scratch = jax.block_until_ready(
-                                    llama.init_cache(
-                                        self.cfg, 1, self.max_seq,
-                                        dtype=self._kv_dtype))
+                                self._scratch = hbm.account(
+                                    "engine", jax.block_until_ready(
+                                        llama.init_cache(
+                                            self.cfg, 1, self.max_seq,
+                                            dtype=self._kv_dtype)),
+                                    owner=self, tag="scratch")
                         else:
                             cache = llama.init_cache(self.cfg, self.n_slots,
                                                      self.max_seq,
                                                      dtype=self._kv_dtype)
                         if self._cache_sh is not None:
                             cache = jax.device_put(cache, self._cache_sh)
-                        self.cache = jax.block_until_ready(cache)
+                        self.cache = hbm.account(
+                            "engine", jax.block_until_ready(cache),
+                            owner=self, tag="cache")
                     if self.logger is not None:
                         self.logger.warn({"event": "generation cache "
                                           "reallocated after device failure"})

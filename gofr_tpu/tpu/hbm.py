@@ -1,0 +1,184 @@
+"""Device-memory accounting: the choke point every persistent HBM
+allocation flows through.
+
+The ROADMAP's unified memory arbiter needs one thing before it can
+exist: visibility. ``BENCH_CANDIDATE.json`` shows the prefix-cache,
+engine and speculative arms dying with RESOURCE_EXHAUSTED and the
+paged engine OOMing at every batch size because each subsystem
+allocates HBM blindly — nobody can SEE device memory, so nobody can
+rebalance it. This module is the accounting substrate: every
+persistent device buffer a serving subsystem creates is declared here
+(gofrlint GL202 enforces it statically), so the registry always knows
+how many bytes each subsystem holds. The arbiter refactor will grow
+lease/rebalance semantics on top of exactly this table; today it
+feeds:
+
+  - the ``app_tpu_device_bytes{subsystem=...}`` Prometheus gauges
+    (register a metrics Manager via :func:`set_metrics` — the engine
+    wiring does) and the ``device_memory`` section of ``/debug/vars``;
+  - ``gofr_tpu/testutil/hbmwatch.py``, which reconciles these declared
+    bytes against ``jax.live_arrays()`` ground truth under
+    ``pytest --hbmwatch``;
+  - ``tools/hbm_report.py``, the operator's attribution table.
+
+Usage — wrap the allocation at its persist point; ``account`` RETURNS
+the tree so it composes inline::
+
+    self.cache = hbm.account("engine", llama.init_cache(...),
+                             owner=self, tag="cache")
+
+Entries are keyed ``(subsystem, owner, tag)`` with SET semantics:
+re-accounting the same key (recovery reallocation, mesh re-placement
+via ``device_put``) replaces the figure instead of double-counting —
+the old buffer was consumed/freed by whatever produced the new one.
+``owner`` scopes entries to an engine instance so two engines in one
+process (tests, A/B serving) attribute independently; an owner's
+``close()`` must call :func:`release`, which is how hbmwatch proves a
+closed engine actually let go of its bytes.
+
+Subsystem names are free-form but the serving stack uses a fixed
+vocabulary so dashboards line up: ``engine`` (serving KV cache +
+chunk scratch row), ``kvcache-t0`` (prefix-pool rows), ``lora``
+(adapter weight stacks), ``spec-decode`` (verify buffers, when they
+grow device state), ``batcher`` (coalesced staging, likewise).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any
+
+__all__ = ["account", "release", "live_bytes", "set_metrics",
+           "tree_nbytes", "reset", "snapshot"]
+
+GAUGE = "app_tpu_device_bytes"
+
+
+def tree_nbytes(tree: Any) -> int:
+    """Total bytes of the array leaves of ``tree`` (jax or numpy —
+    anything with ``nbytes``). None leaves (e.g. absent scale planes)
+    contribute nothing."""
+    import jax
+
+    return sum(int(getattr(leaf, "nbytes", 0) or 0)
+               for leaf in jax.tree.leaves(tree))
+
+
+class _Registry:
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        # (subsystem, owner_id, tag) -> bytes
+        self._entries: dict[tuple[str, int, str], int] = {}
+        # gauge sinks, weakly held: the registry outlives any Manager
+        # and must neither pin one alive nor stop pushing to A because
+        # B registered later (two engines, two Managers — both see the
+        # same process-truth figures)
+        self._sinks: "weakref.WeakSet[Any]" = weakref.WeakSet()
+
+    def account(self, subsystem: str, tree: Any, *, owner: Any = None,
+                tag: str = "") -> Any:
+        key = (subsystem, id(owner) if owner is not None else 0, tag)
+        n = tree_nbytes(tree)
+        with self._mu:
+            self._entries[key] = n
+        if owner is not None:
+            # safety net for owners that die WITHOUT close() — an
+            # __init__ that OOMs after its first account() (exactly
+            # the regime this registry exists for) must not leave
+            # phantom bytes behind, and a reused id() must not alias a
+            # dead owner's entries. Idempotent with close()'s explicit
+            # release; runs at the owner's collection.
+            try:
+                weakref.finalize(owner, self._release_owner_id,
+                                 id(owner))
+            except TypeError:
+                pass  # non-weakrefable owner: explicit release only
+        self._push(subsystem)
+        return tree
+
+    def _release_owner_id(self, oid: int) -> None:
+        touched: set[str] = set()
+        with self._mu:
+            for key in list(self._entries):
+                if key[1] == oid:
+                    self._entries.pop(key)
+                    touched.add(key[0])
+        for sub in touched:
+            self._push(sub)
+
+    def release(self, subsystem: str | None = None, *,
+                owner: Any = None) -> int:
+        """Drop entries by subsystem and/or owner; returns the bytes
+        released. ``release(owner=self)`` in ``close()`` drops every
+        subsystem the instance accounted."""
+        oid = None if owner is None else id(owner)
+        dropped = 0
+        touched: set[str] = set()
+        with self._mu:
+            for key in list(self._entries):
+                sub, key_oid, _ = key
+                if subsystem is not None and sub != subsystem:
+                    continue
+                if oid is not None and key_oid != oid:
+                    continue
+                dropped += self._entries.pop(key)
+                touched.add(sub)
+        for sub in touched:
+            self._push(sub)
+        return dropped
+
+    def live_bytes(self) -> dict[str, int]:
+        """Accounted bytes aggregated by subsystem (zero-byte
+        subsystems with live keys included — a released-to-zero
+        subsystem disappears)."""
+        out: dict[str, int] = {}
+        with self._mu:
+            for (sub, _, _), n in self._entries.items():
+                out[sub] = out.get(sub, 0) + n
+        return dict(sorted(out.items()))
+
+    def snapshot(self) -> dict[tuple[str, int, str], int]:
+        with self._mu:
+            return dict(self._entries)
+
+    def set_metrics(self, metrics: Any) -> None:
+        """Attach a metrics Manager (weakly held; every attached
+        Manager receives every later change as
+        ``app_tpu_device_bytes{subsystem=...}``). ``None`` detaches
+        all sinks."""
+        if metrics is None:
+            self._sinks.clear()
+            return
+        self._sinks.add(metrics)
+        for sub in self.live_bytes():
+            self._push(sub)
+
+    def reset(self) -> None:
+        """Test hook: forget everything (and zero pushed gauges)."""
+        with self._mu:
+            subs = {sub for (sub, _, _) in self._entries}
+            self._entries.clear()
+        for sub in subs:
+            self._push(sub)
+
+    def _push(self, subsystem: str) -> None:
+        sinks = list(self._sinks)
+        if not sinks:
+            return
+        value = float(self.live_bytes().get(subsystem, 0))
+        for m in sinks:
+            try:
+                m.set_gauge(GAUGE, value, subsystem=subsystem)
+            except Exception:
+                pass  # accounting must never take the serving path down
+
+
+_registry = _Registry()
+
+account = _registry.account
+release = _registry.release
+live_bytes = _registry.live_bytes
+snapshot = _registry.snapshot
+set_metrics = _registry.set_metrics
+reset = _registry.reset

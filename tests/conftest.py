@@ -61,6 +61,13 @@ def pytest_addoption(parser):
              "watchdog (gofr_tpu.testutil.lockwatch) and fail the "
              "session on any observed order inversion — this repo's "
              "`go test -race`")
+    parser.addoption(
+        "--hbmwatch", action="store_true", default=False,
+        help="snapshot live device bytes around every test "
+             "(gofr_tpu.testutil.hbmwatch over jax.live_arrays + the "
+             "hbm accounting registry); print per-test leak deltas "
+             "and fail the session on retained growth — the memory "
+             "sibling of --lockwatch")
 
 
 def pytest_configure(config):
@@ -70,6 +77,19 @@ def pytest_configure(config):
         watch = LockWatch(name="pytest-session")
         watch.install()
         config._lockwatch = watch
+    from gofr_tpu.testutil import hbmwatch as hbmwatch_mod
+
+    hbmwatch_mod.install_session_watch(config)
+
+
+@pytest.fixture
+def hbmwatch():
+    """A fresh HBMWatch for steady-state leak assertions
+    (assert_flat: N warmups, then live device bytes must stay flat).
+    Independent of --hbmwatch: regression tests always assert."""
+    from gofr_tpu.testutil.hbmwatch import HBMWatch
+
+    return HBMWatch("fixture")
 
 
 def pytest_unconfigure(config):

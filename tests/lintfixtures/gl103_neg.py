@@ -14,3 +14,5 @@ def pure(x):
 
 def collect(x):
     _RESULTS.append(pure(x))
+    while len(_RESULTS) > 8:
+        _RESULTS.pop(0)

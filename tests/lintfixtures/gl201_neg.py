@@ -1,0 +1,47 @@
+"""GL201 negative: rebinding kills, metadata reads, and the
+`# gl: consumed` annotation all keep donated flows clean."""
+import jax
+
+
+def _step(cache, tokens):
+    return cache
+
+
+step_jit = jax.jit(_step, donate_argnums=(0,))
+plain_jit = jax.jit(_step)  # no donation: args stay readable
+
+
+class Engine:
+    def __init__(self):
+        self.cache = object()
+        self._step_jit = jax.jit(_step, donate_argnums=(0,))
+
+    def tick(self, tokens):
+        # same-statement rebind: the donated buffer is replaced by the
+        # jit's output before anything can read it
+        self.cache = self._step_jit(self.cache, tokens)
+        return self.cache
+
+    def tick_later_rebind(self, tokens):
+        out = self._step_jit(self.cache, tokens)
+        self.cache = out
+        return self.cache
+
+    def tick_metadata(self, cache, tokens):
+        out = step_jit(cache, tokens)
+        shape = cache.shape  # metadata survives donation (aval)
+        return out, shape
+
+    def tick_annotated(self, cache, tokens):
+        out = step_jit(cache, tokens)
+        probe = cache  # gl: consumed — conditional donation, re-checked
+        return out, probe
+
+    def tick_undonated(self, cache, tokens):
+        out = plain_jit(cache, tokens)
+        return out, cache
+
+    def loop_rebinds(self, tokens):
+        for t in tokens:
+            self.cache = self._step_jit(self.cache, t)
+        return self.cache

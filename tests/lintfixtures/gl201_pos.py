@@ -1,0 +1,33 @@
+"""GL201 positive: donated buffers used after the donating dispatch."""
+import jax
+
+
+def _step(cache, tokens):
+    return cache
+
+
+step_jit = jax.jit(_step, donate_argnums=(0,))
+
+
+class Engine:
+    def __init__(self):
+        self.cache = object()
+        self._step_jit = jax.jit(_step, donate_argnums=(0,))
+
+    def tick(self, tokens):
+        out = self._step_jit(self.cache, tokens)
+        return self.cache, out  # EXPECT: GL201
+
+    def tick_local(self, cache, tokens):
+        out = step_jit(cache, tokens)
+        probe = cache  # EXPECT: GL201
+        return out, probe
+
+    def loop_carried(self, tokens):
+        for t in tokens:
+            use(self.cache)  # EXPECT: GL201
+            self._step_jit(self.cache, t)  # EXPECT: GL201
+
+
+def use(x):
+    return x

@@ -1,0 +1,33 @@
+"""GL202 negative: accounted persistence, transient allocations, and
+dispatch operands stay clean."""
+import jax
+import jax.numpy as jnp
+
+from gofr_tpu.tpu import hbm
+
+
+def init_cache(slots):
+    return jnp.zeros((slots, 8))
+
+
+def dispatch(params, toks):
+    return toks
+
+
+class Engine:
+    def __init__(self, slots, params):
+        # wrapped at the persist point
+        self.cache = hbm.account("engine", init_cache(slots),
+                                 owner=self, tag="cache")
+        # local flow into the accounting API
+        pool = init_cache(slots)
+        pool = jax.device_put(pool)
+        self.pool = hbm.account("kvcache-t0", pool, owner=self)
+
+    def warmup(self, params):
+        # transient: dies with the function
+        toks = jnp.zeros((1, 8), jnp.int32)
+        out = dispatch(params, toks)
+        # operand of a dispatch, not persisted by the assignment
+        self.last = dispatch(params, jnp.zeros((1, 8), jnp.int32))
+        return out

@@ -1,0 +1,19 @@
+"""GL202 positive: persistent device allocations that never flow
+through the hbm accounting API."""
+import jax
+import jax.numpy as jnp
+
+
+def init_cache(slots):
+    return jnp.zeros((slots, 8))
+
+
+class Engine:
+    def __init__(self, slots, params):
+        self.cache = init_cache(slots)  # EXPECT: GL202
+        self.mask = jnp.zeros((slots,), jnp.int32)  # EXPECT: GL202
+        buf = jnp.ones((slots, 4))  # EXPECT: GL202
+        self.buf = jax.block_until_ready(buf)
+
+    def recover(self, slots):
+        self.cache = jax.device_put(init_cache(slots))  # EXPECT: GL202

@@ -1,0 +1,32 @@
+"""GL203 negative: growth paired with eviction, setup-phase inserts,
+and constant resets are all bounded shapes."""
+
+_RECENT = []
+
+
+class LruCache:
+    def __init__(self):
+        self._entries = {}
+        self._rows = [None] * 4
+        self._programs = {}
+
+    def store(self, key, row):
+        self._entries[key] = row  # evicted below: bounded
+        self._rows[0] = row
+
+    def evict_one(self):
+        if self._entries:
+            self._entries.pop(next(iter(self._entries)))
+
+    def retire(self, idx):
+        self._rows[idx] = None  # constant reset, not growth
+
+    def register(self, name, prog):
+        self._programs[name] = prog  # setup phase: bounded by config
+
+
+def handle(request):
+    _RECENT.append(request)
+    while len(_RECENT) > 16:
+        _RECENT.pop(0)
+    return len(_RECENT)

@@ -1,0 +1,22 @@
+"""GL203 positive: request-path container growth with no eviction
+anywhere in the class (the flat-prefix-cache leak shape)."""
+
+_RECENT = []
+
+
+class FlatCache:
+    def __init__(self):
+        self._entries = {}
+        self._order = []
+
+    def store(self, key, row):
+        self._entries[key] = row  # EXPECT: GL203
+        self._order.append(key)  # EXPECT: GL203
+
+    def match(self, key):
+        return self._entries.get(key)
+
+
+def handle(request):
+    _RECENT.append(request)  # EXPECT: GL203
+    return len(_RECENT)

@@ -1,0 +1,38 @@
+"""GL204 negative: OOM rethrown or routed to the admission-shed path
+is fail-closed handling."""
+
+
+class XlaRuntimeError(Exception):
+    pass
+
+
+class TooManyRequests(Exception):
+    pass
+
+
+def dispatch(fn, batch):
+    return fn(batch)
+
+
+def run_rethrow(fn, batch, logger):
+    try:
+        return dispatch(fn, batch)
+    except XlaRuntimeError:
+        logger.error({"event": "device oom"})
+        raise
+
+
+def run_shed(fn, batch, gate):
+    try:
+        return dispatch(fn, batch)
+    except XlaRuntimeError:
+        return gate.shed_oom(batch)
+
+
+def run_string_match(fn, batch, gate):
+    try:
+        return dispatch(fn, batch)
+    except Exception as e:
+        if "RESOURCE_EXHAUSTED" in str(e):
+            raise TooManyRequests("device memory exhausted") from e
+        raise

@@ -41,7 +41,8 @@ NEGATIVES = [p for p in ALL_FIXTURES if p.name.endswith("_neg.py")]
 
 ALL_CODES = {"F401", "F811", "E501", "E711", "E722", "B006", "B011",
              "F601", "F541", "W291", "W191", "T201", "E999",
-             "GL001", "GL002", "GL101", "GL102", "GL103"}
+             "GL001", "GL002", "GL101", "GL102", "GL103",
+             "GL201", "GL202", "GL203", "GL204"}
 
 # Fixtures whose finding line cannot carry an inline `# EXPECT:` marker:
 # a comment would remove the trailing whitespace (W291), sit on a
@@ -252,6 +253,29 @@ def test_stats_last_line_json_contract(tmp_path):
     assert obj["tool"] == "gofrlint"
     assert obj["files"] == 1 and obj["findings"] == 1 and obj["new"] == 1
     assert obj["by_code"] == {"F401": 1} and obj["ok"] is False
+    # per-pass breakdown: every pass present (zero included), so CI
+    # output names the regressing pass — and a pass silently dropping
+    # out of the run is itself visible
+    assert set(obj["by_pass"]) == {"style", "locks", "hotpath",
+                                   "resources"}
+    assert obj["by_pass"]["style"] == {"findings": 1, "new": 1}
+    assert obj["by_pass"]["resources"] == {"findings": 0, "new": 0}
+
+
+def test_stats_by_pass_attributes_resource_findings(tmp_path):
+    src = ("import jax\n\n\n"
+           "def f(cache, t):\n    return cache\n\n\n"
+           "g = jax.jit(f, donate_argnums=(0,))\n\n\n"
+           "def tick(cache, t):\n"
+           "    out = g(cache, t)\n"
+           "    return out, cache\n")  # GL201
+    dst = scaffold(tmp_path, "mod.py", src)
+    p = run_cli(str(dst), "--stats")
+    assert p.returncode == 1
+    obj = json.loads(p.stdout.strip().splitlines()[-1])
+    assert obj["by_code"] == {"GL201": 1}
+    assert obj["by_pass"]["resources"] == {"findings": 1, "new": 1}
+    assert obj["by_pass"]["style"] == {"findings": 0, "new": 0}
 
 
 def test_select_filters_by_prefix(tmp_path):
@@ -355,6 +379,86 @@ def test_gl101_cold_path_prefixes_exempt_underscored_names(tmp_path):
     assert got == [(16, "GL101")], got  # only hot() flagged
 
 
+def test_select_gl2_prefix_isolates_resource_pass(tmp_path):
+    # one F401 + one GL203: --select GL2 must report only the
+    # resource-pass finding (the CI liveness step's exact invocation)
+    src = ("import os\n\n\nclass C:\n"
+           "    def __init__(self):\n"
+           "        self._held = []\n\n"
+           "    def handle(self, x):\n"
+           "        self._held.append(x)\n")
+    dst = scaffold(tmp_path, "mod.py", src)
+    p = run_cli(str(dst), "--select", "GL2")
+    assert p.returncode == 1
+    assert "GL203" in p.stdout and "F401" not in p.stdout
+
+
+def test_gl201_same_statement_rebind_is_clean(tmp_path):
+    # `self.cache = self._step(self.cache, t)` donates AND rebinds in
+    # one statement — the canonical serving-loop shape must stay silent
+    src = ("import jax\n\n\n"
+           "def f(cache, t):\n    return cache\n\n\n"
+           "class E:\n"
+           "    def __init__(self):\n"
+           "        self._step = jax.jit(f, donate_argnums=(0,))\n"
+           "        self.cache = object()\n\n"
+           "    def tick(self, t):\n"
+           "        self.cache = self._step(self.cache, t)\n"
+           "        return self.cache\n")
+    dst = scaffold(tmp_path, "mod.py", src)
+    assert analyze(dst) == []
+
+
+def test_gl201_donate_argnames_tracked(tmp_path):
+    src = ("import jax\n\n\n"
+           "def f(t, cache=None):\n    return cache\n\n\n"
+           "g = jax.jit(f, donate_argnames=('cache',))\n\n\n"
+           "def tick(cache, t):\n"
+           "    out = g(t, cache=cache)\n"
+           "    return out, cache\n")
+    dst = scaffold(tmp_path, "mod.py", src)
+    got = analyze(dst)
+    assert got == [(13, "GL201")], got  # the `return out, cache`
+
+
+def test_gl202_local_flow_through_account_is_clean(tmp_path):
+    # allocation -> local -> device_put -> hbm.account(...) at the
+    # persist point: the recovery-path shape must stay silent
+    src = ("import jax\nimport jax.numpy as jnp\n\n\n"
+           "class E:\n"
+           "    def recover(self, hbm):\n"
+           "        pool = jnp.zeros((4, 8))\n"
+           "        pool = jax.device_put(pool)\n"
+           "        self.pool = hbm.account('kvcache-t0', pool,\n"
+           "                                owner=self)\n")
+    dst = scaffold(tmp_path, "mod.py", src)
+    assert analyze(dst) == []
+
+
+def test_gl202_dispatch_operand_not_persisted(tmp_path):
+    # warmup shape: an allocated dummy fed to a dispatch whose OUTPUT
+    # is persisted — the allocation is consumed, not persisted
+    src = ("import jax\nimport jax.numpy as jnp\n\n\n"
+           "class E:\n"
+           "    def warmup(self, step):\n"
+           "        toks = jnp.zeros((1, 8), jnp.int32)\n"
+           "        self.cache = step(self.cache, toks)\n")
+    dst = scaffold(tmp_path, "mod.py", src)
+    assert analyze(dst) == []
+
+
+def test_gl203_reassignment_counts_as_eviction(tmp_path):
+    src = ("class C:\n"
+           "    def __init__(self):\n"
+           "        self._held = []\n\n"
+           "    def grab(self, x):\n"
+           "        self._held.append(x)\n\n"
+           "    def recycle(self):\n"
+           "        self._held = [h for h in self._held if h.live]\n")
+    dst = scaffold(tmp_path, "mod.py", src)
+    assert analyze(dst) == []
+
+
 def test_repo_reports_zero_unbaselined_findings():
     """The CI `analysis` job's exact gate: the checked-in baseline
     covers the whole repo, with no stale entries."""
@@ -370,10 +474,15 @@ def test_repo_reports_zero_unbaselined_findings():
 
 FIXED_MODULES = [
     "gofr_tpu/tpu/batcher.py",        # GL001: reap outside the lock
-    "gofr_tpu/tpu/generator.py",      # GL001: retire loop outside device lock
+    "gofr_tpu/tpu/generator.py",      # GL001: retire loop outside device
+                                      # lock; GL202: cache/pool/scratch/
+                                      # lora accounting threaded
     "gofr_tpu/tpu/kvcache/__init__.py",  # GL101: per-leaf device_get loop
     "gofr_tpu/wire.py",               # GL001: deferred count outside _blk
     "gofr_tpu/grpcx/client.py",       # GL001: unlocked _closed flip
+    "gofr_tpu/tpu/engine.py",         # GL203: register/gate growth triaged
+    "gofr_tpu/tpu/hbm.py",            # the GL202 accounting API itself
+    "gofr_tpu/testutil/hbmwatch.py",  # the GL2xx runtime harness
 ]
 
 

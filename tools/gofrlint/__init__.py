@@ -5,12 +5,15 @@ Reference parity: the reference GoFr CI blocks on golangci-lint and
 Python equivalent, grown from the single-file tools/lint.py fallback
 linter into three passes:
 
-  style    — the original hermetic rule set (F401/F811/E501/E711/E722/
-             B006/B011/F601/F541/W291/W191/T201/E999)
-  locks    — GL001 unguarded writes to lock-guarded attributes,
-             GL002 lock-acquisition-order cycles (potential deadlocks)
-  hotpath  — GL101 host syncs inside decode/step/dispatch loops,
-             GL102 jit recompile hazards, GL103 tracer leakage
+  style     — the original hermetic rule set (F401/F811/E501/E711/E722/
+              B006/B011/F601/F541/W291/W191/T201/E999)
+  locks     — GL001 unguarded writes to lock-guarded attributes,
+              GL002 lock-acquisition-order cycles (potential deadlocks)
+  hotpath   — GL101 host syncs inside decode/step/dispatch loops,
+              GL102 jit recompile hazards, GL103 tracer leakage
+  resources — GL201 use-after-donate, GL202 unaccounted device
+              allocations, GL203 unbounded request-path container
+              growth, GL204 fail-open OOM handling
 
 Every rule honors `# noqa` / `# noqa: CODE` line suppression (applied
 centrally). Accepted findings live in tools/gofrlint_baseline.json; CI
@@ -18,7 +21,9 @@ runs `python -m tools.gofrlint --baseline tools/gofrlint_baseline.json`
 and fails on new findings AND on stale baseline entries. The runtime
 complement (the lock-order watchdog that is this repo's `go test
 -race`) is gofr_tpu/testutil/lockwatch.py, enabled over the threaded
-tier-1 tests with `pytest --lockwatch`.
+tier-1 tests with `pytest --lockwatch`; the resources pass's runtime
+complement is gofr_tpu/testutil/hbmwatch.py (`pytest --hbmwatch`), the
+live-device-buffer leak harness.
 
 See docs/advanced-guide/static-analysis.md for the rule catalog.
 """
@@ -27,10 +32,22 @@ from __future__ import annotations
 
 from pathlib import Path
 
-from . import hotpath, locks, style
+from . import hotpath, locks, resources, style
 from .base import Finding, SourceFile, collect_files
 
-__all__ = ["Finding", "SourceFile", "collect_files", "run"]
+__all__ = ["Finding", "SourceFile", "collect_files", "pass_of", "run"]
+
+# code -> pass, for the per-pass --stats breakdown (CI must see WHICH
+# pass regressed, not one aggregate bucket)
+_PASS_PREFIXES = (("GL0", "locks"), ("GL1", "hotpath"),
+                  ("GL2", "resources"))
+
+
+def pass_of(code: str) -> str:
+    for prefix, name in _PASS_PREFIXES:
+        if code.startswith(prefix):
+            return name
+    return "style"
 
 _REPO = Path(__file__).resolve().parent.parent.parent
 
@@ -53,6 +70,7 @@ def run(roots: list[Path], select: set[str] | None = None
     files = collect_files(roots)
     lock_pass = locks.LockPass()
     hot_pass = hotpath.HotPathPass()
+    res_pass = resources.ResourcePass()
     findings: list[Finding] = []
     sources: dict[str, SourceFile] = {}
     for path in files:
@@ -61,8 +79,10 @@ def run(roots: list[Path], select: set[str] | None = None
         findings.extend(style.run(sf))
         lock_pass.feed(sf)
         hot_pass.feed(sf)
+        res_pass.feed(sf)
     findings.extend(lock_pass.finish())
     findings.extend(hot_pass.findings)
+    findings.extend(res_pass.findings)
     findings = [f for f in findings
                 if f.path not in sources
                 or not sources[f.path].suppressed(f)]

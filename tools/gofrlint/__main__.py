@@ -15,7 +15,7 @@ from collections import Counter
 from pathlib import Path
 
 from . import baseline as baseline_mod
-from . import run
+from . import pass_of, run
 
 REPO = Path(__file__).resolve().parent.parent.parent
 
@@ -86,6 +86,16 @@ def main(argv: list[str] | None = None) -> int:
               f"entr(ies), {n_files} file(s)", file=sys.stderr)
     else:
         by_code = Counter(f.code for f in findings)
+        # per-pass breakdown: CI output must show WHICH pass regressed
+        # (one aggregate bucket hides a resources regression behind a
+        # style fix). Every pass always appears, zero or not, so a
+        # pass silently dropping from the run is itself visible.
+        by_pass = {name: {"findings": 0, "new": 0}
+                   for name in ("style", "locks", "hotpath", "resources")}
+        for f in findings:
+            by_pass[pass_of(f.code)]["findings"] += 1
+        for f in new:
+            by_pass[pass_of(f.code)]["new"] += 1
         print(json.dumps({
             "tool": "gofrlint",
             "files": n_files,
@@ -94,6 +104,7 @@ def main(argv: list[str] | None = None) -> int:
             "stale_baseline": len(stale),
             "baselined": len(findings) - len(new),
             "by_code": {k: by_code[k] for k in sorted(by_code)},
+            "by_pass": by_pass,
             "ok": not failed,
         }, sort_keys=False))
     return 1 if failed else 0

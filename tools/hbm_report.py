@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Device-memory attribution report: who holds how much HBM.
+
+Renders the hbm accounting registry (``gofr_tpu/tpu/hbm.py`` — the
+table every GL202-checked allocation flows through) against
+``jax.live_arrays()`` ground truth. Two modes:
+
+  - attach mode (default when subsystems already accounted bytes in
+    this process — e.g. imported from a notebook/REPL next to a live
+    engine): report what the registry holds right now;
+  - demo mode (the common CLI case, or ``--demo``): build a tiny CPU
+    GenerationEngine with a prefix pool, serve a few requests, report
+    with the engine live, then close it and report again — showing the
+    release path works (the same reconciliation ``pytest --hbmwatch``
+    gates on).
+
+CPU-only by default (JAX_PLATFORMS honored if already set): the point
+is attribution plumbing, not chip numbers — no chip lock taken.
+Stdout contract (tools/README.md): the LAST line is the JSON
+artifact; earlier lines are the human-readable table on stderr/stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def table(att: dict) -> str:
+    rows = [f"  {'subsystem':<14} {'bytes':>12}"]
+    for sub, n in att["accounted"].items():
+        rows.append(f"  {sub:<14} {n:>12}")
+    rows.append(f"  {'(unattributed)':<14} {att['unattributed']:>12}")
+    rows.append(f"  {'live total':<14} {att['live_bytes']:>12}")
+    return "\n".join(rows)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description="HBM attribution report")
+    ap.add_argument("--demo", action="store_true",
+                    help="force the tiny-engine demo even if the "
+                         "registry already has entries")
+    ap.add_argument("--requests", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    from gofr_tpu.testutil.hbmwatch import attribution
+    from gofr_tpu.tpu import hbm
+
+    artifact: dict = {"tool": "hbm_report"}
+    demo = args.demo or not hbm.live_bytes()
+    if demo:
+        import jax
+        import numpy as np
+
+        from gofr_tpu.models import LLAMA_CONFIGS, llama
+        from gofr_tpu.tpu import GenerationEngine
+
+        log("hbm_report: demo mode — tiny engine + prefix pool, "
+            f"{args.requests} request(s)")
+        cfg = LLAMA_CONFIGS["tiny"]
+        eng = GenerationEngine(cfg, llama.init(cfg, jax.random.PRNGKey(0)),
+                               slots=2, max_seq=128,
+                               prompt_buckets=(16, 32),
+                               prefix_cache_slots=2,
+                               prefix_store_min=16)
+        try:
+            rng = np.random.default_rng(0)
+            for _ in range(max(1, args.requests)):
+                prompt = rng.integers(1, cfg.vocab_size, size=24)
+                eng.generate(prompt, max_new_tokens=4).tokens()
+            att_live = attribution()
+            log("attribution with engine live:")
+            log(table(att_live))
+            artifact["serving"] = att_live
+        finally:
+            eng.close()
+        del eng
+        import gc
+
+        gc.collect()  # freed buffers must not read as live
+        att_closed = attribution()
+        log("attribution after close():")
+        log(table(att_closed))
+        artifact["after_close"] = att_closed
+        artifact["released_ok"] = not att_closed["accounted"]
+    else:
+        att = attribution()
+        log("attribution (attach mode):")
+        log(table(att))
+        artifact["serving"] = att
+    print(json.dumps(artifact))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
