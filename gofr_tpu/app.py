@@ -35,6 +35,7 @@ from .http.middleware import (
     logging_middleware,
     metrics_middleware,
     oauth_middleware,
+    slo_class_middleware,
     tracer_middleware,
     JWKSKeyProvider,
 )
@@ -106,6 +107,7 @@ class App:
         self.router.use(inflight_middleware(self.container.observe.requests))
         self.router.use(logging_middleware(self.logger))
         self.router.use(deadline_middleware())
+        self.router.use(slo_class_middleware())
         self.router.use(cors_middleware())
         self.router.use(metrics_middleware(self.container.metrics))
 

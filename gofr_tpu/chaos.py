@@ -23,6 +23,10 @@ Seams (grep for ``chaos.fire``):
                       models slow/failing device dispatch for ``predict``
   GENERATOR_PREFILL   tpu/generator._start, before the prefill dispatch —
                       a raised error fails ONE stream (admission error path)
+  GENERATOR_CHUNK     tpu/generator._chunk_lattice, before EACH mid-chunk
+                      dispatch of a chunked prefill — indexing by chunk
+                      lets a schedule kill chunk N of a long admission
+                      specifically (mid-chunk DeviceLost recovery)
   GENERATOR_STEP      tpu/generator._loop, before a decode tick — a raised
                       ``DeviceLost`` exercises the full loop-recovery path
                       (cache reallocation, waiter fail-fast)
@@ -49,7 +53,8 @@ import threading
 import time
 
 __all__ = [
-    "BATCHER_DISPATCH", "GENERATOR_PREFILL", "GENERATOR_STEP",
+    "BATCHER_DISPATCH", "GENERATOR_CHUNK", "GENERATOR_PREFILL",
+    "GENERATOR_STEP",
     "GRPC_STREAM", "HTTP_REQUEST", "SERVICE_REQUEST", "SEAMS",
     "ChaosSchedule", "DeviceLost", "Rule",
     "active", "fire", "install", "scope", "slow_h2_preface", "slow_loris",
@@ -57,14 +62,15 @@ __all__ = [
 ]
 
 BATCHER_DISPATCH = "batcher.dispatch"
+GENERATOR_CHUNK = "generator.chunk"
 GENERATOR_PREFILL = "generator.prefill"
 GENERATOR_STEP = "generator.step"
 GRPC_STREAM = "grpc.stream"
 HTTP_REQUEST = "http.request"
 SERVICE_REQUEST = "service.request"
 
-SEAMS = (BATCHER_DISPATCH, GENERATOR_PREFILL, GENERATOR_STEP,
-         GRPC_STREAM, HTTP_REQUEST, SERVICE_REQUEST)
+SEAMS = (BATCHER_DISPATCH, GENERATOR_CHUNK, GENERATOR_PREFILL,
+         GENERATOR_STEP, GRPC_STREAM, HTTP_REQUEST, SERVICE_REQUEST)
 
 
 class DeviceLost(RuntimeError):

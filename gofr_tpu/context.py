@@ -79,6 +79,17 @@ class Context:
 
         return current_deadline()
 
+    @property
+    def slo_class(self) -> str:
+        """The request's serving class (``latency`` default /
+        ``throughput``), parsed from ``X-SLO-Class`` / gRPC
+        ``slo-class`` by the transport. Ambient like the deadline:
+        ``ctx.tpu.predict``/``generate`` pick it up automatically
+        (docs/advanced-guide/serving-scheduler.md)."""
+        from .resilience import current_slo_class
+
+        return current_slo_class()
+
     # -- streaming (no reference equivalent: the reference has no HTTP
     # streaming path; needed for token streaming over chunked responses) ----
     def stream(self, chunks, content_type: str = "application/x-ndjson") -> None:

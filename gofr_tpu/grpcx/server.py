@@ -26,7 +26,8 @@ from . import http2 as h2
 from . import service as svc
 from .hpack import Decoder, Encoder, encode_stateless
 from .. import chaos, wire
-from ..resilience import Deadline, deadline_scope
+from ..resilience import (Deadline, deadline_scope, parse_slo_class,
+                          slo_scope)
 from ..wire import Outbox
 
 _GRPC_CONTENT_TYPES = ("application/grpc",)
@@ -714,11 +715,14 @@ class GRPCServer:
                 raise svc.GRPCError(svc.INVALID_ARGUMENT,
                                     f"bad request: {e!r}") from None
 
-        # the wire deadline becomes AMBIENT for the handler thread:
-        # ctx.tpu.predict / generate pick it up without per-call
-        # plumbing, so expired work is dropped before the device sees it
+        # the wire deadline and SLO class become AMBIENT for the
+        # handler thread: ctx.tpu.predict / generate pick them up
+        # without per-call plumbing, so expired work is dropped before
+        # the device sees it and ``slo-class: throughput`` metadata
+        # routes the request through the batch-traffic line
         with deadline_scope(Deadline(deadline) if deadline is not None
-                            else None):
+                            else None), \
+                slo_scope(parse_slo_class(metadata.get("slo-class"))):
             if method.client_streaming:
                 # handler receives a lazy iterator over the request
                 # stream; it ends at the client's half-close

@@ -20,7 +20,8 @@ import time
 from typing import Callable, Iterable
 
 from ..errors import HTTPError, format_retry_after
-from ..resilience import Deadline, deadline_scope, parse_http_timeout
+from ..resilience import (Deadline, deadline_scope, parse_http_timeout,
+                          parse_slo_class, slo_scope)
 from .request import Request
 from .responder import ResponseWriter
 from .router import Handler, Middleware
@@ -138,6 +139,23 @@ def deadline_middleware(header: str = "X-Request-Timeout") -> Middleware:
             if timeout is None:
                 return next_h(req, w)
             with deadline_scope(Deadline.after(timeout)):
+                next_h(req, w)
+        return wrapped
+    return mw
+
+
+def slo_class_middleware(header: str = "X-SLO-Class") -> Middleware:
+    """Parse the request's SLO class header into the AMBIENT class
+    (resilience.slo_scope) for the handler's thread — the HTTP mirror
+    of gRPC's ``slo-class`` metadata. Downstream, ``ctx.tpu.predict``
+    and ``generate`` pick it up: ``throughput`` (aliases: batch, bulk,
+    offline) marks the request as deprioritizable batch work — longer
+    queueing for fuller batches, shed/browned-out first under overload
+    — while anything else (including no header) keeps the full
+    latency-class SLO (docs/advanced-guide/serving-scheduler.md)."""
+    def mw(next_h: Handler) -> Handler:
+        def wrapped(req: Request, w: ResponseWriter) -> None:
+            with slo_scope(parse_slo_class(req.header(header))):
                 next_h(req, w)
         return wrapped
     return mw

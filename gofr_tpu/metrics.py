@@ -322,7 +322,13 @@ def register_framework_metrics(m: Manager) -> None:
                   "deadline expired (never executed)")
     m.new_counter("app_tpu_shed_total",
                   "requests rejected early by the admission gate "
-                  "(429/RESOURCE_EXHAUSTED with Retry-After)")
+                  "(429/RESOURCE_EXHAUSTED with Retry-After), by "
+                  "slo_class — throughput-class sheds first under "
+                  "class degradation")
+    m.new_counter("app_tpu_prefill_chunks_total",
+                  "mid-chunk dispatches of chunked prefills (each one "
+                  "is a bounded slice of a long prompt interleaved "
+                  "with decode/admission; serving-scheduler.md)")
     m.new_counter("app_tpu_brownout_capped_total",
                   "generation requests whose max_new_tokens was capped by "
                   "the brownout band")
@@ -332,7 +338,9 @@ def register_framework_metrics(m: Manager) -> None:
     # serving-path telemetry (gofr_tpu/observe: the inference flight
     # recorder's metric face)
     m.new_histogram("app_tpu_ttft_duration",
-                    "time from generate() submit to first token in seconds",
+                    "time from generate() submit to first token in seconds "
+                    "(labeled by slo_class: the latency-class series is "
+                    "the TTFT SLO)",
                     TTFT_BUCKETS)
     m.new_histogram("app_tpu_inter_token_duration",
                     "gap between consecutive delivered tokens in seconds",
@@ -340,7 +348,9 @@ def register_framework_metrics(m: Manager) -> None:
     m.new_gauge("app_tpu_tokens_per_second",
                 "decode throughput of the most recently finished stream")
     m.new_gauge("app_tpu_queue_depth",
-                "requests waiting for a generation slot or a coalesced batch")
+                "requests waiting for a generation slot or a coalesced "
+                "batch (generate also exports per-slo_class series for "
+                "the split wait lines)")
     m.new_gauge("app_tpu_active_sequences",
                 "generation slots currently holding a live stream")
 

@@ -24,11 +24,21 @@ handler model:
     the gate caps ``max_new_tokens`` so each admitted stream costs
     fewer decode iterations — degrading answer length before
     availability.
+  - SLO classes: every request carries a serving class —
+    ``latency`` (interactive, the default) or ``throughput`` (batch/
+    offline, tagged via the ``X-SLO-Class`` header / ``slo-class``
+    gRPC metadata). The class rides the same ambient-threading-local
+    channel as the deadline, and overload degrades CLASSES IN ORDER:
+    the gate sheds and brownouts throughput-class at a fraction of the
+    latency-class bounds, so batch traffic absorbs pressure before an
+    interactive request feels it (docs/advanced-guide/
+    serving-scheduler.md).
 
-Thread model: the ambient deadline is a ``threading.local`` (handlers
-run one-per-thread on both transports, like ``tracing.current_span``);
-the gate's EWMA state is guarded by one small lock and is touched only
-at admission/dispatch, never per token.
+Thread model: the ambient deadline and SLO class are
+``threading.local`` (handlers run one-per-thread on both transports,
+like ``tracing.current_span``); the gate's EWMA state is guarded by
+one small lock and is touched only at admission/dispatch, never per
+token.
 """
 
 from __future__ import annotations
@@ -43,10 +53,16 @@ __all__ = [
     "AdmissionGate",
     "Deadline",
     "DeadlineExceeded",
+    "SLO_CLASSES",
+    "SLO_LATENCY",
+    "SLO_THROUGHPUT",
     "TooManyRequests",
     "current_deadline",
+    "current_slo_class",
     "deadline_scope",
     "parse_http_timeout",
+    "parse_slo_class",
+    "slo_scope",
 ]
 
 
@@ -108,6 +124,53 @@ def deadline_scope(deadline: Deadline | None):
         _scope.deadline = prev
 
 
+# -- SLO classes ------------------------------------------------------------
+# Two classes, not N priorities: the scheduler's contract is a latency
+# SLO for interactive traffic and a drain guarantee for batch traffic.
+# More levels would just be a priority queue with extra starvation
+# surface; everything downstream (batcher pickup, gate degradation,
+# metric labels) keys on these two strings.
+SLO_LATENCY = "latency"
+SLO_THROUGHPUT = "throughput"
+SLO_CLASSES = (SLO_LATENCY, SLO_THROUGHPUT)
+
+_THROUGHPUT_ALIASES = frozenset({"throughput", "batch", "bulk", "offline",
+                                 "best-effort", "besteffort"})
+
+
+def parse_slo_class(val: str | None) -> str:
+    """``X-SLO-Class`` header / ``slo-class`` gRPC metadata -> class.
+    Unknown or absent values are LATENCY: untagged traffic keeps the
+    full SLO (opting INTO deprioritization must be explicit — a typo in
+    a batch job's header costs capacity, never an interactive user's
+    latency)."""
+    if not val:
+        return SLO_LATENCY
+    return (SLO_THROUGHPUT if val.strip().lower() in _THROUGHPUT_ALIASES
+            else SLO_LATENCY)
+
+
+def current_slo_class() -> str:
+    """The ambient SLO class opened by the transport for this handler
+    thread (latency outside any scope)."""
+    return getattr(_scope, "slo_class", None) or SLO_LATENCY
+
+
+@contextlib.contextmanager
+def slo_scope(slo_class: str | None):
+    """Make ``slo_class`` ambient for the calling thread. None keeps
+    the enclosing scope's class (transports call this unconditionally);
+    a nested explicit class WINS — a handler may re-class its own
+    downstream work, e.g. fan-out prefetches as throughput."""
+    prev = getattr(_scope, "slo_class", None)
+    _scope.slo_class = slo_class if slo_class is not None \
+        else (prev or SLO_LATENCY)
+    try:
+        yield _scope.slo_class
+    finally:
+        _scope.slo_class = prev
+
+
 _HTTP_TIMEOUT_UNITS = (("ms", 1e-3), ("us", 1e-6), ("s", 1.0), ("m", 60.0))
 
 
@@ -153,6 +216,14 @@ class AdmissionGate:
     ``max_new_tokens`` while the wait EWMA sits above the threshold —
     shorter answers per admitted stream instead of shed streams.
 
+    SLO-class degradation order: throughput-class requests see every
+    bound scaled by ``throughput_factor`` (default 0.5) — half the
+    queue depth, half the delay budget, brownout at half the wait
+    threshold. Under rising load the gate therefore sheds and
+    brownouts BATCH traffic first, and latency-class requests keep the
+    full bounds until throughput is fully squeezed out. Factor 1.0
+    restores class-blind gating.
+
     Both bounds disabled (0) -> the gate admits everything and costs
     one attribute read per request.
     """
@@ -164,11 +235,15 @@ class AdmissionGate:
 
     def __init__(self, max_queue_depth: int = 0, max_queue_delay: float = 0.0,
                  brownout_delay: float = 0.0, brownout_max_new: int = 32,
+                 throughput_factor: float = 0.5,
                  name: str = "", metrics=None, tracer=None, logger=None):
         self.max_queue_depth = int(max_queue_depth)
         self.max_queue_delay = float(max_queue_delay)
         self.brownout_delay = float(brownout_delay)
         self.brownout_max_new = int(brownout_max_new)
+        # clamp to (0, 1]: 0 would shed ALL throughput traffic even at
+        # idle, and > 1 would invert the degradation order
+        self.throughput_factor = min(1.0, max(0.01, float(throughput_factor)))
         self.name = name
         self.metrics = metrics
         self.tracer = tracer
@@ -176,8 +251,11 @@ class AdmissionGate:
         self.enabled = self.max_queue_depth > 0 or self.max_queue_delay > 0
         self._lock = threading.Lock()
         self._wait_ewma = 0.0
-        self._brownout_on = False  # edge-logged, gauge-backed
+        # per-class brownout band state (edge-logged, gauge-backed):
+        # throughput's band engages earlier under class degradation
+        self._brownout_on = {c: False for c in SLO_CLASSES}
         self.sheds = 0
+        self.sheds_by_class = {c: 0 for c in SLO_CLASSES}
         self.brownout_capped = 0
 
     def clone(self, name: str) -> "AdmissionGate":
@@ -191,6 +269,7 @@ class AdmissionGate:
             max_queue_delay=self.max_queue_delay,
             brownout_delay=self.brownout_delay,
             brownout_max_new=self.brownout_max_new,
+            throughput_factor=self.throughput_factor,
             name=name, metrics=self.metrics, tracer=self.tracer,
             logger=self.logger)
 
@@ -205,22 +284,30 @@ class AdmissionGate:
         return self._wait_ewma
 
     # -- admission side -------------------------------------------------------
-    def admit(self, depth: int, program: str = "") -> None:
+    def admit(self, depth: int, program: str = "",
+              slo_class: str = SLO_LATENCY) -> None:
         """Admit or raise ``TooManyRequests``. ``depth`` is the queue's
         CURRENT depth (the caller reads it lock-free; an off-by-a-few
-        race only moves the shed boundary by that much)."""
+        race only moves the shed boundary by that much).
+        Throughput-class requests are judged against bounds scaled by
+        ``throughput_factor`` — they shed FIRST as load rises."""
         if not self.enabled:
             return
+        f = (self.throughput_factor if slo_class == SLO_THROUGHPUT else 1.0)
         wait = self._wait_ewma
-        over_depth = self.max_queue_depth > 0 and depth >= self.max_queue_depth
+        over_depth = (self.max_queue_depth > 0
+                      and depth >= max(1, int(self.max_queue_depth * f)))
         over_delay = (self.max_queue_delay > 0 and depth > 0
-                      and wait > self.max_queue_delay)
+                      and wait > self.max_queue_delay * f)
         if not (over_depth or over_delay):
             return
-        self._shed(depth, wait, program)
+        self._shed(depth, wait, program, slo_class)
 
-    def _shed(self, depth: int, wait: float, program: str) -> None:
+    def _shed(self, depth: int, wait: float, program: str,
+              slo_class: str = SLO_LATENCY) -> None:
         self.sheds += 1
+        if slo_class in self.sheds_by_class:
+            self.sheds_by_class[slo_class] += 1
         # honest Retry-After: the current wait estimate, floored so a
         # zero-estimate early shed doesn't invite an instant retry storm
         retry_after = max(0.05, wait)
@@ -228,7 +315,8 @@ class AdmissionGate:
         if self.metrics is not None:
             try:
                 self.metrics.increment_counter(
-                    "app_tpu_shed_total", program=program or self.name)
+                    "app_tpu_shed_total", program=program or self.name,
+                    slo_class=slo_class)
             except Exception:
                 pass
         if self.tracer is not None:
@@ -239,55 +327,99 @@ class AdmissionGate:
                     "tpu.shed", now, now,
                     attributes={"queue_depth": depth,
                                 "wait_ewma_ms": round(wait * 1e3, 3),
-                                "program": program or self.name})
+                                "program": program or self.name,
+                                "slo_class": slo_class})
             except Exception:
                 pass
         raise TooManyRequests(
             f"{self.name or 'admission'}: queue depth {depth}, "
-            f"estimated wait {wait * 1e3:.0f}ms — shed",
+            f"estimated wait {wait * 1e3:.0f}ms — shed ({slo_class})",
             retry_after=retry_after)
 
-    def cap_tokens(self, max_new_tokens: int) -> int:
+    def cap_tokens(self, max_new_tokens: int,
+                   slo_class: str = SLO_LATENCY) -> int:
         """Brownout: cap a generation request's token budget while the
-        queue-wait estimate sits above ``brownout_delay``."""
+        queue-wait estimate sits above ``brownout_delay``. Throughput-
+        class requests brown out at ``brownout_delay *
+        throughput_factor`` — answer length degrades for batch traffic
+        a full band before interactive traffic is touched."""
         if self.brownout_delay <= 0:
             return max_new_tokens
         wait = self._wait_ewma
-        active = wait > self.brownout_delay
-        if active != self._brownout_on:
-            with self._lock:
-                if active != self._brownout_on:
-                    self._brownout_on = active
-                    if self.metrics is not None:
-                        try:
-                            self.metrics.set_gauge("app_tpu_brownout_active",
-                                                   1.0 if active else 0.0)
-                        except Exception:
-                            pass
-                    if self.logger is not None:
-                        self.logger.warn({
-                            "event": "brownout " + ("entered" if active
-                                                    else "cleared"),
-                            "gate": self.name,
-                            "wait_ewma_ms": round(wait * 1e3, 1)})
+        active = self._refresh_brownout(wait)[slo_class]
         if not active or max_new_tokens <= self.brownout_max_new:
             return max_new_tokens
         self.brownout_capped += 1
         if self.metrics is not None:
             try:
-                self.metrics.increment_counter("app_tpu_brownout_capped_total")
+                self.metrics.increment_counter("app_tpu_brownout_capped_total",
+                                               slo_class=slo_class)
             except Exception:
                 pass
         return self.brownout_max_new
 
+    def _band_threshold(self, slo_class: str) -> float:
+        return self.brownout_delay * (
+            self.throughput_factor if slo_class == SLO_THROUGHPUT else 1.0)
+
+    def _refresh_brownout(self, wait: float) -> dict:
+        """Recompute EVERY class's band state from the current wait
+        estimate (band state is PER CLASS — throughput engages a full
+        factor earlier, and keying one flag on mixed traffic would flap
+        the gauge/log). Refreshing all classes on any observation is
+        what lets a class whose traffic vanished — e.g. throughput
+        fully shed by admit() and never reaching here — still CLEAR
+        once the estimate recovers. Emits the per-class gauge AND the
+        pre-existing unlabeled any-class series on each edge."""
+        states = {c: wait > self._band_threshold(c) for c in SLO_CLASSES}
+        if states != self._brownout_on:
+            with self._lock:
+                changed = {c: a for c, a in states.items()
+                           if a != self._brownout_on.get(c, False)}
+                if changed:
+                    self._brownout_on = states
+                    for cls, active in changed.items():
+                        if self.metrics is not None:
+                            try:
+                                self.metrics.set_gauge(
+                                    "app_tpu_brownout_active",
+                                    1.0 if active else 0.0, slo_class=cls)
+                            except Exception:
+                                pass
+                        if self.logger is not None:
+                            self.logger.warn({
+                                "event": "brownout " + ("entered" if active
+                                                        else "cleared"),
+                                "gate": self.name,
+                                "slo_class": cls,
+                                "wait_ewma_ms": round(wait * 1e3, 1)})
+                    if self.metrics is not None:
+                        try:  # the unlabeled series dashboards pinned
+                            # before the per-class split keeps flowing
+                            self.metrics.set_gauge(
+                                "app_tpu_brownout_active",
+                                1.0 if any(states.values()) else 0.0)
+                        except Exception:
+                            pass
+        return states
+
     def stats(self) -> dict:
+        # brownout_active derives LIVE from the estimate (not the
+        # event-driven flags): it must read False after recovery even
+        # if no request has touched cap_tokens since
+        wait = self._wait_ewma
+        active = (self.brownout_delay > 0
+                  and any(wait > self._band_threshold(c)
+                          for c in SLO_CLASSES))
         return {
             "enabled": self.enabled,
             "max_queue_depth": self.max_queue_depth,
             "max_queue_delay": self.max_queue_delay,
-            "wait_ewma_ms": round(self._wait_ewma * 1e3, 3),
+            "throughput_factor": self.throughput_factor,
+            "wait_ewma_ms": round(wait * 1e3, 3),
             "sheds": self.sheds,
-            "brownout_active": self._brownout_on,
+            "sheds_by_class": dict(self.sheds_by_class),
+            "brownout_active": active,
             "brownout_capped": self.brownout_capped,
         }
 
@@ -295,9 +427,10 @@ class AdmissionGate:
 def gate_from_config(cfg, name: str, metrics=None, tracer=None,
                      logger=None) -> AdmissionGate | None:
     """Build a gate from ``TPU_MAX_QUEUE_DEPTH`` / ``TPU_MAX_QUEUE_DELAY``
-    / ``TPU_BROWNOUT_DELAY`` / ``TPU_BROWNOUT_MAX_NEW`` (all bounds
-    default off: enabling load shedding is a capacity-planning decision,
-    not a framework default). Returns None when fully disabled."""
+    / ``TPU_BROWNOUT_DELAY`` / ``TPU_BROWNOUT_MAX_NEW`` /
+    ``TPU_SLO_THROUGHPUT_FACTOR`` (all bounds default off: enabling
+    load shedding is a capacity-planning decision, not a framework
+    default). Returns None when fully disabled."""
     depth = cfg.get_int("TPU_MAX_QUEUE_DEPTH", 0)
     delay = cfg.get_float("TPU_MAX_QUEUE_DELAY", 0.0)
     b_delay = cfg.get_float("TPU_BROWNOUT_DELAY", 0.0)
@@ -307,4 +440,5 @@ def gate_from_config(cfg, name: str, metrics=None, tracer=None,
         max_queue_depth=depth, max_queue_delay=delay,
         brownout_delay=b_delay,
         brownout_max_new=cfg.get_int("TPU_BROWNOUT_MAX_NEW", 32),
+        throughput_factor=cfg.get_float("TPU_SLO_THROUGHPUT_FACTOR", 0.5),
         name=name, metrics=metrics, tracer=tracer, logger=logger)

@@ -30,6 +30,41 @@ Config keys (reference config style, pkg/gofr/config/config.go:3):
                       2 — decode blocks dispatch async and new requests
                       are admitted while one runs, their prefill
                       queueing behind it on the device stream)
+  TPU_PREFILL_CHUNK   chunked-prefill interleave budget in tokens
+                      (docs/advanced-guide/serving-scheduler.md):
+                      prompts longer than the budget admit as bounded
+                      chunk dispatches with one admission pass + one
+                      decode block between chunks, so a long prefill
+                      neither stalls active decode streams nor
+                      head-of-line-blocks a newly arrived request.
+                      Unset = the largest prompt bucket; other values
+                      snap UP to a prompt bucket; 0 disables the
+                      interleave (chunks dispatch back-to-back — the
+                      bench contrast arm)
+  TPU_SLO_THROUGHPUT_FACTOR  scale on every AdmissionGate bound for
+                      throughput-class requests (default 0.5): batch
+                      traffic sheds and brownouts FIRST as load rises;
+                      1.0 restores class-blind gating
+  TPU_SLO_THROUGHPUT_SHARE   generation pending-line share guaranteed
+                      to throughput-class under latency saturation
+                      (default 0.25 — one pick in four); 0 drains
+                      throughput only on latency idle
+  TPU_SLO_LATENCY_SLOTS      decode slots throughput-class admissions
+                      may never occupy (default 1, clamped below the
+                      slot count): a latency request under batch-driven
+                      saturation finds a slot at its uncontended wait
+                      instead of queueing behind admitted batch
+                      streams. Costs idle capacity only while tagged
+                      throughput traffic saturates; 0 disables
+  TPU_SLO_BATCH_SHARE enable SLO-class scheduling in the predict
+                      batchers with this throughput reserve share
+                      (default 0 = off: class lines run the Python
+                      dispatcher, giving up the native GIL-released
+                      wait — a measured tradeoff, not a default)
+  TPU_SLO_BATCH_DELAY throughput-class flush delay for the predict
+                      batchers in seconds (default 4x
+                      TPU_MAX_BATCH_DELAY — batch items wait longer
+                      for fuller batches)
   TPU_PREFIX_CACHE    prefix-KV pool rows (default 0 = off): stored
                       prompt prefixes restore as one HBM row copy
                       instead of prefill compute. The pool is the T0
@@ -96,20 +131,33 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .batcher import BatcherClosed, CoalescingBatcher, pad_bucket
+from .batcher import BatcherClosed, ClassPolicy, CoalescingBatcher, pad_bucket
 from .checkpoint import (load_npz, load_orbax, load_params, maybe_quantize,
                          placed, save_npz, save_orbax)
 from .engine import DEFAULT_BATCH_BUCKETS, DEFAULT_SEQ_BUCKETS, Program, TPUEngine
 from .generator import GenerationEngine, GenerationError, GenStream
 
 __all__ = [
-    "BatcherClosed", "CoalescingBatcher", "pad_bucket",
+    "BatcherClosed", "ClassPolicy", "CoalescingBatcher", "pad_bucket",
     "load_npz", "load_orbax", "load_params", "maybe_quantize", "placed",
     "save_npz", "save_orbax",
     "DEFAULT_BATCH_BUCKETS", "DEFAULT_SEQ_BUCKETS", "Program", "TPUEngine",
     "GenerationEngine", "GenerationError", "GenStream",
     "new_engine_from_config",
 ]
+
+
+def _opt_int(val: str | None) -> int | None:
+    """Tri-state int key (unset -> None, which get_int's single default
+    cannot express); malformed values fall back to None like every
+    other config key degrades to its default instead of crashing
+    startup."""
+    if not val:
+        return None
+    try:
+        return int(val)
+    except (TypeError, ValueError):
+        return None
 
 
 def _csv_ints(val: str | None, default: tuple[int, ...]) -> tuple[int, ...]:
@@ -144,8 +192,16 @@ def new_engine_from_config(cfg, logger=None, metrics=None,
     from ..resilience import gate_from_config
 
     tracer = getattr(observe, "tracer", None)
+    batch_share = cfg.get_float("TPU_SLO_BATCH_SHARE", 0.0)
+    class_policy = None
+    if batch_share > 0:
+        class_policy = ClassPolicy(
+            throughput_delay=cfg.get_float("TPU_SLO_BATCH_DELAY", 0.0)
+            or None,
+            throughput_share=batch_share)
     engine = TPUEngine(logger=logger, metrics=metrics, max_delay=max_delay,
                        mesh=mesh, model_name=name, observe=observe,
+                       class_policy=class_policy,
                        gate=gate_from_config(cfg, "predict", metrics=metrics,
                                              tracer=tracer, logger=logger))
 
@@ -221,6 +277,10 @@ def new_engine_from_config(cfg, logger=None, metrics=None,
             kv_dtype=kv_dtype,
             decode_block=cfg.get_int("TPU_DECODE_BLOCK", 4),
             admit_window_ms=cfg.get_float("TPU_ADMIT_WINDOW_MS", 2.0),
+            prefill_chunk=_opt_int(cfg.get("TPU_PREFILL_CHUNK")),
+            slo_throughput_share=cfg.get_float("TPU_SLO_THROUGHPUT_SHARE",
+                                               0.25),
+            slo_latency_slots=cfg.get_int("TPU_SLO_LATENCY_SLOTS", 1),
             prefix_cache_slots=cfg.get_int("TPU_PREFIX_CACHE", 0),
             prefix_store_min=cfg.get_int("TPU_PREFIX_MIN", 0) or None,
             kvcache=kv_opts,

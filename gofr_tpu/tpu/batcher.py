@@ -14,6 +14,17 @@ Dispatch policy (deadline-based flush):
   - otherwise flush when the OLDEST waiting item has waited ``max_delay``;
   - an idle queue sleeps on a condition variable (no spinning).
 
+SLO-class scheduling (``class_policy``): with a ``ClassPolicy``
+configured the wait line splits into per-class queues — latency-class
+items flush on ``max_delay`` and fill batches first; throughput-class
+items tolerate ``throughput_delay`` (a fuller-batch window) and are
+picked up through a weighted anti-starvation reserve so saturating
+latency traffic can never starve them out entirely. Items are tagged
+per request (``submit(slo_class=...)``, defaulted from the transport's
+ambient class — resilience.current_slo_class). The class-aware line is
+Python-side only: the native scheduler's queue is FIFO, so enabling a
+policy pins the batcher to the condition-variable path.
+
 The runner receives a list of payloads and returns a list of results of the
 same length; per-item failures are surfaced as exceptions re-raised in the
 submitting thread.
@@ -21,20 +32,47 @@ submitting thread.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from typing import Any, Callable, Sequence
 
 from .. import chaos
 from ..errors import DeadlineExceeded
+from ..resilience import SLO_LATENCY, SLO_THROUGHPUT, current_slo_class
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassPolicy:
+    """Per-SLO-class dispatch policy for a CoalescingBatcher.
+
+    throughput_delay: seconds the oldest THROUGHPUT item may wait
+        before it alone forces a flush (None -> 4x the batcher's
+        max_delay — batch traffic trades wait for fuller batches).
+    throughput_share: fraction of each full batch reserved for waiting
+        throughput items (>= 1 slot) when latency traffic would
+        otherwise fill it — the anti-starvation floor. 0 disables the
+        reserve (throughput then drains only on latency slack and its
+        own delay flushes).
+    """
+
+    throughput_delay: float | None = None
+    throughput_share: float = 0.25
+
+    def reserve(self, max_batch: int) -> int:
+        if self.throughput_share <= 0:
+            return 0
+        return max(1, int(max_batch * min(self.throughput_share, 1.0)))
 
 
 class BatchItem:
     __slots__ = ("payload", "result", "error", "done", "enqueued_at",
-                 "deadline", "cancelled", "claimed")
+                 "deadline", "cancelled", "claimed", "slo_class")
 
-    def __init__(self, payload: Any, deadline=None):
+    def __init__(self, payload: Any, deadline=None,
+                 slo_class: str = SLO_LATENCY):
         self.payload = payload
+        self.slo_class = slo_class
         self.result: Any = None
         self.error: BaseException | None = None
         self.done = threading.Event()
@@ -72,13 +110,25 @@ class CoalescingBatcher:
                  on_dispatch: Callable[[int, float], None] | None = None,
                  use_native: bool = True,
                  on_queue_depth: Callable[[int], None] | None = None,
-                 on_expired: Callable[[int], None] | None = None):
+                 on_expired: Callable[[int], None] | None = None,
+                 class_policy: ClassPolicy | None = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.runner = runner
         self.max_batch = max_batch
         self.max_delay = max_delay
         self.name = name
+        # SLO-class scheduling: a second wait line for throughput-class
+        # items with its own (longer) delay bound and a reserved pickup
+        # share. The native queue is FIFO and class-blind, so a policy
+        # forces the Python dispatcher.
+        self.class_policy = class_policy
+        self._thr: list[BatchItem] = []
+        self._thr_delay = (max_delay * 4 if class_policy is None
+                           or class_policy.throughput_delay is None
+                           else class_policy.throughput_delay)
+        if class_policy is not None:
+            use_native = False
         self.on_dispatch = on_dispatch  # (batch_size, oldest_wait_s) -> None
         # (n_dropped,) -> None: expired items dropped WITHOUT executing
         # (feeds app_tpu_expired_dropped_total)
@@ -115,7 +165,9 @@ class CoalescingBatcher:
 
     def queue_depth(self) -> int:
         """Items waiting for (or inside) a dispatch right now."""
-        return len(self._items) if self._native is not None else len(self._queue)
+        if self._native is not None:
+            return len(self._items)
+        return len(self._queue) + len(self._thr)
 
     def _report_depth(self) -> None:
         if self.on_queue_depth is not None:
@@ -126,19 +178,23 @@ class CoalescingBatcher:
 
     # -- producer side -------------------------------------------------------
     def submit(self, payload: Any, timeout: float | None = None,
-               deadline=None) -> Any:
+               deadline=None, slo_class: str | None = None) -> Any:
         """Block until the batched result for ``payload`` is ready.
 
         ``deadline`` (resilience.Deadline): tightens the wait to the
         caller's remaining budget AND rides on the item so the
-        dispatcher drops it unexecuted if it expires while queued."""
+        dispatcher drops it unexecuted if it expires while queued.
+        ``slo_class`` defaults to the transport's ambient class; with a
+        ``class_policy`` configured it selects the item's wait line."""
         if deadline is not None:
             if deadline.expired():
                 self._count_expired(1)
                 raise DeadlineExceeded(
                     f"{self.name}: deadline expired before enqueue")
             timeout = deadline.budget(timeout)
-        item = BatchItem(payload, deadline=deadline)
+        if slo_class is None:
+            slo_class = current_slo_class()
+        item = BatchItem(payload, deadline=deadline, slo_class=slo_class)
         item_id = 0
         if self._native is not None:
             with self._lock:
@@ -158,7 +214,7 @@ class CoalescingBatcher:
             with self._lock:
                 if self._closed:
                     raise BatcherClosed(f"{self.name} is closed")
-                self._queue.append(item)
+                self._line_for(item).append(item)
                 self._nonempty.notify()
         self._report_depth()
         if not item.done.wait(timeout):
@@ -187,7 +243,7 @@ class CoalescingBatcher:
                     self._items.pop(item_id, None)
                 else:
                     try:
-                        self._queue.remove(item)
+                        self._line_for(item).remove(item)
                     except ValueError:
                         pass
             if item.deadline is not None and item.deadline.expired():
@@ -217,30 +273,39 @@ class CoalescingBatcher:
                 pass  # telemetry must never take the batcher down
 
     # -- dispatcher ----------------------------------------------------------
+    def _line_for(self, item: BatchItem) -> list:
+        """The wait line an item joins: class-split only under a
+        policy — without one every class shares the FIFO line."""
+        if self.class_policy is not None \
+                and item.slo_class == SLO_THROUGHPUT:
+            return self._thr
+        return self._queue
+
     def _prune_locked(self) -> None:
-        """Drop cancelled and expired items from the queue (lock held).
-        Cancelled waiters already raised — silently discard; expired
-        items fail with DEADLINE_EXCEEDED and are counted: the whole
-        point is that the runner never burns device time on them. The
-        telemetry callback for the count is DEFERRED (accumulated in
-        ``_expired_pending``, flushed by the dispatch loop outside the
-        lock): firing metrics here would stall every concurrent
+        """Drop cancelled and expired items from the wait lines (lock
+        held). Cancelled waiters already raised — silently discard;
+        expired items fail with DEADLINE_EXCEEDED and are counted: the
+        whole point is that the runner never burns device time on them.
+        The telemetry callback for the count is DEFERRED (accumulated
+        in ``_expired_pending``, flushed by the dispatch loop outside
+        the lock): firing metrics here would stall every concurrent
         submit() behind per-item counter work exactly under overload."""
         n_expired = 0
-        keep: list[BatchItem] = []
-        for it in self._queue:
-            if it.cancelled:
-                continue
-            if it.deadline is not None and it.deadline.expired():
-                it.error = DeadlineExceeded(
-                    f"{self.name}: deadline expired after "
-                    f"{time.monotonic() - it.enqueued_at:.3f}s in queue")
-                it.done.set()
-                n_expired += 1
-                continue
-            keep.append(it)
-        if len(keep) != len(self._queue):
-            self._queue[:] = keep
+        for line in (self._queue, self._thr):
+            keep: list[BatchItem] = []
+            for it in line:
+                if it.cancelled:
+                    continue
+                if it.deadline is not None and it.deadline.expired():
+                    it.error = DeadlineExceeded(
+                        f"{self.name}: deadline expired after "
+                        f"{time.monotonic() - it.enqueued_at:.3f}s in queue")
+                    it.done.set()
+                    n_expired += 1
+                    continue
+                keep.append(it)
+            if len(keep) != len(line):
+                line[:] = keep
         self._expired_pending += n_expired
 
     def _flush_expired(self) -> None:
@@ -253,30 +318,67 @@ class CoalescingBatcher:
         """Wait for a flush condition; pop up to max_batch live items
         (None on close). Expired/cancelled items are pruned BEFORE the
         flush decision so a dead head-of-line never triggers a dispatch
-        of its own."""
+        of its own.
+
+        Class-aware flush (policy configured): each class's OLDEST item
+        is judged against its own delay bound — latency flushes on
+        ``max_delay``, throughput on ``throughput_delay`` — and a full
+        combined line flushes immediately. Composition reserves the
+        policy's throughput share so saturated latency traffic still
+        drains the batch line (see ``_compose_locked``)."""
         with self._lock:
             while True:
-                if self._queue:
+                if self._queue or self._thr:
                     self._prune_locked()
-                    if not self._queue and self._expired_pending:
+                    if not (self._queue or self._thr) \
+                            and self._expired_pending:
                         # pruning emptied the line: bounce through the
                         # loop (empty batch) so the pending count is
                         # flushed now, not at the next enqueue
                         return []
-                if self._queue:
-                    oldest_wait = time.monotonic() - self._queue[0].enqueued_at
-                    if len(self._queue) >= self.max_batch or oldest_wait >= self.max_delay:
-                        batch = self._queue[: self.max_batch]
-                        del self._queue[: self.max_batch]
-                        for it in batch:
-                            it.claimed = True
-                        return batch
-                    # Not full yet: sleep exactly until the oldest's deadline.
-                    self._nonempty.wait(self.max_delay - oldest_wait)
+                if self._queue or self._thr:
+                    now = time.monotonic()
+                    lat_wait = (now - self._queue[0].enqueued_at
+                                if self._queue else None)
+                    thr_wait = (now - self._thr[0].enqueued_at
+                                if self._thr else None)
+                    if (len(self._queue) + len(self._thr) >= self.max_batch
+                            or (lat_wait is not None
+                                and lat_wait >= self.max_delay)
+                            or (thr_wait is not None
+                                and thr_wait >= self._thr_delay)):
+                        return self._compose_locked()
+                    # Not full yet: sleep exactly until the earliest
+                    # class's oldest-item deadline.
+                    waits = []
+                    if lat_wait is not None:
+                        waits.append(self.max_delay - lat_wait)
+                    if thr_wait is not None:
+                        waits.append(self._thr_delay - thr_wait)
+                    self._nonempty.wait(max(min(waits), 0.0))
                 elif self._closed:
                     return None
                 else:
                     self._nonempty.wait()
+
+    def _compose_locked(self) -> list[BatchItem]:
+        """Pop one dispatch's items (lock held): latency head first, up
+        to ``max_batch`` minus the throughput reserve (which binds only
+        while throughput items actually wait), then throughput, then
+        latency backfill into any slack. Without a policy the
+        throughput line is empty and this degenerates to the classic
+        FIFO take."""
+        B = self.max_batch
+        reserve = (self.class_policy.reserve(B)
+                   if self.class_policy is not None and self._thr else 0)
+        n_lat = min(len(self._queue), B - min(reserve, len(self._thr)))
+        n_thr = min(len(self._thr), B - n_lat)
+        batch = self._queue[:n_lat] + self._thr[:n_thr]
+        del self._queue[:n_lat]
+        del self._thr[:n_thr]
+        for it in batch:
+            it.claimed = True
+        return batch
 
     def _run_one(self, batch: list[BatchItem], oldest_wait: float) -> None:
         if self.on_dispatch is not None:
@@ -311,7 +413,10 @@ class CoalescingBatcher:
                 return
             self._report_depth()
             if batch:
-                self._run_one(batch, time.monotonic() - batch[0].enqueued_at)
+                # the batch head is the latency line's; the true oldest
+                # may be a throughput item picked up via the reserve
+                oldest = min(it.enqueued_at for it in batch)
+                self._run_one(batch, time.monotonic() - oldest)
 
     def _native_loop(self) -> None:
         while True:
@@ -346,6 +451,8 @@ class CoalescingBatcher:
             self._closed = True
             if not drain:
                 pending, self._queue = self._queue, []
+                pending += self._thr
+                self._thr = []
                 pending += list(self._items.values())
                 self._items.clear()
             self._nonempty.notify_all()

@@ -33,9 +33,9 @@ import numpy as np
 
 from ..datasource import Health, STATUS_DEGRADED, STATUS_DOWN, STATUS_UP
 from ..errors import DeadlineExceeded
-from ..resilience import current_deadline
+from ..resilience import current_deadline, current_slo_class
 from . import hbm
-from .batcher import CoalescingBatcher, pad_bucket
+from .batcher import ClassPolicy, CoalescingBatcher, pad_bucket
 
 DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8)
 DEFAULT_SEQ_BUCKETS = (32, 64, 128, 256, 512)
@@ -82,7 +82,8 @@ class TPUEngine:
     """
 
     def __init__(self, logger=None, metrics=None, max_delay: float = 0.004,
-                 mesh=None, model_name: str = "", observe=None, gate=None):
+                 mesh=None, model_name: str = "", observe=None, gate=None,
+                 class_policy: ClassPolicy | None = None):
         self.logger = logger
         self.metrics = metrics
         self.observe = observe  # Observe bundle (registry + flight recorder)
@@ -92,6 +93,13 @@ class TPUEngine:
         # traffic), fed with that program's batch waits at dispatch
         self.gate = gate
         self._gates: dict[str, Any] = {}
+        # SLO-class batching policy (None = classic FIFO): per-class
+        # wait lines in every program's batcher — latency first,
+        # throughput on a longer delay with a reserved pickup share.
+        # Opt-in (TPU_SLO_BATCH_SHARE): the class-aware line runs the
+        # Python dispatcher, giving up the native scheduler's
+        # GIL-released wait.
+        self.class_policy = class_policy
         self.max_delay = max_delay
         self.mesh = mesh
         self.model_name = model_name
@@ -128,7 +136,8 @@ class TPUEngine:
                 max_batch=prog.max_batch, max_delay=self.max_delay,
                 name=f"tpu-{name}", on_dispatch=self._dispatch_metrics(prog),
                 on_queue_depth=self._depth_gauge(name),
-                on_expired=self._expired_counter(name))
+                on_expired=self._expired_counter(name),
+                class_policy=self.class_policy)
         if self.logger is not None:
             self.logger.info({"event": "tpu program registered", "program": name,
                               "kind": kind, "batch_buckets": list(prog.batch_buckets)})
@@ -226,7 +235,7 @@ class TPUEngine:
 
     # -- public API (ctx.tpu.predict) ---------------------------------------
     def predict(self, program: str, item: Any, timeout: float | None = 60.0,
-                deadline=None) -> Any:
+                deadline=None, slo_class: str | None = None) -> Any:
         """Run one item through a registered program, coalescing with any
         concurrent callers. Returns the un-batched result (numpy).
 
@@ -235,7 +244,10 @@ class TPUEngine:
         (grpc-timeout / X-Request-Timeout): the wait is capped to the
         remaining budget and the item is dropped unexecuted if it
         expires while queued. An admission gate, when configured, sheds
-        with ``TooManyRequests`` before the item ever joins the line."""
+        with ``TooManyRequests`` before the item ever joins the line.
+        ``slo_class`` defaults to the transport's ambient class; the
+        gate degrades throughput-class first, and with a class policy
+        configured the batcher schedules the classes separately."""
         if self._closed:
             raise RuntimeError("TPU engine is closed")
         batcher = self._batchers.get(program)
@@ -244,6 +256,8 @@ class TPUEngine:
                            f"{sorted(self._programs)}")
         if deadline is None:
             deadline = current_deadline()
+        if slo_class is None:
+            slo_class = current_slo_class()
         if deadline is not None and deadline.expired():
             if self.metrics is not None:
                 self.metrics.increment_counter(
@@ -252,7 +266,8 @@ class TPUEngine:
                 f"deadline expired before predict({program!r}) was queued")
         gate = self._gate_for(program)
         if gate is not None:
-            gate.admit(batcher.queue_depth(), program=program)
+            gate.admit(batcher.queue_depth(), program=program,
+                       slo_class=slo_class)
         self._validate_item(self._programs[program], item)
         t0 = time.monotonic()
         entry = None
@@ -265,7 +280,8 @@ class TPUEngine:
                 stage="batch-wait")
         failed = None
         try:
-            return batcher.submit(item, timeout=timeout, deadline=deadline)
+            return batcher.submit(item, timeout=timeout, deadline=deadline,
+                                  slo_class=slo_class)
         except BaseException as e:
             failed = e
             raise

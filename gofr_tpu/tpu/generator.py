@@ -34,6 +34,7 @@ import os
 import queue
 import threading
 import time
+from collections import deque
 from typing import Any
 
 import jax
@@ -44,13 +45,89 @@ from .. import chaos
 from ..errors import DeadlineExceeded
 from ..models import llama
 from ..models.common import ModelConfig
-from ..resilience import current_deadline
+from ..resilience import (SLO_LATENCY, SLO_THROUGHPUT, current_deadline,
+                          current_slo_class)
 from ..wire import PushStream
 from . import hbm
 from .batcher import pad_bucket
 from .kvcache import HostKV, clamp_restore_len
 
 _REQ_IDS = itertools.count(1)
+
+
+class _ClassPending:
+    """SLO-class-aware pending line for the serving loop: latency-class
+    requests are picked first; a weighted anti-starvation counter hands
+    every Nth pick to the throughput line while it has waiters, so
+    saturating interactive traffic can never starve batch streams out
+    of the slot pool entirely (the generator-side mirror of the
+    batcher's ClassPolicy reserve).
+
+    Thread model: any thread puts (``generate()``); ONLY the serving
+    loop pops — the same single-consumer contract the old queue.Queue
+    carried, which is what makes pop-then-push-front requeues exact."""
+
+    def __init__(self, throughput_share: float = 0.25):
+        share = min(max(float(throughput_share), 0.0), 1.0)
+        # share -> latency picks per throughput pick, FLOORED so the
+        # realized contended fraction 1/(weight+1) is always >= the
+        # configured share (0.25 -> 3:1, 0.5 -> 1:1, >= 0.5 rounds
+        # toward throughput-first). None disables the guarantee
+        # (throughput then drains only when the latency line is empty).
+        self._weight = (int((1.0 - share) / share) if share > 0 else None)
+        self._lat: "deque[_Request]" = deque()
+        self._thr: "deque[_Request]" = deque()
+        self._lock = threading.Lock()
+        self._lat_streak = 0
+        self._prev_streak = 0  # streak before the most recent pop
+
+    def put(self, req: "_Request") -> None:
+        with self._lock:
+            (self._thr if req.slo_class == SLO_THROUGHPUT
+             else self._lat).append(req)
+
+    def put_front(self, req: "_Request") -> None:
+        """UNDO the most recent pop: return the request to the head of
+        its class line AND restore the anti-starvation streak to its
+        pre-pop value (the in-flight lattice deferral). Without the
+        restore, a throughput request whose streak-earned turn lands
+        in a deferred pass would burn its credit with nothing served —
+        under latency saturation its admission could slip far past the
+        configured share. Valid because pops and push-fronts come from
+        the single consumer thread, back-to-back."""
+        with self._lock:
+            (self._thr if req.slo_class == SLO_THROUGHPUT
+             else self._lat).appendleft(req)
+            self._lat_streak = self._prev_streak
+
+    def get_nowait(self, allow_throughput: bool = True) -> "_Request":
+        """Pop the next admissible request. ``allow_throughput=False``
+        is the slot-reservation path: the caller is filling one of the
+        latency-reserved slots, so only the latency line may serve it
+        (raises queue.Empty when only throughput waits)."""
+        with self._lock:
+            use_thr = allow_throughput and bool(self._thr) and (
+                not self._lat
+                or (self._weight is not None
+                    and self._lat_streak >= self._weight))
+            line = self._thr if use_thr else self._lat
+            if not line:
+                raise queue.Empty
+            self._prev_streak = self._lat_streak
+            if use_thr:
+                self._lat_streak = 0
+            else:
+                self._lat_streak += 1
+            return line.popleft()
+
+    def qsize(self) -> int:
+        return len(self._lat) + len(self._thr)
+
+    def qsize_class(self, slo_class: str) -> int:
+        return len(self._thr if slo_class == SLO_THROUGHPUT else self._lat)
+
+    def empty(self) -> bool:
+        return not (self._lat or self._thr)
 
 
 def _copy_row(dst, src, dst_idx, src_idx):
@@ -168,7 +245,7 @@ class GenStream(PushStream):
 class _Request:
     __slots__ = ("stream", "prompt", "max_new", "temperature", "top_k",
                  "eos_id", "adapter", "enqueued_at", "lattice_peek",
-                 "kv_match", "deadline")
+                 "kv_match", "deadline", "slo_class")
 
     @property
     def logprobs(self) -> bool:
@@ -176,7 +253,8 @@ class _Request:
 
     def __init__(self, stream: GenStream, prompt: np.ndarray, max_new: int,
                  temperature: float, top_k: int, eos_id: int | None,
-                 adapter: int = 0, deadline=None):
+                 adapter: int = 0, deadline=None,
+                 slo_class: str = SLO_LATENCY):
         self.stream = stream
         self.prompt = prompt
         self.max_new = max_new
@@ -192,6 +270,9 @@ class _Request:
         # resilience.Deadline: expired requests are dropped at admission
         # (no prefill dispatch for a caller that already gave up)
         self.deadline = deadline
+        # resilience SLO class: selects the pending line, the gate's
+        # degradation band, and the per-class telemetry labels
+        self.slo_class = slo_class
 
 
 class _Inflight:
@@ -232,7 +313,10 @@ class GenerationEngine:
                  kvcache=None,
                  spec_decode_k: int = 0,
                  lora_adapters: int = 0, lora_rank: int = 16,
-                 paged_blocks: int = 0, paged_block_size: int = 128):
+                 paged_blocks: int = 0, paged_block_size: int = 128,
+                 prefill_chunk: int | None = None,
+                 slo_throughput_share: float = 0.25,
+                 slo_latency_slots: int = 1):
         self.cfg = cfg
         self.params = params
         self.n_slots = slots
@@ -296,6 +380,29 @@ class GenerationEngine:
         self.max_seq = min(max_seq or cfg.max_seq, cfg.max_seq)
         self.prompt_buckets = tuple(sorted(b for b in prompt_buckets
                                            if b <= self.max_seq)) or (self.max_seq,)
+        # Chunked-prefill interleave budget (TPU_PREFILL_CHUNK): a
+        # prompt longer than the budget is admitted as a SEQUENCE of
+        # bounded chunk dispatches, and between chunks the admission
+        # loop runs one decode block for the live batch AND an
+        # admission pass for new arrivals — a 4k-token prefill can no
+        # longer stall every active stream's next token, and a newly
+        # arrived short request gets its first dispatch within one
+        # chunk budget (docs/advanced-guide/serving-scheduler.md).
+        #   None -> budget = largest prompt bucket (interleave on);
+        #   <= 0 -> interleave OFF: the lattice's chunks dispatch
+        #           back-to-back (the head-of-line A/B arm);
+        #   else -> snapped UP to the nearest prompt bucket (chunk
+        #           shapes are compile keys — off-lattice sizes would
+        #           recompile mid-serving).
+        C_max = self.prompt_buckets[-1]
+        if prefill_chunk is None:
+            self._chunk, self._chunk_interleave = C_max, True
+        elif prefill_chunk <= 0:
+            self._chunk, self._chunk_interleave = C_max, False
+        else:
+            self._chunk = pad_bucket(min(int(prefill_chunk), C_max),
+                                     self.prompt_buckets)
+            self._chunk_interleave = True
 
         # Paged (block-pool) KV cache: slots share a pool of fixed
         # T-token blocks via a host-owned block table instead of owning
@@ -486,7 +593,15 @@ class GenerationEngine:
             self._hist_buf = np.zeros((slots, self.max_seq), np.int32)
             self._hist_n = np.zeros((slots,), np.int64)
 
-        self._pending: queue.Queue[_Request] = queue.Queue()
+        self._pending = _ClassPending(slo_throughput_share)
+        # Latency slot reservation: throughput-class admissions may
+        # never take the LAST ``slo_latency_slots`` free slots, so a
+        # latency arrival under batch-driven saturation finds a slot
+        # at its uncontended wait instead of queueing behind admitted
+        # batch streams (the gate bounds the LINE; this bounds the
+        # SLOTS). Clamped so throughput can always run somewhere; costs
+        # nothing when traffic is untagged (all-latency).
+        self._lat_reserve = max(0, min(int(slo_latency_slots), slots - 1))
         self._work = threading.Event()
         # serializes device-state mutation (the loop thread vs warmup/close)
         self._device_lock = threading.Lock()
@@ -573,7 +688,7 @@ class GenerationEngine:
             if self._spec_k:
                 self._verify_jit = jax.jit(self._paged_verify_fn,
                                            donate_argnums=(0,))
-            if (self.max_seq - 1 > self.prompt_buckets[-1]
+            if (self.max_seq - 1 > self._chunk
                     or self._prefix_idx is not None):
                 # Long-prompt admission AND prefix-hit resume both run
                 # the chunk lattice against a dense single-slot SCRATCH
@@ -866,7 +981,8 @@ class GenerationEngine:
     def generate(self, prompt, max_new_tokens: int = 128,
                  temperature: float = 0.0, top_k: int = 0,
                  eos_id=None, adapter: int = 0,
-                 logprobs: bool = False, deadline=None) -> GenStream:
+                 logprobs: bool = False, deadline=None,
+                 slo_class: str | None = None) -> GenStream:
         """Enqueue a prompt (sequence of token ids); returns a GenStream
         yielding generated ids as the device produces them.
 
@@ -887,7 +1003,14 @@ class GenerationEngine:
         admission without a prefill dispatch. With an admission gate
         configured, overload sheds with ``TooManyRequests`` (fast 429/
         RESOURCE_EXHAUSTED) and the brownout band caps
-        ``max_new_tokens``."""
+        ``max_new_tokens``.
+
+        ``slo_class`` (resilience.SLO_LATENCY/SLO_THROUGHPUT) defaults
+        to the transport's ambient class (``X-SLO-Class`` header /
+        ``slo-class`` gRPC metadata): latency-class requests pick up
+        slots first; throughput-class tolerates longer queueing, is
+        shed/browned-out FIRST under pressure, and still drains via the
+        pending line's weighted anti-starvation pickup."""
         if self._closed:
             raise GenerationError("generation engine is closed")
         if self._draining:
@@ -896,13 +1019,19 @@ class GenerationEngine:
             raise GenerationError(f"generation engine is down: {self.down}")
         if deadline is None:
             deadline = current_deadline()
+        if slo_class is None:
+            slo_class = current_slo_class()
+        elif slo_class not in (SLO_LATENCY, SLO_THROUGHPUT):
+            raise GenerationError(f"unknown slo_class {slo_class!r}")
         if deadline is not None and deadline.expired():
             self._count_expired()
             raise DeadlineExceeded("deadline expired before generate() "
                                    "was queued")
         if self.gate is not None:
-            self.gate.admit(self._pending.qsize(), program="generate")
-            max_new_tokens = self.gate.cap_tokens(max_new_tokens)
+            self.gate.admit(self._pending.qsize(), program="generate",
+                            slo_class=slo_class)
+            max_new_tokens = self.gate.cap_tokens(max_new_tokens,
+                                                  slo_class=slo_class)
         if eos_id is not None and not isinstance(eos_id, (int, np.integer)):
             eos_id = frozenset(int(t) for t in eos_id) or None
         elif isinstance(eos_id, np.integer):
@@ -962,7 +1091,8 @@ class GenerationEngine:
                 "generate", "generate", stream.trace_id, stage="queued",
                 detail={"request_id": stream.request_id,
                         "prompt_len": len(prompt),
-                        "max_new": max_new_tokens})
+                        "max_new": max_new_tokens,
+                        "slo_class": slo_class})
             self._observe.recorder.record(
                 "submitted", request_id=stream.request_id,
                 trace_id=stream.trace_id, prompt_len=len(prompt),
@@ -979,7 +1109,8 @@ class GenerationEngine:
                 self._pending.put(_Request(stream, prompt, max_new_tokens,
                                            temperature, top_k, eos_id,
                                            adapter=int(adapter),
-                                           deadline=deadline))
+                                           deadline=deadline,
+                                           slo_class=slo_class))
         except BaseException:
             self._obs_end(stream, "failed", error="rejected at admission")
             raise
@@ -999,6 +1130,14 @@ class GenerationEngine:
             "prompt_buckets": list(self.prompt_buckets),
             "total_requests": self.total_requests,
             "total_tokens": self.total_tokens,
+            "scheduler": {
+                "prefill_chunk": self._chunk,
+                "chunk_interleave": self._chunk_interleave,
+                "latency_reserved_slots": self._lat_reserve,
+                "queued_latency": self._pending.qsize_class(SLO_LATENCY),
+                "queued_throughput":
+                    self._pending.qsize_class(SLO_THROUGHPUT),
+            },
         }
         if self.gate is not None:
             out["admission"] = self.gate.stats()
@@ -1044,18 +1183,24 @@ class GenerationEngine:
             cursors = np.asarray(jax.device_get(self.cache.lengths))
             free = next((i for i, s in enumerate(self._slots) if s.free), None)
             if free is not None:
-                C = self.prompt_buckets[-1]
-                # chunk programs run for prompts past the largest bucket
-                # — and, with a prefix pool, for ANY hit (prefill resumes
-                # mid-prompt through the chunk lattice), so they must be
-                # warm whenever the pool exists
+                # chunk programs run for prompts past the chunk budget
+                # (the largest bucket unless TPU_PREFILL_CHUNK bounds
+                # it) — and, with a prefix pool, for ANY hit (prefill
+                # resumes mid-prompt through the chunk lattice), so
+                # they must be warm whenever the pool exists
                 # paged engines chunk into the scratch row; warm those
                 # programs against it below instead of the serving cache
+                C = self._chunk
                 paged_chunks = self._paged and hasattr(self, "_scratch")
                 chunked_reachable = (not self._paged
                                      and (self.max_seq - 1 > C
                                           or self._kvc is not None))
                 for b in self.prompt_buckets:
+                    if b > C:
+                        # single-dispatch prefills and final chunks are
+                        # both bounded by the chunk budget — wider
+                        # buckets never dispatch
+                        continue
                     toks = jnp.zeros((1, b), jnp.int32)
                     if paged_chunks:
                         _, _, self._key, self._scratch = \
@@ -1356,17 +1501,25 @@ class GenerationEngine:
             # stream. Only this thread mutates the counter.
             self._admitting += 1
             try:
-                if defer_lattice:
-                    # peek is safe: this thread is the only consumer
-                    try:
-                        head = self._pending.queue[0]
-                    except IndexError:
-                        return started
-                    if self._needs_lattice(head):
-                        return started
+                # slot reservation: this pick may only go to a
+                # throughput-class request if filling it still leaves
+                # the reserved latency slots free
+                free_now = sum(1 for s in self._slots if s.free)
                 try:
-                    req = self._pending.get_nowait()
+                    req = self._pending.get_nowait(
+                        allow_throughput=free_now > self._lat_reserve)
                 except queue.Empty:
+                    return started
+                if defer_lattice and self._needs_lattice(req):
+                    # a lattice admission cannot start under an
+                    # un-reaped block (its interleaved decode ticks
+                    # would re-decode stale tokens): return the
+                    # request to the HEAD of its class line for the
+                    # next synchronous pass. Pop-then-push-front
+                    # instead of peek: with per-class lines a
+                    # concurrent put() could otherwise change which
+                    # head the verdict applied to.
+                    self._pending.put_front(req)
                     return started
                 if req.stream.cancelled.is_set():
                     req.stream._q.put(None)
@@ -1413,7 +1566,10 @@ class GenerationEngine:
         O(entries x prompt) LCP rescan of an unchanged index on the
         serving-loop thread is pure waste."""
         L = len(req.prompt)
-        if L > self.prompt_buckets[-1]:
+        if L > self._chunk:
+            # past the chunk budget (== the largest bucket by default;
+            # smaller when TPU_PREFILL_CHUNK bounds per-dispatch
+            # prefill work) the prompt admits through the lattice
             return True
         if not self._paged and self._kvc is not None:
             # contiguous engines: a usable tier hit ALSO resumes the
@@ -1496,7 +1652,7 @@ class GenerationEngine:
         self._slot_adapter[idx] = req.adapter
         self._touch("adapters")
         pos = self._prefix_restore(idx, req, L, C)
-        if pos == 0 and L <= C:
+        if pos == 0 and L <= self._chunk:
             Sb = pad_bucket(L, self.prompt_buckets)
             padded = np.zeros((1, Sb), np.int32)
             padded[0, :L] = req.prompt
@@ -1513,11 +1669,12 @@ class GenerationEngine:
         prompt? The final chunk's bucket must not pad wider than the
         prompt (a negative window start would slice off the compiled
         lattice) — the shared reject-to-miss guard for prefix hits on
-        both engine kinds."""
-        C = self.prompt_buckets[-1]
+        both engine kinds. Mirrors ``_chunk_lattice``'s loop: mid
+        chunks advance by the configured chunk budget."""
+        T = self._chunk
         rem = L - m
-        while rem > C:
-            rem -= C
+        while rem > T:
+            rem -= T
         return L - pad_bucket(rem, self.prompt_buckets) >= 0
 
     def _chunk_lattice(self, attr: str, slot: int, req: _Request,
@@ -1525,29 +1682,55 @@ class GenerationEngine:
         """Run the chunked-prefill lattice for ``req.prompt[pos:]``
         against the cache at ``getattr(self, attr)`` ("cache" for the
         contiguous engine, "_scratch" for paged long-prompt admission),
-        writing into batch row ``slot``. One decode block runs between
-        mid chunks so long admissions never stall active decode streams
-        (VERDICT r2 weak #5). Returns the final chunk's sampled
-        (token, logprob) — or (0, 0.0) when the request was cancelled
-        mid-lattice (the token is discarded anyway: _deliver retires
-        cancelled slots before use)."""
+        writing into batch row ``slot``. Between mid chunks (interleave
+        on) the loop yields the device: one admission pass for NEW
+        arrivals — a bucket-lattice request reaching the pending line
+        mid-prefill gets its own prefill dispatched within one chunk
+        budget instead of waiting out this whole prompt — then one
+        decode block for the live batch, so long admissions never
+        stall active decode streams. With ``prefill_chunk <= 0`` the
+        chunks dispatch back-to-back (the head-of-line contrast arm
+        tools/slo_bench.py measures against). Returns the final
+        chunk's sampled (token, logprob) — or (0, 0.0) when the
+        request was cancelled or deadline-expired mid-lattice (the
+        token is discarded anyway: _deliver retires cancelled slots
+        before use)."""
         L = len(req.prompt)
-        C = self.prompt_buckets[-1]
-        while L - pos > C:
+        T = self._chunk
+        while L - pos > T:
             if req.stream.cancelled.is_set():
                 return 0, 0.0
-            chunk = req.prompt[pos:pos + C]
+            if self._expire_mid_lattice(req, pos):
+                return 0, 0.0
+            chaos.fire(chaos.GENERATOR_CHUNK)
+            chunk = req.prompt[pos:pos + T]
             setattr(self, attr, self._chunk_mid_jit(
                 getattr(self, attr), self.params,
                 jnp.asarray(chunk[None, :]), jnp.int32(pos),
                 jnp.int32(slot), jnp.int32(0), jnp.int32(0),
                 jnp.float32(0.0), jnp.int32(0), self._key,
                 self._adapter1(req)))
-            pos += C
-            inflight = self._decode_tick()  # synchronous: the lattice
-            if inflight is not None:        # already runs under the
-                inflight.reap()             # device lock
+            pos += T
+            if self.metrics is not None:
+                self.metrics.increment_counter("app_tpu_prefill_chunks_total")
+            if not self._chunk_interleave:
+                continue
+            # Yield between chunks — everything below already runs
+            # under the device lock (the lattice is only entered from
+            # the loop thread's admission pass):
+            #   1. admit new arrivals into OTHER free slots (this
+            #      slot is claimed by _start); lattice-path arrivals
+            #      stay queued — one chunk stream at a time;
+            #   2. one decode block for the live batch, reaped
+            #      synchronously so its tokens deliver before the
+            #      next chunk occupies the device.
+            self._admit(defer_lattice=True)
+            inflight = self._decode_tick()
+            if inflight is not None:
+                inflight.reap()
         if req.stream.cancelled.is_set():
+            return 0, 0.0
+        if self._expire_mid_lattice(req, pos):
             return 0, 0.0
         rem = L - pos
         Sb = pad_bucket(rem, self.prompt_buckets)
@@ -1559,6 +1742,28 @@ class GenerationEngine:
             jnp.int32(req.top_k), self._key, self._adapter1(req))
         setattr(self, attr, new_cache)
         return int(tok), float(lp)
+
+    def _expire_mid_lattice(self, req: _Request, pos: int) -> bool:
+        """Deadline check between chunk dispatches: a half-prefilled
+        request whose caller already gave up must stop burning device
+        time NOW — its remaining chunks, its decode slot, all of it.
+        Fails the stream with DeadlineExceeded and flips the cancelled
+        flag so the existing cancel-retire path (parked cursor, block
+        release at _deliver/_retire) cleans the slot up."""
+        if req.deadline is None or not req.deadline.expired():
+            return False
+        self._count_expired()
+        req.stream.failed = "deadline expired mid-prefill"
+        req.stream._q.put(DeadlineExceeded(
+            f"deadline expired after {pos}/{len(req.prompt)} prompt "
+            "tokens were prefilled"))
+        req.stream.cancel()
+        if self._observe is not None:
+            self._observe.recorder.record(
+                "expired_mid_prefill", request_id=req.stream.request_id,
+                trace_id=req.stream.trace_id, prefilled=pos,
+                prompt_len=len(req.prompt))
+        return True
 
     # -- paged-mode host side ------------------------------------------------
     def _paged_admit_prefill(self, idx: int, req: _Request,
@@ -1575,7 +1780,6 @@ class GenerationEngine:
         blocks are never rewritten."""
         L = len(req.prompt)
         T = self._block_t
-        C = self.prompt_buckets[-1]
         blocks = shared + fresh
         self._slot_adapter[idx] = req.adapter
         self._touch("adapters")
@@ -1593,7 +1797,7 @@ class GenerationEngine:
         # holder; the write-back only repairs the fresh region).
         self._slot_blocks[idx] = blocks
         self._cursors[idx] = L
-        if m == 0 and L <= C:
+        if m == 0 and L <= self._chunk:
             Sb = pad_bucket(L, self.prompt_buckets)
             n_wr = -(-Sb // T)
             write_blocks = blocks + [0] * (n_wr - len(blocks))
@@ -1910,6 +2114,13 @@ class GenerationEngine:
         self.metrics.set_gauge("app_tpu_queue_depth",
                                float(self._pending.qsize()),
                                program="generate")
+        for cls in (SLO_LATENCY, SLO_THROUGHPUT):
+            # per-class wait lines alongside the total (distinct label
+            # sets are distinct series; dashboards on the unlabeled
+            # total keep working)
+            self.metrics.set_gauge("app_tpu_queue_depth",
+                                   float(self._pending.qsize_class(cls)),
+                                   program="generate", slo_class=cls)
 
     def _start(self, idx: int, slot: _Slot, req: _Request,
                blocks: "tuple | None" = None) -> None:
@@ -1922,7 +2133,13 @@ class GenerationEngine:
             self._observe.recorder.record(
                 "admitted", request_id=req.stream.request_id,
                 trace_id=req.stream.trace_id, slot=idx,
+                slo_class=req.slo_class,
                 wait_s=round(t0 - req.enqueued_at, 6))
+        # CLAIM the slot before any dispatch: a chunk-lattice admission
+        # runs nested admission passes between chunks, and an unclaimed
+        # slot (request still None until the old post-prefill
+        # assignment) would be handed to a second request mid-lattice
+        slot.request = req
         try:
             chaos.fire(chaos.GENERATOR_PREFILL)
             if self._paged:
@@ -1949,6 +2166,11 @@ class GenerationEngine:
                 self._cursors[idx] = 0
                 self._touch("table")
                 self._alloc.free(shared + fresh)
+            # un-claim BEFORE re-raising: the loop's recovery handler
+            # retires every slot holding a request, and this stream is
+            # already failed right here — leaving the claim would
+            # deliver it a second error and end its registry entry twice
+            slot.request = None
             req.stream._q.put(GenerationError(f"prefill failed: {e!r}"))
             req.stream._q.put(None)
             self._obs_end(req.stream, "failed", stage="prefill",
@@ -1957,16 +2179,16 @@ class GenerationEngine:
         prefill_done = time.monotonic()
         req.stream.trace["prefill_done"] = prefill_done
         self._obs_span("tpu.admit-wait", req.enqueued_at, t0, req.stream,
-                       {"slot": idx})
+                       {"slot": idx, "slo_class": req.slo_class})
         self._obs_span("tpu.prefill", t0, prefill_done, req.stream,
-                       {"slot": idx, "prompt_len": len(req.prompt)})
+                       {"slot": idx, "prompt_len": len(req.prompt),
+                        "slo_class": req.slo_class})
         self._prefix_store(idx, req)
         if self._spec_k:
             self._hist_set(idx, req.prompt)
         if self.metrics is not None:
             self.metrics.record_histogram("app_tpu_batch_wait_duration",
                                           t0 - req.enqueued_at, program="generate")
-        slot.request = req
         slot.generated = 0
         slot.remaining = req.max_new
         self.total_requests += 1
@@ -1998,12 +2220,14 @@ class GenerationEngine:
             ttft = now - req.stream.trace["submit"]
             if self.metrics is not None:
                 self.metrics.record_histogram("app_tpu_ttft_duration", ttft,
-                                              program="generate")
+                                              program="generate",
+                                              slo_class=req.slo_class)
             self._obs_stage(req.stream, "decode")
             if self._observe is not None:
                 self._observe.recorder.record(
                     "first_token", request_id=req.stream.request_id,
                     trace_id=req.stream.trace_id, slot=idx,
+                    slo_class=req.slo_class,
                     ttft_s=round(ttft, 6))
         # inter-token latency is recorded at the REAP level (_record_itl),
         # not here: a fused decode block delivers its K tokens back-to-back
@@ -2041,7 +2265,8 @@ class GenerationEngine:
                                    program="generate")
         if first is not None and slot.generated > 0:
             self._obs_span("tpu.decode", first, now, stream,
-                           {"slot": idx, "tokens": slot.generated})
+                           {"slot": idx, "tokens": slot.generated,
+                            "slo_class": slot.request.slo_class})
         event = ("failed" if stream.failed is not None
                  else "cancelled" if stream.cancelled.is_set()
                  else "finished")

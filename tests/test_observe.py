@@ -253,11 +253,17 @@ def test_full_app_generation_flight_recorder_and_telemetry():
         text = body.decode()
 
         def series_count(name):
+            # TTFT carries the scheduler's slo_class label (untagged
+            # traffic is latency-class — serving-scheduler.md); the
+            # inter-token series stays program-only
             line = next(l for l in text.splitlines()
-                        if l.startswith(f'{name}_count{{program="generate"}}'))
+                        if l.startswith(f'{name}_count{{program="generate"'))
             return int(float(line.split()[-1]))
 
         assert series_count("app_tpu_ttft_duration") >= 1
+        assert 'slo_class="latency"' in next(
+            l for l in text.splitlines()
+            if l.startswith('app_tpu_ttft_duration_count{'))
         assert series_count("app_tpu_inter_token_duration") >= 99
         assert 'app_tpu_active_sequences 0.0' in text  # drained
         assert 'app_tpu_queue_depth{program="generate"} 0.0' in text

@@ -119,7 +119,18 @@ def test_kv_cache_specs():
 def test_train_state_checkpoint_resume(tmp_path):
     """Full training-state resume (step + params + adam moments), restored
     DIRECTLY sharded — including onto a DIFFERENT mesh topology than the
-    one that saved it (orbax reshards at load)."""
+    one that saved it (orbax reshards at load).
+
+    The continuation's loss is checked against a SINGLE-DEVICE forward
+    of the restored params, not against a second step on the saving
+    mesh: on this jax/XLA-CPU version a fused train step on a 3-axis
+    dp×fsdp×tp mesh computes a loss that drifts ~1% from the pure
+    forward of the SAME params (grad-coupled GSPMD partitioning; the
+    pure jitted loss/grad on that mesh is exact, and every restored
+    leaf is verified bit-equal below, so the checkpoint machinery is
+    not the cause — reproduce with a plain `params - lr*grads` step,
+    no optimizer, no donation). The resumed mesh_b (tp=4,dp=2) step
+    matches the single-device reference to float tolerance."""
     cfg = LLAMA_CONFIGS["tiny"].with_(n_layers=2, max_seq=32)
     opt = parallel.default_optimizer(lr=1e-3, warmup=1, total_steps=10)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
@@ -135,17 +146,24 @@ def test_train_state_checkpoint_resume(tmp_path):
     path = str(tmp_path / "ckpt")
     parallel.save_train_state(path, state)
 
-    # resume on a DIFFERENT topology
+    # resume on a DIFFERENT topology; EVERY leaf (params, adam moments,
+    # step) must round-trip bit-exact through the reshard
     mesh_b = parallel.make_mesh(tp=4, dp=2)
     restored = parallel.restore_train_state(path, cfg, mesh_b, opt)
     assert int(restored.step) == 2
-    np.testing.assert_array_equal(
-        np.asarray(jax.device_get(restored.params["embedding"])),
-        np.asarray(jax.device_get(state.params["embedding"])))
+    for want, got in zip(jax.tree.leaves(state),
+                         jax.tree.leaves(restored), strict=True):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(want)), np.asarray(jax.device_get(got)))
 
-    # training continues from the restored state with the same loss curve
+    # training continues from the restored state with the same loss
+    # curve: the next step's loss equals the single-device forward loss
+    # of the saved params (loss is computed pre-update)
+    host_params = jax.tree.map(
+        lambda a: jnp.asarray(np.asarray(jax.device_get(a))), state.params)
+    logits = llama.forward(host_params, cfg, tokens, lengths)
+    want_loss = float(parallel.next_token_loss(logits, tokens, lengths))
     step_b = parallel.make_train_step(cfg, opt, mesh_b, remat=False)
     cont, m3 = step_b(restored, tokens, lengths)
-    ref, m3_ref = step_a(state, tokens, lengths)
-    assert abs(float(m3["loss"]) - float(m3_ref["loss"])) < 1e-4
+    assert abs(float(m3["loss"]) - want_loss) < 1e-4
     assert int(cont.step) == 3

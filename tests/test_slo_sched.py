@@ -1,0 +1,546 @@
+"""SLO-class scheduling + chunked-prefill interleave (ISSUE 7).
+
+Three layers under test:
+
+  - resilience: class parsing, the ambient class scope, and the
+    admission gate's degradation ORDER (throughput-class sheds and
+    brownouts at a fraction of the latency-class bounds);
+  - batcher: per-class wait lines — latency first, throughput picked
+    up through the anti-starvation reserve and its own delay flush;
+  - generator: the class-aware pending line, chunked prefill that
+    stays TOKEN-EXACT against the head-of-line arm on both engine
+    kinds, mid-lattice admission of new arrivals, expiry-drop of a
+    half-prefilled request, and DeviceLost recovery mid-chunk.
+"""
+
+import dataclasses
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from gofr_tpu import chaos
+from gofr_tpu.errors import DeadlineExceeded, TooManyRequests
+from gofr_tpu.models import LLAMA_CONFIGS, llama
+from gofr_tpu.resilience import (AdmissionGate, Deadline, SLO_LATENCY,
+                                 SLO_THROUGHPUT, current_slo_class,
+                                 parse_slo_class, slo_scope)
+from gofr_tpu.tpu import GenerationEngine
+from gofr_tpu.tpu.batcher import ClassPolicy, CoalescingBatcher
+from gofr_tpu.tpu.generator import GenerationError, _ClassPending, _Request
+
+
+# -- resilience: class vocabulary + scope ------------------------------------
+
+def test_parse_slo_class_defaults_to_latency():
+    assert parse_slo_class(None) == SLO_LATENCY
+    assert parse_slo_class("") == SLO_LATENCY
+    assert parse_slo_class("interactive") == SLO_LATENCY
+    assert parse_slo_class("typo-throughputt") == SLO_LATENCY
+    for alias in ("throughput", "Batch", " BULK ", "offline",
+                  "best-effort"):
+        assert parse_slo_class(alias) == SLO_THROUGHPUT
+
+
+def test_ctx_and_middleware_thread_the_class():
+    """The HTTP middleware opens the ambient scope from X-SLO-Class and
+    ctx.slo_class reads it — the path ctx.tpu.generate inherits."""
+    from gofr_tpu.context import Context
+    from gofr_tpu.http.middleware import slo_class_middleware
+
+    seen = {}
+
+    class _Req:
+        def header(self, key, default=""):
+            return "batch" if key == "X-SLO-Class" else default
+
+    def handler(req, w):
+        seen["cls"] = Context(request=req, container=None).slo_class
+
+    slo_class_middleware()(handler)(_Req(), None)
+    assert seen["cls"] == SLO_THROUGHPUT
+    assert Context(request=None, container=None).slo_class == SLO_LATENCY
+
+
+def test_slo_scope_ambient_and_nesting():
+    assert current_slo_class() == SLO_LATENCY
+    with slo_scope(SLO_THROUGHPUT):
+        assert current_slo_class() == SLO_THROUGHPUT
+        with slo_scope(None):  # None inherits
+            assert current_slo_class() == SLO_THROUGHPUT
+        with slo_scope(SLO_LATENCY):  # explicit nested class wins
+            assert current_slo_class() == SLO_LATENCY
+        assert current_slo_class() == SLO_THROUGHPUT
+    assert current_slo_class() == SLO_LATENCY
+
+
+# -- resilience: gate degradation order --------------------------------------
+
+def test_gate_sheds_throughput_first_on_depth():
+    gate = AdmissionGate(max_queue_depth=8, throughput_factor=0.5)
+    # depth 4 = half the bound: throughput sheds, latency sails through
+    gate.admit(4, slo_class=SLO_LATENCY)
+    with pytest.raises(TooManyRequests):
+        gate.admit(4, slo_class=SLO_THROUGHPUT)
+    gate.admit(7, slo_class=SLO_LATENCY)
+    with pytest.raises(TooManyRequests):
+        gate.admit(8, slo_class=SLO_LATENCY)
+    assert gate.sheds_by_class[SLO_THROUGHPUT] == 1
+    assert gate.sheds_by_class[SLO_LATENCY] == 1
+
+
+def test_gate_sheds_throughput_first_on_delay():
+    gate = AdmissionGate(max_queue_delay=0.1, throughput_factor=0.5)
+    for _ in range(50):
+        gate.note_wait(0.08)  # EWMA converges into (0.05, 0.1)
+    gate.admit(1, slo_class=SLO_LATENCY)
+    with pytest.raises(TooManyRequests):
+        gate.admit(1, slo_class=SLO_THROUGHPUT)
+
+
+def test_gate_brownout_caps_throughput_first():
+    gate = AdmissionGate(max_queue_depth=100, brownout_delay=0.1,
+                         brownout_max_new=8, throughput_factor=0.5)
+    for _ in range(50):
+        gate.note_wait(0.08)
+    assert gate.cap_tokens(64, SLO_LATENCY) == 64
+    assert gate.cap_tokens(64, SLO_THROUGHPUT) == 8
+    for _ in range(50):
+        gate.note_wait(0.2)  # past the latency band too
+    assert gate.cap_tokens(64, SLO_LATENCY) == 8
+    assert gate.stats()["brownout_active"] is True
+
+
+def test_gate_brownout_clears_for_silent_class():
+    """A class whose traffic vanished (e.g. throughput fully shed at
+    admit) must still CLEAR its brownout band once the estimate
+    recovers — any observation refreshes every class's state, and
+    stats() derives liveness from the estimate, not the flags."""
+    gate = AdmissionGate(max_queue_depth=100, brownout_delay=0.1,
+                         brownout_max_new=4, throughput_factor=0.5)
+    for _ in range(50):
+        gate.note_wait(0.08)
+    assert gate.cap_tokens(64, SLO_THROUGHPUT) == 4
+    assert gate.stats()["brownout_active"] is True
+    for _ in range(80):
+        gate.note_wait(0.0)  # recovery; only latency traffic remains
+    assert gate.cap_tokens(64, SLO_LATENCY) == 64
+    assert gate._brownout_on[SLO_THROUGHPUT] is False
+    assert gate.stats()["brownout_active"] is False
+
+
+def test_gate_factor_one_is_class_blind():
+    gate = AdmissionGate(max_queue_depth=4, throughput_factor=1.0)
+    gate.admit(3, slo_class=SLO_THROUGHPUT)
+    with pytest.raises(TooManyRequests):
+        gate.admit(4, slo_class=SLO_THROUGHPUT)
+    with pytest.raises(TooManyRequests):
+        gate.admit(4, slo_class=SLO_LATENCY)
+
+
+# -- batcher: per-class wait lines -------------------------------------------
+
+def _run_batcher(policy, submissions, max_batch=4, max_delay=0.004,
+                 hold_first=0.0):
+    """Drive a batcher with ``submissions`` = [(id, class, delay_s)];
+    returns the dispatched batches (lists of ids) in order."""
+    batches, lock = [], threading.Lock()
+
+    def runner(items):
+        with lock:
+            batches.append(list(items))
+        if hold_first and len(batches) == 1:
+            time.sleep(hold_first)
+        return items
+
+    b = CoalescingBatcher(runner, max_batch=max_batch, max_delay=max_delay,
+                          class_policy=policy)
+    threads = []
+    for rid, cls, delay in submissions:
+        time.sleep(delay)
+        t = threading.Thread(
+            target=lambda r=rid, c=cls: b.submit(r, timeout=10, slo_class=c))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=15)
+    b.close()
+    return batches
+
+
+def test_latency_dispatches_before_earlier_throughput():
+    """A throughput item queued FIRST still yields the batch head to
+    latency items that arrive within the same flush window."""
+    batches = _run_batcher(
+        ClassPolicy(throughput_delay=5.0, throughput_share=0.25),
+        [("T", SLO_THROUGHPUT, 0.0),
+         ("L1", SLO_LATENCY, 0.001), ("L2", SLO_LATENCY, 0.0)],
+        max_batch=2, max_delay=0.05)
+    first = batches[0]
+    assert first[0] in ("L1", "L2") and len(first) == 2
+    # the reserve hands throughput its slot in the first full batch
+    assert "T" in first
+
+
+def test_throughput_reserve_survives_latency_saturation():
+    """With latency traffic saturating every batch, the reserve share
+    still drains the throughput line (anti-starvation floor). The
+    first dispatch HOLDS the runner while both lines fill, so the
+    composition of the next batch is deterministic: 3 latency + the
+    reserved throughput slot."""
+    subs = [("L0", SLO_LATENCY, 0.0)]          # triggers the held dispatch
+    subs += [("T", SLO_THROUGHPUT, 0.01)]      # queued while held
+    subs += [(f"L{i}", SLO_LATENCY, 0.0) for i in range(1, 12)]
+    batches = _run_batcher(
+        ClassPolicy(throughput_delay=30.0, throughput_share=0.25),
+        subs, max_batch=4, max_delay=0.004, hold_first=0.05)
+    # T's delay flush (30s) can never fire in-test: only the reserve
+    # can have picked it up
+    assert any("T" in b for b in batches)
+    picked = next(b for b in batches if "T" in b)
+    assert sum(1 for x in picked if x != "T") == 3  # latency kept 3/4 slots
+
+
+def test_throughput_solo_flushes_on_its_own_delay():
+    """A lone throughput item must not wait forever: it flushes at
+    throughput_delay even with the latency line empty."""
+    t0 = time.monotonic()
+    batches = _run_batcher(
+        ClassPolicy(throughput_delay=0.05, throughput_share=0.25),
+        [("T", SLO_THROUGHPUT, 0.0)], max_batch=8, max_delay=0.002)
+    took = time.monotonic() - t0
+    assert batches == [["T"]]
+    assert took >= 0.04  # waited the throughput window, not max_delay
+
+
+def test_classless_batcher_ignores_slo_tags():
+    """Without a policy the classes share one FIFO line — order is
+    arrival order, and the native path stays eligible."""
+    batches = _run_batcher(
+        None,
+        [("T", SLO_THROUGHPUT, 0.0), ("L", SLO_LATENCY, 0.002)],
+        max_batch=2, max_delay=0.05)
+    assert batches[0] == ["T", "L"]
+
+
+# -- generator: class pending line -------------------------------------------
+
+def _req(cls):
+    class _S:  # minimal stand-in: the line only reads slo_class
+        pass
+    r = object.__new__(_Request)
+    r.slo_class = cls
+    return r
+
+
+def test_class_pending_prefers_latency_with_antistarvation():
+    q = _ClassPending(throughput_share=0.25)  # 1 throughput pick per 3
+    for i in range(6):
+        q.put(_req(SLO_THROUGHPUT))
+    for i in range(20):
+        q.put(_req(SLO_LATENCY))
+    order = [q.get_nowait().slo_class for _ in range(12)]
+    # first three latency, then the guaranteed throughput pick, repeating
+    assert order == ([SLO_LATENCY] * 3 + [SLO_THROUGHPUT]) * 3
+
+
+def test_class_pending_high_share_is_a_floor():
+    """Shares past 1/2 floor toward throughput-first rather than
+    silently disabling the guarantee (the realized contended fraction
+    1/(weight+1) is always >= the configured share)."""
+    q = _ClassPending(throughput_share=0.75)
+    for _ in range(3):
+        q.put(_req(SLO_THROUGHPUT))
+    for _ in range(3):
+        q.put(_req(SLO_LATENCY))
+    order = [q.get_nowait().slo_class for _ in range(6)]
+    # weight 0: throughput picked whenever it waits; latency drains after
+    assert order == [SLO_THROUGHPUT] * 3 + [SLO_LATENCY] * 3
+
+
+def test_class_pending_zero_share_drains_on_idle_only():
+    q = _ClassPending(throughput_share=0.0)
+    q.put(_req(SLO_THROUGHPUT))
+    for _ in range(5):
+        q.put(_req(SLO_LATENCY))
+    order = [q.get_nowait().slo_class for _ in range(6)]
+    assert order == [SLO_LATENCY] * 5 + [SLO_THROUGHPUT]
+
+
+def test_class_pending_put_front_restores_head():
+    q = _ClassPending()
+    a, b = _req(SLO_LATENCY), _req(SLO_LATENCY)
+    q.put(a)
+    q.put(b)
+    got = q.get_nowait()
+    assert got is a
+    q.put_front(got)
+    assert q.get_nowait() is a
+    assert q.get_nowait() is b
+    assert q.empty()
+
+
+def test_class_pending_put_front_restores_streak():
+    """A deferred pop must not burn the throughput line's earned turn:
+    pop-then-push-front restores the anti-starvation streak, so the
+    very next allowed pick still goes to throughput."""
+    q = _ClassPending(throughput_share=0.25)  # weight 3
+    t = _req(SLO_THROUGHPUT)
+    q.put(t)
+    for _ in range(6):
+        q.put(_req(SLO_LATENCY))
+    for _ in range(3):
+        assert q.get_nowait().slo_class == SLO_LATENCY
+    # streak earned: throughput's turn — but the pass defers it
+    got = q.get_nowait()
+    assert got is t
+    q.put_front(got)
+    # the credit survives: the next pick is STILL throughput's
+    assert q.get_nowait() is t
+    # and the cadence continues normally afterwards
+    assert [q.get_nowait().slo_class for _ in range(3)] == [SLO_LATENCY] * 3
+
+
+# -- generator: chunked prefill ----------------------------------------------
+
+TINY = dataclasses.replace(LLAMA_CONFIGS["tiny"], max_seq=256)
+BUCKETS = (8, 16, 32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init(TINY, jax.random.PRNGKey(1))
+
+
+def _engine(params, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("max_seq", 256)
+    kw.setdefault("prompt_buckets", BUCKETS)
+    kw.setdefault("decode_block", 2)
+    return GenerationEngine(TINY, params, **kw)
+
+
+def _prompt(n, seed=7):
+    return np.random.default_rng(seed).integers(
+        1, TINY.vocab_size, n).tolist()
+
+
+def test_chunked_interleave_token_exact_contiguous(params):
+    """Interleaved chunked admission (default and a smaller budget)
+    must match the head-of-line arm token for token — chunking is an
+    execution schedule, never a semantics change."""
+    prompt = _prompt(200)
+    ref_eng = _engine(params, prefill_chunk=0)  # head-of-line arm
+    ref = ref_eng.generate(prompt, max_new_tokens=12).tokens()
+    ref_eng.close()
+    for chunk in (None, 16):
+        eng = _engine(params, prefill_chunk=chunk)
+        got = eng.generate(prompt, max_new_tokens=12).tokens()
+        eng.close()
+        assert got == ref, f"chunk={chunk} diverged"
+
+
+def test_chunked_interleave_token_exact_paged(params):
+    """Same exactness contract on the paged engine's scratch-row
+    lattice (chunk budget below the largest bucket included)."""
+    prompt = _prompt(100, seed=11)
+    ref_eng = _engine(params, paged_blocks=40, paged_block_size=16,
+                      prefill_chunk=0)
+    ref = ref_eng.generate(prompt, max_new_tokens=10).tokens()
+    ref_eng.close()
+    for chunk in (None, 16):
+        eng = _engine(params, paged_blocks=40, paged_block_size=16,
+                      prefill_chunk=chunk)
+        got = eng.generate(prompt, max_new_tokens=10).tokens()
+        eng.close()
+        assert got == ref, f"paged chunk={chunk} diverged"
+
+
+def test_short_request_first_token_beats_long_prefill(params):
+    """The tentpole property: a short request reaching the line while
+    a long prompt chunk-prefills gets its first token BEFORE the long
+    prompt finishes prefilling — one chunk budget of wait, not one
+    whole prefill."""
+    eng = _engine(params)
+    eng.warmup()
+    try:
+        long_s = eng.generate(_prompt(200), max_new_tokens=48)
+        time.sleep(0.005)  # let the lattice start
+        short_s = eng.generate(_prompt(6, seed=3), max_new_tokens=4)
+        short_toks = short_s.tokens()
+        long_toks = long_s.tokens()
+        assert len(short_toks) == 4 and len(long_toks) == 48
+        assert short_s.trace["first_put"] < long_s.trace["first_put"], (
+            "short request's first token waited out the long prefill")
+    finally:
+        eng.close()
+
+
+def test_head_of_line_arm_blocks_short_request(params):
+    """The contrast arm really is head-of-line: with interleave off the
+    short request's first token waits for the whole long prefill (this
+    is what tools/slo_bench.py measures at scale)."""
+    eng = _engine(params, prefill_chunk=0)
+    eng.warmup()
+    try:
+        long_s = eng.generate(_prompt(200), max_new_tokens=4)
+        time.sleep(0.005)
+        short_s = eng.generate(_prompt(6, seed=3), max_new_tokens=4)
+        short_s.tokens()
+        long_s.tokens()
+        assert short_s.trace["first_put"] > long_s.trace["prefill_done"]
+    finally:
+        eng.close()
+
+
+@pytest.mark.chaos
+def test_expiry_drops_half_prefilled_request(params):
+    """A deadline that runs out mid-lattice stops the remaining chunks:
+    the stream fails with DeadlineExceeded naming the prefilled length,
+    the slot frees, and the engine keeps serving. A chaos latency rule
+    on the chunk seam pins the lattice duration far past the deadline,
+    so expiry deterministically fires MID-lattice (a bare sleep-based
+    deadline can expire in the admission queue under suite load)."""
+    eng = _engine(params, prefill_chunk=8)   # many small chunks
+    eng.warmup()
+    sched = chaos.ChaosSchedule(seed=0).on(
+        chaos.GENERATOR_CHUNK, latency=0.02)  # ~27 chunks >> 150 ms
+    try:
+        with chaos.scope(sched):
+            stream = eng.generate(_prompt(220), max_new_tokens=8,
+                                  deadline=Deadline.after(0.15))
+            with pytest.raises(DeadlineExceeded) as ei:
+                stream.tokens()
+        assert "prefilled" in str(ei.value), (
+            "expiry should fire MID-lattice, not at admission")
+        # the engine is healthy and the slot came back
+        assert eng.generate(_prompt(6, seed=5),
+                            max_new_tokens=3).tokens()
+        assert all(s.free for s in eng._slots)
+    finally:
+        eng.close()
+
+
+@pytest.mark.chaos
+def test_devicelost_mid_chunk_recovers(params):
+    """DeviceLost on the 2nd mid-chunk dispatch: the victim stream
+    fails fast, recovery reallocates the donated cache, and the next
+    long admission prefills token-exact."""
+    ref_eng = _engine(params)
+    want = ref_eng.generate(_prompt(200), max_new_tokens=8).tokens()
+    ref_eng.close()
+
+    eng = _engine(params)
+    sched = chaos.ChaosSchedule(seed=0).on(
+        chaos.GENERATOR_CHUNK, error=chaos.DeviceLost, every=2, limit=1)
+    try:
+        with chaos.scope(sched):
+            stream = eng.generate(_prompt(200), max_new_tokens=8)
+            with pytest.raises(GenerationError):
+                stream.tokens()
+        got = eng.generate(_prompt(200), max_new_tokens=8).tokens()
+        assert got == want
+    finally:
+        eng.close()
+
+
+# -- generator: class scheduling end to end ----------------------------------
+
+def test_latency_request_admitted_before_earlier_throughput(params):
+    """With one slot busy, a latency request queued AFTER a throughput
+    request still takes the next free slot."""
+    eng = _engine(params, slots=1)
+    eng.warmup()
+    try:
+        blocker = eng.generate(_prompt(6, seed=1), max_new_tokens=64)
+        time.sleep(0.01)  # blocker owns the only slot
+        thr = eng.generate(_prompt(6, seed=2), max_new_tokens=2,
+                           slo_class=SLO_THROUGHPUT)
+        lat = eng.generate(_prompt(6, seed=3), max_new_tokens=2,
+                           slo_class=SLO_LATENCY)
+        lat_toks = lat.tokens()
+        thr_toks = thr.tokens()
+        blocker.tokens()
+        assert len(lat_toks) == 2 and len(thr_toks) == 2
+        assert lat.trace["first_put"] < thr.trace["first_put"]
+    finally:
+        eng.close()
+
+
+def test_latency_reserved_slot_blocks_throughput(params):
+    """With the default 1-slot latency reserve, throughput-class
+    admissions stop at slots-1 occupancy: the reserved slot stays free
+    for a latency arrival even while throughput queues."""
+    eng = _engine(params, slots=2)
+    eng.warmup()
+    try:
+        t1 = eng.generate(_prompt(6, seed=1), max_new_tokens=96,
+                          slo_class=SLO_THROUGHPUT)
+        time.sleep(0.02)
+        t2 = eng.generate(_prompt(6, seed=2), max_new_tokens=2,
+                          slo_class=SLO_THROUGHPUT)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            s = eng.stats()
+            if s["active"] == 1 and \
+                    s["scheduler"]["queued_throughput"] == 1:
+                break
+            time.sleep(0.005)
+        else:
+            pytest.fail(f"throughput took the reserved slot: {eng.stats()}")
+        lat = eng.generate(_prompt(6, seed=3), max_new_tokens=2)
+        assert lat.tokens()  # served from the reserved slot immediately
+        assert t2.trace.get("first_put") is None, (
+            "queued throughput ran before the reserve freed")
+        t1.cancel()
+        assert len(t2.tokens()) == 2  # drains once the engine idles
+        t1.tokens()
+    finally:
+        eng.close()
+
+
+def test_generate_rejects_unknown_slo_class(params):
+    eng = _engine(params, slots=1)
+    try:
+        with pytest.raises(GenerationError):
+            eng.generate(_prompt(6), slo_class="platinum")
+    finally:
+        eng.close()
+
+
+def test_engine_gate_sheds_throughput_first(params):
+    eng = _engine(params, slots=1,
+                  gate=AdmissionGate(max_queue_depth=4,
+                                     throughput_factor=0.5,
+                                     name="generate"))
+    eng.warmup()
+    try:
+        blocker = eng.generate(_prompt(6, seed=1), max_new_tokens=96)
+        time.sleep(0.01)
+        queued = [eng.generate(_prompt(6, seed=10 + i), max_new_tokens=1)
+                  for i in range(2)]  # depth 2 = throughput bound
+        with pytest.raises(TooManyRequests):
+            eng.generate(_prompt(6, seed=20), max_new_tokens=1,
+                         slo_class=SLO_THROUGHPUT)
+        ok = eng.generate(_prompt(6, seed=21), max_new_tokens=1,
+                          slo_class=SLO_LATENCY)
+        for s in queued + [ok, blocker]:
+            s.tokens()
+        assert eng.gate.sheds_by_class[SLO_THROUGHPUT] == 1
+        assert eng.gate.sheds_by_class[SLO_LATENCY] == 0
+    finally:
+        eng.close()
+
+
+def test_stats_surface_scheduler_state(params):
+    eng = _engine(params, prefill_chunk=16)
+    try:
+        sched = eng.stats()["scheduler"]
+        assert sched["prefill_chunk"] == 16
+        assert sched["chunk_interleave"] is True
+        assert sched["queued_latency"] == 0
+        assert sched["queued_throughput"] == 0
+    finally:
+        eng.close()

@@ -1,0 +1,457 @@
+#!/usr/bin/env python
+"""SLO-class scheduling + chunked-prefill benchmark: proves the serving
+scheduler closes the long-prefill TTFT gap and degrades classes in
+order under overload.
+
+CPU-only (JAX_PLATFORMS=cpu, tiny model, no chip lock): the point is
+the RATIO between scheduling policies on identical hardware, not
+absolute chip numbers. Two parts, one process, one run:
+
+PART A — chunked prefill A/B (both arms in this run):
+  head_of_line   TPU_PREFILL_CHUNK=0 — a long prompt's chunks dispatch
+                 back-to-back; a newly arrived short request waits out
+                 the WHOLE prefill and active decode streams stall
+  chunked        default — bounded chunk dispatches with one admission
+                 pass + one decode block between chunks
+
+  Load per arm: continuous long-prompt throughput-class streams
+  (the head-of-line hazard) while short latency-class probes arrive on
+  a fixed cadence. Measured: latency-class TTFT (submit -> first
+  token) and the long streams' decode inter-token gaps.
+
+PART B — 2x overload with mixed classes (gate + class degradation +
+the latency slot reserve):
+  uncontended    latency-only at 0.15x measured capacity — the
+                 reference tail
+  overload       the same latency rate + 1.85x capacity of
+                 throughput-class (2x total) through an AdmissionGate
+                 with throughput_factor 0.5 — throughput must shed
+                 FIRST and latency-class TTFT must hold near its
+                 uncontended value (the reserved slot is what makes
+                 that physically possible: admitted batch streams can
+                 never occupy every slot)
+
+Acceptance (checks; gated in --smoke too):
+  - latency-class TTFT p50 improves >= 25% chunked vs head_of_line
+  - decode inter-token p99 regresses <= 10% (it should IMPROVE:
+    head-of-line stalls decode entirely during a long prefill)
+  - under overload, throughput-class sheds dominate (latency sheds
+    stay near zero) and the latency tail holds: p95 within
+    max(1.3x, +50 ms noise floor) of uncontended — p99 and the raw
+    1.3x ratio are recorded; on CPU the uncontended p99 sits at ~one
+    decode block, so the bare ratio measures box jitter (a device
+    run is where the strict 1.3x p99 criterion is judged)
+
+Output follows the bench stdout contract (tools/README.md): the LAST
+stdout line is the JSON artifact; earlier stdout lines are partial
+snapshots; progress goes to stderr. Full runs write SLO_BENCH.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from gofr_tpu.errors import TooManyRequests  # noqa: E402
+from gofr_tpu.models import LLAMA_CONFIGS, llama  # noqa: E402
+from gofr_tpu.resilience import (AdmissionGate, SLO_LATENCY,  # noqa: E402
+                                 SLO_THROUGHPUT)
+from gofr_tpu.tpu import GenerationEngine  # noqa: E402
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def pctl(vals, p):
+    if not vals:
+        return None
+    vs = sorted(vals)
+    return vs[min(len(vs) - 1, int(p / 100.0 * len(vs)))]
+
+
+BUCKETS = (8, 16, 32)
+MAX_SEQ = 512
+LONG_LEN = 480      # ~15 mid chunks at the default 32-token budget
+SHORT_LEN = 6
+
+
+class Harness:
+    def __init__(self):
+        self.cfg = dataclasses.replace(LLAMA_CONFIGS["tiny"],
+                                       max_seq=MAX_SEQ)
+        self.params = llama.init(self.cfg, jax.random.PRNGKey(1))
+        self.rng = np.random.default_rng(42)
+
+    def engine(self, **kw) -> GenerationEngine:
+        kw.setdefault("slots", 4)
+        kw.setdefault("max_seq", MAX_SEQ)
+        kw.setdefault("prompt_buckets", BUCKETS)
+        kw.setdefault("decode_block", 2)
+        eng = GenerationEngine(self.cfg, self.params, **kw)
+        eng.warmup()
+        return eng
+
+    def prompt(self, n: int):
+        return self.rng.integers(1, self.cfg.vocab_size, n).tolist()
+
+
+class LongLoad:
+    """Keeps ``n`` concurrent long-prompt throughput-class streams
+    alive against the engine and records their client-observed decode
+    cadence — the stream a head-of-line prefill stalls.
+
+    Gaps are taken per DECODE BLOCK (every ``decode_block``-th token):
+    a fused block delivers its tokens back-to-back in one host loop,
+    and the intra-burst ~0 gaps would dilute the percentile the bench
+    gates on (the same rationale as the engine's reap-level
+    ``app_tpu_inter_token_duration``)."""
+
+    def __init__(self, harness: Harness, eng, n: int, max_new: int = 16):
+        self.h = harness
+        self.eng = eng
+        self.max_new = max_new
+        self.block = eng.decode_block
+        self.itl: list[float] = []
+        self.prefills = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = [threading.Thread(target=self._drive, daemon=True)
+                         for _ in range(n)]
+
+    def _drive(self) -> None:
+        while not self._stop.is_set():
+            prompt = self.h.prompt(LONG_LEN)
+            try:
+                stream = self.eng.generate(prompt,
+                                           max_new_tokens=self.max_new,
+                                           slo_class=SLO_THROUGHPUT)
+            except Exception:
+                time.sleep(0.01)
+                continue
+            gaps, prev = [], None
+            for i, _ in enumerate(stream):
+                if i % self.block:
+                    continue  # intra-burst delivery, not device cadence
+                now = time.monotonic()
+                if prev is not None:
+                    gaps.append(now - prev)
+                prev = now
+            with self._lock:
+                self.itl.extend(gaps)
+                self.prefills += 1
+
+    def __enter__(self) -> "LongLoad":
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30.0)
+
+
+def ttft_of(stream) -> float:
+    return stream.trace["first_put"] - stream.trace["submit"]
+
+
+def run_arm(h: Harness, name: str, probes: int, interval: float,
+            **engine_kw) -> dict:
+    """One Part-A arm: long-prefill background load + short
+    latency-class TTFT probes."""
+    log(f"slo_bench: arm {name}: building engine")
+    eng = h.engine(**engine_kw)
+    ttfts = []
+    try:
+        # 3 cycling long streams: each spends most of its life in
+        # prefill (480 tokens vs 8 decoded), so most probes arrive
+        # while a lattice is actually running — the hazard under test
+        with LongLoad(h, eng, n=3) as load:
+            time.sleep(0.2)  # let the first long prefills start
+            for _ in range(probes):
+                stream = eng.generate(h.prompt(SHORT_LEN),
+                                      max_new_tokens=4,
+                                      slo_class=SLO_LATENCY)
+                stream.tokens()  # drain: the probe slot must retire
+                ttfts.append(ttft_of(stream))
+                time.sleep(interval)
+        itl, prefills = list(load.itl), load.prefills
+    finally:
+        eng.close()
+    out = {
+        "probes": len(ttfts),
+        "long_prefills": prefills,
+        "ttft_p50_ms": round((pctl(ttfts, 50) or 0) * 1e3, 2),
+        "ttft_p99_ms": round((pctl(ttfts, 99) or 0) * 1e3, 2),
+        "itl_samples": len(itl),
+        "itl_p50_ms": round((pctl(itl, 50) or 0) * 1e3, 3),
+        "itl_p99_ms": round((pctl(itl, 99) or 0) * 1e3, 3),
+    }
+    log(f"slo_bench: arm {name}: {out}")
+    return out
+
+
+def measure_capacity(h: Harness, eng, seconds: float) -> float:
+    """Closed-loop short-request capacity (requests/s): one worker per
+    slot, no queueing — what this box actually completes."""
+    stop = time.monotonic() + seconds
+    counts = [0] * eng.n_slots
+
+    def worker(i: int) -> None:
+        while time.monotonic() < stop:
+            eng.generate(h.prompt(SHORT_LEN), max_new_tokens=16).tokens()
+            counts[i] += 1
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(len(counts))]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=seconds + 30.0)
+    return sum(counts) / (time.monotonic() - t0)
+
+
+class Phase:
+    """Open-loop mixed-class load driven by a FIXED worker pool: each
+    worker claims the next scheduled (offset, class) arrival and fires
+    it at its offset. Thread-per-request spawn jitter would otherwise
+    dominate the TTFT tails this phase exists to compare (the same
+    lesson as chaos_bench's rate cap); a bounded pool keeps the
+    arrival schedule honest while sheds return in microseconds."""
+
+    WORKERS = 32
+
+    def __init__(self, h: Harness, eng, lat_rps: float, thr_rps: float,
+                 duration: float):
+        self.h = h
+        self.eng = eng
+        self.lat_rps = lat_rps
+        self.thr_rps = thr_rps
+        self.duration = duration
+        self.lock = threading.Lock()
+        self.ttft = {SLO_LATENCY: [], SLO_THROUGHPUT: []}
+        self.sheds = {SLO_LATENCY: 0, SLO_THROUGHPUT: 0}
+        self.late = 0  # arrivals fired behind schedule (pool saturated)
+        self.errors: list[str] = []
+
+    def _one(self, cls: str) -> None:
+        try:
+            # heavier than the Part-A probes on purpose: more device
+            # time per request keeps arrival rates (and the Python-side
+            # churn that pollutes tail percentiles) low
+            stream = self.eng.generate(self.h.prompt(SHORT_LEN),
+                                       max_new_tokens=16, slo_class=cls)
+            stream.tokens()
+            t = ttft_of(stream)
+        except TooManyRequests:
+            with self.lock:
+                self.sheds[cls] += 1
+            return
+        except Exception as e:  # noqa: BLE001 — tally, judge later
+            with self.lock:
+                self.errors.append(repr(e))
+            return
+        with self.lock:
+            self.ttft[cls].append(t)
+
+    def run(self) -> dict:
+        # one merged seeded arrival schedule for both classes
+        arrivals = []
+        for cls, rate in ((SLO_LATENCY, self.lat_rps),
+                          (SLO_THROUGHPUT, self.thr_rps)):
+            if rate <= 0:
+                continue
+            n = max(1, int(rate * self.duration))
+            arrivals += [(i / rate, cls) for i in range(n)]
+        arrivals.sort()
+        cursor = [0]
+        t0 = time.monotonic()
+
+        def worker() -> None:
+            while True:
+                with self.lock:
+                    i = cursor[0]
+                    if i >= len(arrivals):
+                        return
+                    cursor[0] = i + 1
+                offset, cls = arrivals[i]
+                pause = t0 + offset - time.monotonic()
+                if pause > 0:
+                    time.sleep(pause)
+                elif pause < -0.05:
+                    with self.lock:
+                        self.late += 1
+                self._one(cls)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(min(self.WORKERS, len(arrivals)))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.duration + 60.0)
+        out = {"offered": len(arrivals), "late": self.late}
+        for cls in (SLO_LATENCY, SLO_THROUGHPUT):
+            out[cls] = {
+                "completed": len(self.ttft[cls]),
+                "sheds": self.sheds[cls],
+                "ttft_p50_ms": round((pctl(self.ttft[cls], 50) or 0) * 1e3, 2),
+                "ttft_p95_ms": round((pctl(self.ttft[cls], 95) or 0) * 1e3, 2),
+                "ttft_p99_ms": round((pctl(self.ttft[cls], 99) or 0) * 1e3, 2),
+            }
+        out["errors"] = len(self.errors)
+        return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--probes", type=int, default=60,
+                    help="Part A latency-class TTFT probes per arm")
+    ap.add_argument("--overload-s", type=float, default=8.0)
+    ap.add_argument("--uncontended-s", type=float, default=4.0)
+    ap.add_argument("--out", default=str(Path(__file__).resolve().parent.parent
+                                         / "SLO_BENCH.json"))
+    ap.add_argument("--smoke", action="store_true",
+                    help="reduced CI run: no artifact file")
+    args = ap.parse_args()
+    if args.smoke:
+        args.probes, args.overload_s, args.uncontended_s = 24, 6.0, 4.0
+
+    h = Harness()
+    result = {"bench": "slo_sched", "smoke": bool(args.smoke),
+              "long_prompt": LONG_LEN, "buckets": list(BUCKETS)}
+
+    # -- Part A: chunked-prefill A/B ------------------------------------
+    interval = 0.03
+    arms = {
+        "head_of_line": run_arm(h, "head_of_line", args.probes, interval,
+                                prefill_chunk=0),
+        "chunked": run_arm(h, "chunked", args.probes, interval),
+    }
+    result["arms"] = arms
+    hol, chk = arms["head_of_line"], arms["chunked"]
+    ttft_gain = (1 - chk["ttft_p50_ms"] / hol["ttft_p50_ms"]
+                 if hol["ttft_p50_ms"] else None)
+    itl_ratio = (chk["itl_p99_ms"] / hol["itl_p99_ms"]
+                 if hol["itl_p99_ms"] else None)
+    result["chunking_checks"] = {
+        "ttft_p50_improvement_pct": (round(ttft_gain * 100, 1)
+                                     if ttft_gain is not None else None),
+        "ttft_improves_25pct": bool(ttft_gain is not None
+                                    and ttft_gain >= 0.25),
+        "itl_p99_ratio": (round(itl_ratio, 3)
+                          if itl_ratio is not None else None),
+        "itl_p99_within_1p1x": bool(itl_ratio is not None
+                                    and itl_ratio <= 1.10),
+    }
+    print(json.dumps({"partial": "overload pending", **result}), flush=True)
+
+    # -- Part B: 2x overload, mixed classes -----------------------------
+    log("slo_bench: overload: building gated engine")
+    gate = AdmissionGate(max_queue_depth=8, throughput_factor=0.5,
+                         brownout_delay=0.05, brownout_max_new=2,
+                         name="generate")
+    eng = h.engine(gate=gate)
+    try:
+        capacity = measure_capacity(h, eng, 1.5 if args.smoke else 3.0)
+        log(f"slo_bench: measured capacity {capacity:.1f} rps")
+        # mixed 2x: latency is the minority under a batch-driven
+        # overload (0.15x capacity — within the reserved slot's own
+        # capacity, so the reservation can actually honor the SLO);
+        # throughput carries the excess to 2x total. The gate squeezes
+        # throughput out while latency keeps near-uncontended service.
+        uncontended = Phase(h, eng, lat_rps=0.15 * capacity, thr_rps=0.0,
+                            duration=args.uncontended_s).run()
+        overload = Phase(h, eng, lat_rps=0.15 * capacity,
+                         thr_rps=1.85 * capacity,
+                         duration=args.overload_s).run()
+    finally:
+        eng.close()
+    result["overload"] = {
+        "capacity_rps": round(capacity, 1),
+        "uncontended": uncontended,
+        "mixed_2x": overload,
+        "gate": {k: gate.stats()[k]
+                 for k in ("sheds", "sheds_by_class", "brownout_capped")},
+    }
+    lat_unc = uncontended[SLO_LATENCY]["ttft_p99_ms"]
+    lat_over = overload[SLO_LATENCY]["ttft_p99_ms"]
+    p99_ratio = lat_over / lat_unc if lat_unc else None
+    thr_sheds = overload[SLO_THROUGHPUT]["sheds"]
+    lat_sheds = overload[SLO_LATENCY]["sheds"]
+    # Tail gate: overloaded latency tail within 1.3x of uncontended OR
+    # an absolute scheduling-noise floor (50 ms), judged at p95. On this
+    # CPU/GIL harness the uncontended p99 lands at ~one decode block
+    # (a few ms), so a bare 1.3x bound is smaller than a single loop
+    # hiccup — it would measure the box, not the scheduler; p99 and
+    # the raw 1.3x ratio are always RECORDED so regressions stay
+    # visible, and a device run (real service times, 10^4 samples) is
+    # where the strict ratio is meaningful.
+    unc_g = uncontended[SLO_LATENCY]["ttft_p95_ms"]
+    over_g = overload[SLO_LATENCY]["ttft_p95_ms"]
+    bound_ms = max(1.3 * unc_g, unc_g + 50.0) if unc_g else None
+    gate_pctl = "p95 vs max(1.3x, +50ms floor)"
+    result["overload_checks"] = {
+        "throughput_shed_first": bool(thr_sheds > 0
+                                      and thr_sheds > 5 * lat_sheds),
+        "thr_sheds": thr_sheds,
+        "lat_sheds": lat_sheds,
+        "lat_p99_ratio_vs_uncontended": (round(p99_ratio, 3)
+                                         if p99_ratio else None),
+        "lat_tail_gate": gate_pctl,
+        "lat_tail_ms": over_g,
+        "lat_tail_bound_ms": round(bound_ms, 2) if bound_ms else None,
+        "lat_tail_within_bound": bool(bound_ms is not None
+                                      and over_g <= bound_ms),
+    }
+
+    # -- invariants (smoke-gated) + checks ------------------------------
+    invariants = []
+    for name, arm in arms.items():
+        if arm["probes"] != args.probes:
+            invariants.append(f"{name}: lost TTFT probes")
+        if arm["long_prefills"] == 0 or arm["itl_samples"] == 0:
+            invariants.append(f"{name}: background long load never ran")
+    for phase_name, ph in (("uncontended", uncontended),
+                           ("mixed_2x", overload)):
+        acc = sum(ph[c]["completed"] + ph[c]["sheds"]
+                  for c in (SLO_LATENCY, SLO_THROUGHPUT)) + ph["errors"]
+        if acc != ph["offered"]:
+            invariants.append(f"{phase_name}: {acc} accounted != "
+                              f"{ph['offered']} offered")
+        if ph["errors"]:
+            invariants.append(f"{phase_name}: {ph['errors']} errors")
+    if uncontended[SLO_LATENCY]["sheds"]:
+        invariants.append("uncontended phase shed latency traffic")
+    result["invariants_failed"] = invariants
+
+    checks_ok = all(v for v in (
+        result["chunking_checks"]["ttft_improves_25pct"],
+        result["chunking_checks"]["itl_p99_within_1p1x"],
+        result["overload_checks"]["throughput_shed_first"],
+        result["overload_checks"]["lat_tail_within_bound"],
+    ))
+    ok = not invariants and checks_ok
+    if not args.smoke and ok:
+        Path(args.out).write_text(json.dumps(result, indent=1) + "\n")
+        log(f"wrote {args.out}")
+    print(json.dumps(result), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
