@@ -248,6 +248,16 @@ class App:
 
         def metrics_handler(req: Request, w: ResponseWriter) -> None:
             update_system_metrics(self.container.metrics)
+            # content negotiation (OpenMetrics spec): only an explicit
+            # Accept for application/openmetrics-text gets the exemplar-
+            # carrying OpenMetrics exposition; every other scraper keeps
+            # the Prometheus 0.0.4 text format byte-identically
+            accept = req.header("Accept") or ""
+            if "application/openmetrics-text" in accept:
+                w.set_header("Content-Type", "application/openmetrics-text; "
+                                             "version=1.0.0; charset=utf-8")
+                w.write(self.container.metrics.render_openmetrics().encode())
+                return
             w.set_header("Content-Type", "text/plain; version=0.0.4; charset=utf-8")
             w.write(self.container.metrics.render_prometheus().encode())
 

@@ -30,15 +30,21 @@ class Container:
         )
         self.metrics = gmetrics.Manager(logger=self.logger)
         gmetrics.register_framework_metrics(self.metrics)
-        self.tracer = tracing.tracer_from_config(self.config, self.app_name)
-        # Inference flight recorder + in-flight registry (observe/):
-        # always on, shared by HTTP middleware and the TPU datasource,
-        # rendered by the /debug pages on the metrics server.
-        from .observe import Observe
+        # tail-sampled when exporting (TPU_TRACE_SAMPLE); the metrics
+        # handle feeds app_tpu_spans_dropped_total from the bounded
+        # export buffer
+        self.tracer = tracing.tracer_from_config(self.config, self.app_name,
+                                                 metrics=self.metrics)
+        # Inference flight recorder + in-flight registry + serving
+        # timeline (observe/): always on, shared by HTTP middleware and
+        # the TPU datasource, rendered by the /debug pages on the
+        # metrics server.
+        from .observe import Observe, timeline_from_config
 
         self.observe = Observe(
             metrics=self.metrics, tracer=self.tracer,
-            max_events=self.config.get_int("DEBUG_EVENT_BUFFER", 2048))
+            max_events=self.config.get_int("DEBUG_EVENT_BUFFER", 2048),
+            timeline=timeline_from_config(self.config))
 
         # Datasources — wired from config, graceful degradation throughout
         self.redis = None

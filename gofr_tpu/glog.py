@@ -79,8 +79,9 @@ class Logger:
         self._lock = threading.Lock()
 
     # -- core ---------------------------------------------------------------
-    def _logf(self, level: LogLevel, *args: Any, fmt: str | None = None) -> None:
-        if level < self.level:
+    def _logf(self, level: LogLevel, *args: Any, fmt: str | None = None,
+              force: bool = False) -> None:
+        if level < self.level and not force:
             return
         stream = self.err if level >= LogLevel.ERROR else self.out
         now = time.time()
@@ -154,6 +155,23 @@ class Logger:
 
     def warnf(self, fmt: str, *args: Any) -> None:
         self._logf(LogLevel.WARN, *args, fmt=fmt)
+
+    def wide(self, fields: dict) -> None:
+        """Emit one canonical WIDE event: a single structured line
+        carrying everything worth knowing about one request (outcome,
+        slo_class, queue wait, chunk count, cache tier, tokens,
+        trace_id — see docs/advanced-guide/observability.md). The
+        contract is grep-ability: ``"event": "request"`` in JSON mode
+        (or ``event=request`` pretty) finds every request's one-line
+        summary, and the dict's insertion order is preserved so field
+        positions stay stable across lines.
+
+        BYPASSES the level gate: wide events are the per-request log
+        contract dashboards and scripts join on, and a deployment that
+        raises the level to WARN to cut diagnostic noise must not
+        silently lose every request record with it. The line still
+        labels itself INFO."""
+        self._logf(LogLevel.INFO, dict(fields), force=True)
 
     def error(self, *args: Any) -> None:
         self._logf(LogLevel.ERROR, *args)

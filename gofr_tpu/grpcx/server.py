@@ -25,7 +25,7 @@ import urllib.parse
 from . import http2 as h2
 from . import service as svc
 from .hpack import Decoder, Encoder, encode_stateless
-from .. import chaos, wire
+from .. import chaos, tracing, wire
 from ..resilience import (Deadline, deadline_scope, parse_slo_class,
                           slo_scope)
 from ..wire import Outbox
@@ -720,9 +720,15 @@ class GRPCServer:
         # without per-call plumbing, so expired work is dropped before
         # the device sees it and ``slo-class: throughput`` metadata
         # routes the request through the batch-traffic line
+        slo_class = parse_slo_class(metadata.get("slo-class"))
+        rpc_span = tracing.current_span()
+        if rpc_span is not None:
+            # the RPC root span carries the class so the tail sampler's
+            # per-class slow-tail p99 judges grpc traffic correctly
+            rpc_span.set_attribute("slo_class", slo_class)
         with deadline_scope(Deadline(deadline) if deadline is not None
                             else None), \
-                slo_scope(parse_slo_class(metadata.get("slo-class"))):
+                slo_scope(slo_class):
             if method.client_streaming:
                 # handler receives a lazy iterator over the request
                 # stream; it ends at the client's half-close

@@ -153,9 +153,17 @@ def slo_class_middleware(header: str = "X-SLO-Class") -> Middleware:
     queueing for fuller batches, shed/browned-out first under overload
     — while anything else (including no header) keeps the full
     latency-class SLO (docs/advanced-guide/serving-scheduler.md)."""
+    from .. import tracing
+
     def mw(next_h: Handler) -> Handler:
         def wrapped(req: Request, w: ResponseWriter) -> None:
-            with slo_scope(parse_slo_class(req.header(header))):
+            with slo_scope(parse_slo_class(req.header(header))) as cls:
+                span = tracing.current_span()
+                if span is not None:
+                    # the tail sampler's per-class slow-tail estimate
+                    # keys on the ROOT span's slo_class; tagging here
+                    # (inside the tracer middleware) puts it there
+                    span.set_attribute("slo_class", cls)
                 next_h(req, w)
         return wrapped
     return mw
@@ -205,6 +213,8 @@ def inflight_middleware(registry) -> Middleware:
 
 
 def metrics_middleware(metrics) -> Middleware:
+    from .. import tracing
+
     def mw(next_h: Handler) -> Handler:
         def wrapped(req: Request, w: ResponseWriter) -> None:
             start = time.monotonic()
@@ -215,8 +225,10 @@ def metrics_middleware(metrics) -> Middleware:
                 # (the reference gets this via mux route templates); unmatched
                 # requests share one fixed label for the same reason
                 path = getattr(req, "matched_route", None) or "unmatched"
+                span = tracing.current_span()
                 metrics.record_histogram(
                     "app_http_response", time.monotonic() - start,
+                    exemplar=span.trace_id if span is not None else None,
                     path=path, method=req.method, status=str(w.status),
                 )
         return wrapped

@@ -49,6 +49,12 @@ class _Metric:
     # (bucket_counts: list[int], total_sum: float, count: int).
     series: dict[tuple, object] = field(default_factory=dict)
     lock: threading.Lock = field(default_factory=threading.Lock)
+    # label-set key -> {bucket index -> (trace_id, value, unix_ts)}.
+    # The OpenMetrics trace<->metric join: each histogram bucket keeps
+    # its most recent exemplar (index len(buckets) = +Inf; -1 = the
+    # counter-sample exemplar). Rendered ONLY by render_openmetrics —
+    # the Prometheus 0.0.4 text format has no exemplar syntax.
+    exemplars: dict[tuple, dict[int, tuple]] = field(default_factory=dict)
 
 
 DEFAULT_HISTOGRAM_BUCKETS = (
@@ -121,12 +127,19 @@ class Manager:
                 {"event": "high metric label cardinality", "metric": m.name, "labels": len(labels)}
             )
 
-    def increment_counter(self, name: str, **labels: str) -> None:
+    def increment_counter(self, name: str, exemplar: str | None = None,
+                          **labels: str) -> None:
+        """``exemplar``: optional trace id attached to this series'
+        OpenMetrics ``_total`` sample (shed/error counters pass the
+        ambient span so a dashboard count links to an exact trace)."""
         m = self._get(name, "counter")
         self._check_cardinality(m, labels)
         key = _label_key(labels)
         with m.lock:
             m.series[key] = float(m.series.get(key, 0.0)) + 1.0
+            if exemplar:
+                m.exemplars.setdefault(key, {})[-1] = (
+                    str(exemplar), 1.0, time.time())
 
     def delta_updown_counter(self, name: str, delta: float, **labels: str) -> None:
         m = self._get(name, "updown")
@@ -134,7 +147,12 @@ class Manager:
         with m.lock:
             m.series[key] = float(m.series.get(key, 0.0)) + delta
 
-    def record_histogram(self, name: str, value: float, **labels: str) -> None:
+    def record_histogram(self, name: str, value: float,
+                         exemplar: str | None = None, **labels: str) -> None:
+        """``exemplar``: optional trace id for the bucket this value
+        lands in — the OpenMetrics bucket->trace link (a p99 TTFT
+        bucket resolves to the exact trace that put it there). Costs
+        one locked dict write, paid only when passed."""
         m = self._get(name, "histogram")
         key = _label_key(labels)
         entry = m.series.get(key)
@@ -144,6 +162,15 @@ class Manager:
                 if entry is None:
                     entry = _new_histogram_series(m.buckets)
                     m.series[key] = entry
+        if exemplar:
+            idx = len(m.buckets)
+            for i, b in enumerate(m.buckets):
+                if value <= b:
+                    idx = i
+                    break
+            with m.lock:
+                m.exemplars.setdefault(key, {})[idx] = (
+                    str(exemplar), float(value), time.time())
         if type(entry) is not list:  # native: wait-free, no Python lock
             entry.record(value)
             return
@@ -178,19 +205,7 @@ class Manager:
             for key, val in sorted(series.items()):
                 label_str = _fmt_labels(key)
                 if m.kind == "histogram":
-                    if type(val) is not list:  # native snapshot -> cumulative
-                        raw, total, count = val.snapshot()
-                        counts, cum = [], 0
-                        for c in raw[:-1]:
-                            cum += c
-                            counts.append(cum)
-                        # +Inf/_count from the SAME snapshot's buckets (incl.
-                        # overflow), not the independent count atomic: a
-                        # scrape racing record() must never show a le-bucket
-                        # above +Inf (Prometheus monotonicity).
-                        count = cum + raw[-1]
-                    else:
-                        counts, total, count = val  # type: ignore[misc]
+                    counts, total, count = _hist_snapshot(val)
                     cum = 0
                     for b, c in zip(m.buckets, counts):
                         cum = c
@@ -203,6 +218,96 @@ class Manager:
                 else:
                     lines.append(f"{m.name}{label_str} {val}")
         return "\n".join(lines) + "\n"
+
+    def render_openmetrics(self) -> str:
+        """Render all metrics in the OpenMetrics 1.0 text exposition,
+        with exemplars. Sample lines are identical to the Prometheus
+        renderer's except for the exemplar suffix on histogram bucket
+        (and counter ``_total``) lines; the additions are the metric-
+        family naming (a counter family drops its ``_total`` suffix on
+        the TYPE/HELP lines) and the mandatory ``# EOF`` terminator.
+        Served content-negotiated from ``/metrics`` — scrapers that do
+        not send ``Accept: application/openmetrics-text`` keep getting
+        the 0.0.4 text format byte-identically."""
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in sorted(metrics, key=lambda x: x.name):
+            if m.kind == "counter":
+                # OpenMetrics counters expose family X with sample
+                # X_total; a counter registered WITHOUT the suffix has
+                # no conformant counter rendering — expose it as
+                # `unknown` (bare samples allowed) instead of minting a
+                # renamed series dashboards have never seen
+                if m.name.endswith("_total"):
+                    family, ptype = m.name[: -len("_total")], "counter"
+                else:
+                    family, ptype = m.name, "unknown"
+            elif m.kind == "histogram":
+                family, ptype = m.name, "histogram"
+            else:
+                family, ptype = m.name, "gauge"
+            if m.desc:
+                lines.append(f"# HELP {family} {m.desc}")
+            lines.append(f"# TYPE {family} {ptype}")
+            with m.lock:
+                series = dict(m.series)
+                exemplars = {k: dict(v) for k, v in m.exemplars.items()}
+            for key, val in sorted(series.items()):
+                label_str = _fmt_labels(key)
+                ex = exemplars.get(key, {})
+                if m.kind == "histogram":
+                    counts, total, count = _hist_snapshot(val)
+                    cum = 0
+                    for i, (b, c) in enumerate(zip(m.buckets, counts)):
+                        cum = c
+                        lines.append(
+                            f'{m.name}_bucket{_fmt_labels(key, extra=("le", _fmt_float(b)))} {cum}'
+                            + _fmt_exemplar(ex.get(i)))
+                    lines.append(
+                        f'{m.name}_bucket{_fmt_labels(key, extra=("le", "+Inf"))} {count}'
+                        + _fmt_exemplar(ex.get(len(m.buckets))))
+                    # exemplars attach to bucket lines ONLY: _sum/_count
+                    # (and every non-counter sample) stay bare per spec
+                    lines.append(f"{m.name}_sum{label_str} {total}")
+                    lines.append(f"{m.name}_count{label_str} {count}")
+                elif m.kind == "counter" and m.name.endswith("_total"):
+                    lines.append(f"{m.name}{label_str} {val}"
+                                 + _fmt_exemplar(ex.get(-1)))
+                else:
+                    lines.append(f"{m.name}{label_str} {val}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+
+def _hist_snapshot(val) -> tuple[list, float, int]:
+    """One histogram series -> (cumulative bucket counts, sum, count),
+    shared by both exposition renderers so they can never disagree."""
+    if type(val) is not list:  # native snapshot -> cumulative
+        raw, total, count = val.snapshot()
+        counts, cum = [], 0
+        for c in raw[:-1]:
+            cum += c
+            counts.append(cum)
+        # +Inf/_count from the SAME snapshot's buckets (incl.
+        # overflow), not the independent count atomic: a
+        # scrape racing record() must never show a le-bucket
+        # above +Inf (Prometheus monotonicity).
+        count = cum + raw[-1]
+    else:
+        counts, total, count = val
+    return counts, total, count
+
+
+def _fmt_exemplar(ex: tuple | None) -> str:
+    """OpenMetrics exemplar suffix: `` # {trace_id="…"} value ts``.
+    Empty when the bucket has never seen an exemplar."""
+    if not ex:
+        return ""
+    trace_id, value, ts = ex
+    tid = str(trace_id).replace(chr(92), chr(92) * 2).replace(
+        chr(34), chr(92) + chr(34))
+    return f' # {{trace_id="{tid}"}} {_fmt_float(value)} {ts:.3f}'
 
 
 def _fmt_float(v: float) -> str:
@@ -334,6 +439,14 @@ def register_framework_metrics(m: Manager) -> None:
                   "the brownout band")
     m.new_gauge("app_tpu_brownout_active",
                 "1 while the admission gate's brownout band is engaged")
+
+    # tracing export health (tracing.ZipkinExporter): spans dropped
+    # because the pending buffer hit its bound while the collector was
+    # down/stalled — fail-open export must cost bounded memory, and
+    # this counter is how a silent collector outage stays visible
+    m.new_counter("app_tpu_spans_dropped_total",
+                  "finished spans dropped by the bounded trace-export "
+                  "buffer (collector down or stalled)")
 
     # serving-path telemetry (gofr_tpu/observe: the inference flight
     # recorder's metric face)

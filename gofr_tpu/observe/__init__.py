@@ -13,26 +13,37 @@ from __future__ import annotations
 from .profiler import collect_profile, render_collapsed, sample_once
 from .recorder import FlightRecorder
 from .registry import InflightRequest, RequestRegistry
+from .timeline import Timeline, _enabled_from_env, timeline_from_config
 
 __all__ = [
     "Observe",
     "FlightRecorder",
     "InflightRequest",
     "RequestRegistry",
+    "Timeline",
     "collect_profile",
     "render_collapsed",
     "sample_once",
+    "timeline_from_config",
 ]
 
 
 class Observe:
     """The container's observability bundle: request registry + flight
-    recorder + the tracer the serving stack emits stage spans through.
-    Always constructed (the recorder is bounded and the registry is
-    O(active requests)) — observability is not opt-in."""
+    recorder + serving timeline + the tracer the serving stack emits
+    stage spans through. Always constructed (the recorder and timeline
+    are bounded rings and the registry is O(active requests)) —
+    observability is not opt-in."""
 
-    def __init__(self, metrics=None, tracer=None, max_events: int = 2048):
+    def __init__(self, metrics=None, tracer=None, max_events: int = 2048,
+                 timeline: "Timeline | None" = None):
         self.requests = RequestRegistry()
         self.recorder = FlightRecorder(capacity=max_events)
         self.metrics = metrics
         self.tracer = tracer
+        # serving timeline (timeline.py): defaults honor the
+        # TPU_TIMELINE / TPU_TIMELINE_EVENTS process environment so
+        # engine-level constructions (tests, benches) behave like the
+        # container wiring, which passes timeline_from_config(config)
+        self.timeline = timeline if timeline is not None else Timeline(
+            enabled=_enabled_from_env())

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import html
 import json
+import math
 import sys
 import threading
 
@@ -122,6 +123,9 @@ def install_debug_routes(router, app) -> None:
             " — in-flight requests</li>"
             '<li><a href="/debug/events">/debug/events</a>'
             " — flight recorder</li>"
+            '<li><a href="/debug/timeline?last_ms=2000">'
+            "/debug/timeline</a> — serving timeline "
+            "(Chrome-trace JSON; load in Perfetto)</li>"
             '<li><a href="/debug/vars">/debug/vars</a>'
             " — config, topology, engine state</li>"
             '<li><a href="/debug/cache">/debug/cache</a>'
@@ -165,7 +169,51 @@ def install_debug_routes(router, app) -> None:
         events = observe.recorder.events(
             limit=limit, event=req.param("event") or None,
             request_id=request_id)
-        _json(w, {"events": events, **observe.recorder.stats()})
+        if req.param("format") != "html":
+            return _json(w, {"events": events, **observe.recorder.stats()})
+        # HTML view: seq + trace_id columns up front so recorder rows
+        # join by eye against exported traces and the wide events
+        head = ("seq", "ts", "event", "request_id", "trace_id", "fields")
+        rows = "".join(
+            "<tr><td>{seq}</td><td>{ts:.3f}</td><td>{ev}</td>"
+            "<td>{rid}</td><td>{tid}</td><td>{rest}</td></tr>".format(
+                seq=e["seq"], ts=e["ts"], ev=html.escape(e["event"]),
+                rid=e.get("request_id", "-"),
+                tid=html.escape(str(e.get("trace_id", "-"))),
+                rest=html.escape(json.dumps(
+                    {k: v for k, v in e.items()
+                     if k not in ("seq", "ts", "event", "request_id",
+                                  "trace_id")}, default=str)))
+            for e in events)
+        _html(w, "flight recorder", (
+            f"<h2>{len(events)} event(s)</h2>"
+            "<table><tr>" + "".join(f"<th>{c}</th>" for c in head)
+            + "</tr>" + rows + "</table>"
+            '<p><a href="/debug/events">json</a></p>'))
+
+    def timeline_page(req, w) -> None:
+        """The serving timeline as Chrome-trace JSON (Perfetto /
+        chrome://tracing load it directly). ``?last_ms=N`` restricts to
+        the trailing window; ``?format=stats`` returns ring state
+        only."""
+        tl = getattr(observe, "timeline", None)
+        if tl is None:
+            return _json(w, {"enabled": False})
+        if req.param("format") == "stats":
+            return _json(w, tl.stats())
+        last_ms = None
+        if req.param("last_ms"):
+            try:
+                last_ms = float(req.param("last_ms"))
+            except ValueError:
+                last_ms = float("nan")
+            if not math.isfinite(last_ms) or last_ms < 0:
+                # float() happily parses "nan"/"inf", which would make
+                # every window comparison False and return an empty
+                # trace instead of the 400 this branch exists for
+                return _json(w, {"error": "last_ms must be a "
+                                          "non-negative finite number"}, 400)
+        _json(w, tl.chrome_trace(last_ms=last_ms))
 
     def vars_page(req, w) -> None:
         payload: dict = {
@@ -182,6 +230,9 @@ def install_debug_routes(router, app) -> None:
             "inflight": len(observe.requests),
             "recorder": observe.recorder.stats(),
         }
+        tl = getattr(observe, "timeline", None)
+        if tl is not None:
+            payload["timeline"] = tl.stats()
         # per-subsystem declared device bytes (hbm accounting — the
         # same figures the app_tpu_device_bytes gauges export). Module
         # looked up, not imported: an app with no TPU configured must
@@ -268,6 +319,7 @@ def install_debug_routes(router, app) -> None:
     router.add("GET", "/debug", index)
     router.add("GET", "/debug/requests", requests_page)
     router.add("GET", "/debug/events", events_page)
+    router.add("GET", "/debug/timeline", timeline_page)
     router.add("GET", "/debug/vars", vars_page)
     router.add("GET", "/debug/cache", cache_page)
     router.add("GET", "/debug/pprof/profile", profile_page)

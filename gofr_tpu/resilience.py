@@ -313,9 +313,14 @@ class AdmissionGate:
         retry_after = max(0.05, wait)
         now = time.monotonic()
         if self.metrics is not None:
+            from . import tracing
+
+            span = tracing.current_span()  # the shed caller's request
             try:
                 self.metrics.increment_counter(
-                    "app_tpu_shed_total", program=program or self.name,
+                    "app_tpu_shed_total",
+                    exemplar=span.trace_id if span is not None else None,
+                    program=program or self.name,
                     slo_class=slo_class)
             except Exception:
                 pass

@@ -111,13 +111,18 @@ class CoalescingBatcher:
                  use_native: bool = True,
                  on_queue_depth: Callable[[int], None] | None = None,
                  on_expired: Callable[[int], None] | None = None,
-                 class_policy: ClassPolicy | None = None):
+                 class_policy: ClassPolicy | None = None,
+                 timeline=None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.runner = runner
         self.max_batch = max_batch
         self.max_delay = max_delay
         self.name = name
+        # serving timeline (observe/timeline.py): expiry decisions
+        # land as scheduler instants so a Perfetto window shows WHY a
+        # queued item never dispatched (None = emission off)
+        self._timeline = timeline
         # SLO-class scheduling: a second wait line for throughput-class
         # items with its own (longer) delay bound and a reserved pickup
         # share. The native queue is FIFO and class-blind, so a policy
@@ -266,7 +271,14 @@ class CoalescingBatcher:
         return err
 
     def _count_expired(self, n: int) -> None:
-        if self.on_expired is not None and n > 0:
+        if n <= 0:
+            return
+        if self._timeline is not None:
+            try:
+                self._timeline.expired(self.name, count=n)
+            except Exception:
+                pass  # telemetry must never take the batcher down
+        if self.on_expired is not None:
             try:
                 self.on_expired(n)
             except Exception:

@@ -87,6 +87,11 @@ class TPUEngine:
         self.logger = logger
         self.metrics = metrics
         self.observe = observe  # Observe bundle (registry + flight recorder)
+        # serving timeline (observe/timeline.py): None when emission is
+        # off so hot paths pay one attribute test (see generator)
+        tl = getattr(observe, "timeline", None) if observe is not None \
+            else None
+        self._tl = tl if (tl is not None and tl.enabled) else None
         # resilience.AdmissionGate TEMPLATE (None = admit everything):
         # each program gets its own clone (one gate per queue — a shared
         # wait EWMA would let a backlogged program shed a healthy one's
@@ -137,7 +142,7 @@ class TPUEngine:
                 name=f"tpu-{name}", on_dispatch=self._dispatch_metrics(prog),
                 on_queue_depth=self._depth_gauge(name),
                 on_expired=self._expired_counter(name),
-                class_policy=self.class_policy)
+                class_policy=self.class_policy, timeline=self._tl)
         if self.logger is not None:
             self.logger.info({"event": "tpu program registered", "program": name,
                               "kind": kind, "batch_buckets": list(prog.batch_buckets)})
@@ -198,6 +203,8 @@ class TPUEngine:
             out = self._run_tokens(prog, items)
         else:
             out = self._run_fixed(prog, items)
+        if self._tl is not None:
+            self._tl.predict(t0, time.monotonic(), prog.name, len(items))
         if self.metrics is not None:
             self.metrics.record_histogram("app_tpu_device_execute_duration",
                                           time.monotonic() - t0, program=prog.name)
@@ -265,19 +272,24 @@ class TPUEngine:
             raise DeadlineExceeded(
                 f"deadline expired before predict({program!r}) was queued")
         gate = self._gate_for(program)
+        from .. import tracing
+
+        span = tracing.current_span()
+        trace_id = span.trace_id if span else ""
         if gate is not None:
-            gate.admit(batcher.queue_depth(), program=program,
-                       slo_class=slo_class)
+            try:
+                gate.admit(batcher.queue_depth(), program=program,
+                           slo_class=slo_class)
+            except BaseException:
+                if self._tl is not None:
+                    self._tl.shed(program, slo_class, trace_id)
+                raise
         self._validate_item(self._programs[program], item)
         t0 = time.monotonic()
         entry = None
         if self.observe is not None:
-            from .. import tracing
-
-            span = tracing.current_span()
             entry = self.observe.requests.add(
-                "predict", program, span.trace_id if span else "",
-                stage="batch-wait")
+                "predict", program, trace_id, stage="batch-wait")
         failed = None
         try:
             return batcher.submit(item, timeout=timeout, deadline=deadline,
@@ -301,7 +313,8 @@ class TPUEngine:
                 self.metrics.increment_counter("app_tpu_requests_total",
                                                program=program)
                 self.metrics.record_histogram("app_tpu_predict_duration",
-                                              dur, program=program)
+                                              dur, exemplar=trace_id or None,
+                                              program=program)
 
     def predict_batch(self, program: str, items: list) -> list:
         """Direct batched execution, bypassing the coalescing queue (for

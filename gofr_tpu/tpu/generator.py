@@ -232,6 +232,13 @@ class GenStream(PushStream):
         self.trace_id: str = ""
         self.obs_entry = None
         self.failed: str | None = None  # set by the loop's error handler
+        # canonical-wide-event state (docs/advanced-guide/
+        # observability.md "wide events"): accumulated by the serving
+        # loop, emitted once at the stream's terminal outcome
+        self.slo_class: str = SLO_LATENCY
+        self.chunks = 0             # mid-chunk dispatches of this prefill
+        self.cache_tier: str | None = None  # kvcache tier that served it
+        self.cache_tokens = 0       # prompt positions the tier covered
 
     def tokens(self) -> list[int]:
         """Drain the whole stream (blocking) into a list of ids
@@ -459,6 +466,16 @@ class GenerationEngine:
         self.gate = gate
         # flight recorder + in-flight registry + stage spans (observe/)
         self._observe = observe
+        # serving timeline (observe/timeline.py): hot paths hold None
+        # when emission is off (TPU_TIMELINE=0) so the disabled cost is
+        # one attribute test, not a method call into a dead ring
+        tl = getattr(observe, "timeline", None) if observe is not None \
+            else None
+        self._tl = tl if (tl is not None and tl.enabled) else None
+        if self._tl is not None:
+            # device-byte accounting changes land HBM counter samples
+            # on the exported Perfetto trace (one track per subsystem)
+            hbm.set_timeline(self._tl)
         self.mesh = mesh
         self.rope_tables = llama.get_rope_tables(cfg, self.max_seq)
 
@@ -1024,12 +1041,19 @@ class GenerationEngine:
         elif slo_class not in (SLO_LATENCY, SLO_THROUGHPUT):
             raise GenerationError(f"unknown slo_class {slo_class!r}")
         if deadline is not None and deadline.expired():
-            self._count_expired()
+            self._count_expired(where="pre-queue")
             raise DeadlineExceeded("deadline expired before generate() "
                                    "was queued")
         if self.gate is not None:
-            self.gate.admit(self._pending.qsize(), program="generate",
-                            slo_class=slo_class)
+            try:
+                self.gate.admit(self._pending.qsize(), program="generate",
+                                slo_class=slo_class)
+            except BaseException:
+                # shed: the request dies HERE, before a stream exists —
+                # its canonical wide event and timeline marker are the
+                # only record that it ever arrived
+                self._wide_shed(slo_class)
+                raise
             max_new_tokens = self.gate.cap_tokens(max_new_tokens,
                                                   slo_class=slo_class)
         if eos_id is not None and not isinstance(eos_id, (int, np.integer)):
@@ -1044,6 +1068,7 @@ class GenerationEngine:
         stream = GenStream(next(_REQ_IDS), self, logprobs=logprobs)
         stream.trace["submit"] = time.monotonic()
         stream.prompt_len = len(prompt)
+        stream.slo_class = slo_class
         if len(prompt) == 0:
             stream._q.put(GenerationError("empty prompt"))
             stream._q.put(None)
@@ -1528,7 +1553,8 @@ class GenerationEngine:
                 if req.deadline is not None and req.deadline.expired():
                     # the caller's wire deadline ran out while queued:
                     # fail fast, never dispatch its prefill
-                    self._count_expired()
+                    self._count_expired(where="queue",
+                                        request_id=req.stream.request_id)
                     wait_s = time.monotonic() - req.enqueued_at
                     req.stream._q.put(DeadlineExceeded(
                         f"deadline expired after {wait_s:.3f}s in the "
@@ -1678,7 +1704,8 @@ class GenerationEngine:
         return L - pad_bucket(rem, self.prompt_buckets) >= 0
 
     def _chunk_lattice(self, attr: str, slot: int, req: _Request,
-                       pos: int = 0) -> tuple[int, float]:
+                       pos: int = 0,
+                       track_slot: int | None = None) -> tuple[int, float]:
         """Run the chunked-prefill lattice for ``req.prompt[pos:]``
         against the cache at ``getattr(self, attr)`` ("cache" for the
         contiguous engine, "_scratch" for paged long-prompt admission),
@@ -1694,9 +1721,12 @@ class GenerationEngine:
         chunk's sampled (token, logprob) — or (0, 0.0) when the
         request was cancelled or deadline-expired mid-lattice (the
         token is discarded anyway: _deliver retires cancelled slots
-        before use)."""
+        before use). ``track_slot``: the serving slot the timeline
+        renders these chunk slices on (paged admissions dispatch
+        against scratch row 0 but serve slot ``idx``)."""
         L = len(req.prompt)
         T = self._chunk
+        tslot = slot if track_slot is None else track_slot
         while L - pos > T:
             if req.stream.cancelled.is_set():
                 return 0, 0.0
@@ -1704,6 +1734,7 @@ class GenerationEngine:
                 return 0, 0.0
             chaos.fire(chaos.GENERATOR_CHUNK)
             chunk = req.prompt[pos:pos + T]
+            t0c = time.monotonic() if self._tl is not None else 0.0
             setattr(self, attr, self._chunk_mid_jit(
                 getattr(self, attr), self.params,
                 jnp.asarray(chunk[None, :]), jnp.int32(pos),
@@ -1711,6 +1742,14 @@ class GenerationEngine:
                 jnp.float32(0.0), jnp.int32(0), self._key,
                 self._adapter1(req)))
             pos += T
+            req.stream.chunks += 1
+            if self._tl is not None:
+                # host dispatch slice (the device work runs async
+                # behind it); index + length make the lattice's shape
+                # readable on the slot's track
+                self._tl.chunk(t0c, time.monotonic(), tslot,
+                               req.stream.chunks - 1, T,
+                               req.stream.request_id)
             if self.metrics is not None:
                 self.metrics.increment_counter("app_tpu_prefill_chunks_total")
             if not self._chunk_interleave:
@@ -1752,7 +1791,8 @@ class GenerationEngine:
         release at _deliver/_retire) cleans the slot up."""
         if req.deadline is None or not req.deadline.expired():
             return False
-        self._count_expired()
+        self._count_expired(where="mid-prefill",
+                            request_id=req.stream.request_id)
         req.stream.failed = "deadline expired mid-prefill"
         req.stream._q.put(DeadlineExceeded(
             f"deadline expired after {pos}/{len(req.prompt)} prompt "
@@ -1816,7 +1856,14 @@ class GenerationEngine:
             self._scratch = self._blocks_to_row_jit(
                 self._scratch, self.cache,
                 jnp.asarray(read_blocks, jnp.int32))
-        tok, lp = self._chunk_lattice("_scratch", 0, req, pos=m)
+            # zero-copy block-share hit: the wide event and timeline
+            # call it tier "paged" (the paged engine has no t0/t1/t2)
+            req.stream.cache_tier = "paged"
+            req.stream.cache_tokens = m
+            if self._tl is not None:
+                self._tl.kvcache("paged", m, idx)
+        tok, lp = self._chunk_lattice("_scratch", 0, req, pos=m,
+                                      track_slot=idx)
         if req.stream.cancelled.is_set():
             return tok, lp  # slot retires at _deliver; blocks free there
         # write back only the FRESH region: scratch rows for the shared
@@ -2005,6 +2052,10 @@ class GenerationEngine:
                                          jnp.int32(idx), jnp.int32(row))
         restore_s = time.monotonic() - t_start
         self._kvc.accept(mt, restore_s)
+        req.stream.cache_tier = mt.tier
+        req.stream.cache_tokens = m_eff
+        if self._tl is not None:
+            self._tl.kvcache(mt.tier, m_eff, idx)
         self._obs_span("tpu.prefix-restore", t_start, t_start + restore_s,
                        req.stream, {"tier": mt.tier, "tokens": m_eff,
                                     "slot": idx})
@@ -2055,7 +2106,10 @@ class GenerationEngine:
                                        self._kv_row_get(self.cache, idx,
                                                         want))
 
-    def _count_expired(self) -> None:
+    def _count_expired(self, where: str = "queue",
+                       request_id=None) -> None:
+        if self._tl is not None:
+            self._tl.expired(where, request_id)
         if self.metrics is not None:
             try:
                 self.metrics.increment_counter(
@@ -2065,13 +2119,87 @@ class GenerationEngine:
 
     # -- flight-recorder plumbing (all no-ops without an Observe bundle) -----
     def _obs_end(self, stream: GenStream, event: str, **fields) -> None:
-        """Remove the request's registry entry and record its terminal
-        lifecycle event (finished/failed/cancelled)."""
-        if self._observe is None:
-            return
-        self._observe.requests.remove(stream.obs_entry)
-        self._observe.recorder.record(event, request_id=stream.request_id,
-                                      trace_id=stream.trace_id, **fields)
+        """Remove the request's registry entry, record its terminal
+        lifecycle event (finished/failed/cancelled), and emit the
+        request's canonical WIDE event."""
+        if self._observe is not None:
+            self._observe.requests.remove(stream.obs_entry)
+            self._observe.recorder.record(event, request_id=stream.request_id,
+                                          trace_id=stream.trace_id, **fields)
+        self._wide_event(stream, event, fields)
+
+    def _wide_fields(self, outcome: str, trace_id: str,
+                     slo_class: str) -> dict:
+        """The canonical wide-event skeleton: key order is part of the
+        contract (one grep on ``"event": "request"`` reconstructs any
+        request; dashboards and scripts rely on stable field names)."""
+        return {"event": "request", "outcome": outcome,
+                "trace_id": trace_id, "slo_class": slo_class}
+
+    def _wide_event(self, stream: GenStream, outcome: str,
+                    fields: dict) -> None:
+        """One structured event per request at its terminal outcome —
+        slo class, queue wait, chunk count, cache tier, tokens, trace
+        id — through glog (grep the logs) AND the flight recorder
+        (/debug/events survives log rotation)."""
+        trace = stream.trace
+        submit = trace.get("submit")
+        admit = trace.get("admit")
+        now = time.monotonic()
+        wide = self._wide_fields(outcome, stream.trace_id, stream.slo_class)
+        wide.update({
+            "request_id": stream.request_id,
+            "prompt_len": stream.prompt_len,
+            "tokens": fields.get("tokens", 0),
+            "queue_wait_s": (round(admit - submit, 6)
+                             if admit is not None and submit is not None
+                             else None),
+            "duration_s": fields.get(
+                "duration_s",
+                round(now - submit, 6) if submit is not None else None),
+            "chunks": stream.chunks,
+            "cache_tier": stream.cache_tier,
+            "cache_tokens": stream.cache_tokens,
+        })
+        if "error" in fields:
+            wide["error"] = fields["error"]
+        if self._observe is not None:
+            self._observe.recorder.record(
+                "request", request_id=stream.request_id,
+                trace_id=stream.trace_id,
+                **{k: v for k, v in wide.items()
+                   if k not in ("event", "request_id", "trace_id")})
+        if self.logger is not None:
+            try:
+                self.logger.wide(wide)
+            except Exception:
+                pass  # telemetry must never take the serving loop down
+
+    def _wide_shed(self, slo_class: str) -> None:
+        """Wide event + timeline marker for a request shed at the gate
+        (no stream exists yet; the ambient span is the only trace
+        context the request ever had)."""
+        trace_id = ""
+        if self._observe is not None:
+            from .. import tracing
+
+            span = tracing.current_span()
+            if span is not None:
+                trace_id = span.trace_id
+        if self._tl is not None:
+            self._tl.shed("generate", slo_class, trace_id)
+        wide = self._wide_fields("shed", trace_id, slo_class)
+        wide["sheds"] = 1
+        if self._observe is not None:
+            self._observe.recorder.record(
+                "request", trace_id=trace_id,
+                **{k: v for k, v in wide.items()
+                   if k not in ("event", "trace_id")})
+        if self.logger is not None:
+            try:
+                self.logger.wide(wide)
+            except Exception:
+                pass
 
     def _obs_stage(self, stream: GenStream, stage: str) -> None:
         if stream.obs_entry is not None:
@@ -2101,9 +2229,14 @@ class GenerationEngine:
         if self.metrics is None or n <= 0 or slot.last_token_t == 0.0:
             return
         gap = (time.monotonic() - slot.last_token_t) / n
-        for _ in range(n):
+        # one exemplar per reap (first sample): n identical samples
+        # land in one bucket, and the OpenMetrics join only needs one
+        # trace id per bucket update
+        tid = slot.request.stream.trace_id or None if slot.request else None
+        for i in range(n):
             self.metrics.record_histogram("app_tpu_inter_token_duration",
-                                          gap, program="generate")
+                                          gap, exemplar=tid if i == 0 else None,
+                                          program="generate")
 
     def _obs_gauges(self) -> None:
         """Refresh the live-load gauges after admission/retirement."""
@@ -2128,6 +2261,9 @@ class GenerationEngine:
         req.stream.trace["admit"] = t0
         if self.gate is not None:
             self.gate.note_wait(t0 - req.enqueued_at)
+        if self._tl is not None:
+            self._tl.admit(idx, req.slo_class, t0 - req.enqueued_at,
+                           req.stream.request_id, req.stream.trace_id)
         self._obs_stage(req.stream, "prefill")
         if self._observe is not None:
             self._observe.recorder.record(
@@ -2178,6 +2314,9 @@ class GenerationEngine:
             raise
         prefill_done = time.monotonic()
         req.stream.trace["prefill_done"] = prefill_done
+        if self._tl is not None:
+            self._tl.prefill(t0, prefill_done, idx, len(req.prompt),
+                             req.stream.request_id, req.stream.trace_id)
         self._obs_span("tpu.admit-wait", req.enqueued_at, t0, req.stream,
                        {"slot": idx, "slo_class": req.slo_class})
         self._obs_span("tpu.prefill", t0, prefill_done, req.stream,
@@ -2219,7 +2358,11 @@ class GenerationEngine:
             req.stream.trace["first_put"] = now
             ttft = now - req.stream.trace["submit"]
             if self.metrics is not None:
+                # the exemplar makes a dashboard's p99 TTFT bucket
+                # resolve to the exact trace that populated it
                 self.metrics.record_histogram("app_tpu_ttft_duration", ttft,
+                                              exemplar=req.stream.trace_id
+                                              or None,
                                               program="generate",
                                               slo_class=req.slo_class)
             self._obs_stage(req.stream, "decode")
@@ -2550,13 +2693,20 @@ class GenerationEngine:
         snap_active = self._active.copy()
         snap_reqs = [s.request for s in self._slots]
         return _Inflight((toks, lps, emit), functools.partial(
-            self._verify_reap, toks, lps, emit, snap_active, snap_reqs))
+            self._verify_reap, toks, lps, emit, snap_active, snap_reqs,
+            time.monotonic()))
 
     # invoked through _Inflight.reap, always under the engine's device
     # lock (see _loop) — the partial hides that from static call-graph
     # inference  # gl: holds self._device_lock
-    def _verify_reap(self, toks, lps, emit, snap_active, snap_reqs) -> None:
+    def _verify_reap(self, toks, lps, emit, snap_active, snap_reqs,
+                     t0: float = 0.0) -> None:
         toks_np, lps_np, emit_np = jax.device_get((toks, lps, emit))
+        if self._tl is not None:
+            self._tl.verify_block(
+                t0, time.monotonic(),
+                tuple(int(i) for i in np.flatnonzero(snap_active)),
+                self._spec_k + 1)
         self._spec_windows += int(snap_active.sum())
         self._spec_emitted += int(emit_np.sum())
         emit_l = emit_np.tolist()
@@ -2623,12 +2773,21 @@ class GenerationEngine:
         snap_active = self._active.copy()
         snap_reqs = [s.request for s in self._slots]
         return _Inflight((toks, lps), functools.partial(
-            self._decode_reap, toks, lps, snap_active, snap_reqs))
+            self._decode_reap, toks, lps, snap_active, snap_reqs,
+            time.monotonic()))
 
     # invoked through _Inflight.reap, always under the engine's device
     # lock (see _loop)  # gl: holds self._device_lock
-    def _decode_reap(self, toks, lps, snap_active, snap_reqs) -> None:
+    def _decode_reap(self, toks, lps, snap_active, snap_reqs,
+                     t0: float = 0.0) -> None:
         toks_np, lps_np = jax.device_get((toks, lps))  # [K, B] each
+        if self._tl is not None:
+            # one ring event per fused block, fanned out to per-slot
+            # slices only at export time — the hot path pays one append
+            self._tl.decode_block(
+                t0, time.monotonic(),
+                tuple(int(i) for i in np.flatnonzero(snap_active)),
+                self.decode_block)
         if self.metrics is not None:
             self.metrics.set_gauge("app_tpu_batch_fill",
                                    float(self._active.sum()) / self.n_slots,

@@ -50,7 +50,7 @@ import weakref
 from typing import Any
 
 __all__ = ["account", "release", "live_bytes", "set_metrics",
-           "tree_nbytes", "reset", "snapshot"]
+           "set_timeline", "tree_nbytes", "reset", "snapshot"]
 
 GAUGE = "app_tpu_device_bytes"
 
@@ -75,6 +75,10 @@ class _Registry:
         # B registered later (two engines, two Managers — both see the
         # same process-truth figures)
         self._sinks: "weakref.WeakSet[Any]" = weakref.WeakSet()
+        # serving timelines (observe/timeline.py), same weak fan-out:
+        # every accounting change lands a counter sample so the
+        # exported Perfetto trace carries an HBM track per subsystem
+        self._timelines: "weakref.WeakSet[Any]" = weakref.WeakSet()
 
     def account(self, subsystem: str, tree: Any, *, owner: Any = None,
                 tag: str = "") -> Any:
@@ -162,9 +166,24 @@ class _Registry:
         for sub in subs:
             self._push(sub)
 
+    def set_timeline(self, timeline: Any) -> None:
+        """Attach a serving timeline (weakly held) that receives an
+        ``hbm`` counter sample on every accounting change. ``None``
+        detaches all timelines."""
+        if timeline is None:
+            self._timelines.clear()
+            return
+        self._timelines.add(timeline)
+        for sub, n in self.live_bytes().items():
+            try:
+                timeline.hbm(sub, float(n))
+            except Exception:
+                pass
+
     def _push(self, subsystem: str) -> None:
         sinks = list(self._sinks)
-        if not sinks:
+        timelines = list(self._timelines)
+        if not sinks and not timelines:
             return
         value = float(self.live_bytes().get(subsystem, 0))
         for m in sinks:
@@ -172,6 +191,11 @@ class _Registry:
                 m.set_gauge(GAUGE, value, subsystem=subsystem)
             except Exception:
                 pass  # accounting must never take the serving path down
+        for tl in timelines:
+            try:
+                tl.hbm(subsystem, value)
+            except Exception:
+                pass
 
 
 _registry = _Registry()
@@ -181,4 +205,5 @@ release = _registry.release
 live_bytes = _registry.live_bytes
 snapshot = _registry.snapshot
 set_metrics = _registry.set_metrics
+set_timeline = _registry.set_timeline
 reset = _registry.reset

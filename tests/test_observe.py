@@ -199,6 +199,146 @@ def test_debug_cache_without_engine_reports_disabled(app):
     assert payload == {"enabled": False, "cache": None}
 
 
+# -- OpenMetrics exposition conformance -------------------------------------
+
+def _manager_with_samples(with_exemplars):
+    from gofr_tpu.metrics import Manager
+
+    m = Manager()
+    m.new_histogram("lat", "latency", buckets=[0.1, 1.0, 10.0])
+    m.new_counter("hits_total", "hits")
+    m.new_gauge("depth", "queue depth")
+    tid = "ab" * 16
+    m.record_histogram("lat", 0.05, exemplar=tid if with_exemplars else None,
+                       route="/a")
+    m.record_histogram("lat", 50.0, exemplar=tid if with_exemplars else None,
+                       route="/a")
+    m.increment_counter("hits_total",
+                        exemplar=tid if with_exemplars else None)
+    m.set_gauge("depth", 3.0)
+    return m
+
+
+def test_openmetrics_exemplars_only_on_bucket_and_total_lines():
+    m = _manager_with_samples(with_exemplars=True)
+    text = m.render_openmetrics()
+    with_ex = [l for l in text.splitlines() if " # {" in l]
+    # exemplars land exactly where the spec allows: histogram bucket
+    # lines and the counter _total sample — never _sum/_count/gauges
+    assert with_ex, "no exemplar rendered"
+    for line in with_ex:
+        assert line.startswith("lat_bucket") or line.startswith("hits_total")
+    assert not any(l.startswith(("lat_sum", "lat_count", "depth")) and "#" in l
+                   for l in text.splitlines() if not l.startswith("# "))
+    # the exemplar carries the trace id, value, and a timestamp
+    bucket_line = next(l for l in with_ex if l.startswith('lat_bucket'))
+    assert '# {trace_id="' + "ab" * 16 + '"}' in bucket_line
+    # the 0.05 exemplar sits on the le="0.1" bucket, the 50.0 one on +Inf
+    assert any('le="0.1"' in l and "0.05" in l for l in with_ex)
+    assert any('le="+Inf"' in l and "50" in l for l in with_ex)
+
+
+def test_openmetrics_terminates_with_eof_and_names_counter_family():
+    m = _manager_with_samples(with_exemplars=False)
+    text = m.render_openmetrics()
+    assert text.endswith("# EOF\n")
+    assert text.count("# EOF") == 1
+    # counter family drops _total on TYPE/HELP; samples keep it
+    assert "# TYPE hits counter" in text
+    assert "hits_total 1.0" in text
+    assert "# TYPE lat histogram" in text
+    assert "# TYPE depth gauge" in text
+
+
+def test_openmetrics_label_escaping_roundtrip():
+    import re
+
+    from gofr_tpu.metrics import Manager
+
+    m = Manager()
+    m.new_counter("esc_total")
+    tricky = 'a\\b"c\\\\d'
+    m.increment_counter("esc_total", path=tricky, exemplar='t"\\id')
+    text = m.render_openmetrics()
+    line = next(l for l in text.splitlines() if l.startswith("esc_total{"))
+    sample = line.split(" # ")[0]
+    match = re.fullmatch(r'esc_total\{path="((?:[^"\\]|\\.)*)"\} 1\.0',
+                         sample)
+    assert match, f"malformed exposition line: {line!r}"
+    assert re.sub(r"\\(.)", r"\1", match.group(1)) == tricky
+    # the exemplar labelset escapes the same way
+    ex = line.split(" # ", 1)[1]
+    ex_match = re.fullmatch(r'\{trace_id="((?:[^"\\]|\\.)*)"\} 1 [0-9.]+', ex)
+    assert ex_match, f"malformed exemplar: {ex!r}"
+    assert re.sub(r"\\(.)", r"\1", ex_match.group(1)) == 't"\\id'
+
+
+def test_prometheus_text_is_byte_identical_with_and_without_exemplars():
+    # recording exemplars must not perturb the 0.0.4 exposition AT ALL:
+    # scrapers that never opted into OpenMetrics see identical bytes
+    a = _manager_with_samples(with_exemplars=True)
+    b = _manager_with_samples(with_exemplars=False)
+    assert a.render_prometheus() == b.render_prometheus()
+    assert " # {" not in a.render_prometheus()
+    assert "# EOF" not in a.render_prometheus()
+
+
+def test_metrics_endpoint_content_negotiation(app):
+    app.run(block=False)
+    # default: Prometheus 0.0.4, no EOF, no exemplar syntax
+    _, body, headers = _get(app.metrics_port, "/metrics")
+    assert "text/plain" in headers["Content-Type"]
+    assert "0.0.4" in headers["Content-Type"]
+    assert b"# EOF" not in body
+    # explicit Accept: OpenMetrics with the versioned content type
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{app.metrics_port}/metrics",
+        headers={"Accept": "application/openmetrics-text"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        om_headers = dict(r.headers)
+        om_body = r.read()
+    assert "application/openmetrics-text" in om_headers["Content-Type"]
+    assert om_body.endswith(b"# EOF\n")
+
+
+def test_debug_events_html_renders_seq_and_trace_id(app):
+    app.run(block=False)
+    app.container.observe.recorder.record(
+        "submitted", request_id=1, trace_id="cd" * 16, prompt_len=3)
+    status, body, headers = _get(app.metrics_port,
+                                 "/debug/events?format=html")
+    assert status == 200 and "text/html" in headers["Content-Type"]
+    assert b"<th>seq</th>" in body and b"<th>trace_id</th>" in body
+    assert ("cd" * 16).encode() in body
+
+
+def test_debug_timeline_page_serves_chrome_trace(app):
+    app.run(block=False)
+    tl = app.container.observe.timeline
+    tl.decode_block(time.monotonic() - 0.01, time.monotonic(), (0,), 4)
+    status, body, _ = _get(app.metrics_port, "/debug/timeline")
+    assert status == 200
+    payload = json.loads(body)
+    assert "traceEvents" in payload
+    assert any(e.get("cat") == "decode" for e in payload["traceEvents"])
+    # the trailing-window filter drops events older than last_ms
+    status, body, _ = _get(app.metrics_port,
+                           "/debug/timeline?last_ms=0.001")
+    assert not any(e.get("cat") == "decode"
+                   for e in json.loads(body)["traceEvents"])
+    status, body, _ = _get(app.metrics_port,
+                           "/debug/timeline?format=stats")
+    assert json.loads(body)["enabled"] is True
+    status, _, _ = _get(app.metrics_port, "/debug/timeline?last_ms=zzz")
+    assert status == 400
+    # float() parses nan/inf happily; they must still 400, not return
+    # a silently empty trace
+    for bad in ("nan", "inf", "-5"):
+        status, _, _ = _get(app.metrics_port,
+                            f"/debug/timeline?last_ms={bad}")
+        assert status == 400, f"last_ms={bad} accepted"
+
+
 # -- acceptance: the full serving path on the CPU backend -------------------
 
 def test_full_app_generation_flight_recorder_and_telemetry():
@@ -206,9 +346,15 @@ def test_full_app_generation_flight_recorder_and_telemetry():
     must show the in-flight generation (stage + age + trace id) WHILE it
     runs, and /metrics must expose non-empty TTFT and inter-token
     histograms after it completes (ISSUE acceptance criteria)."""
+    from gofr_tpu.tracing import InMemoryExporter
+
     app = App(MapConfig({"HTTP_PORT": "0", "METRICS_PORT": "0",
                          "TPU_MODEL": "tiny", "TPU_MAX_SEQ": "128",
                          "TPU_SLOTS": "2", "TPU_SEQ_BUCKETS": "8,16"}))
+    # capture exported spans so the TTFT exemplar's trace id can be
+    # resolved against them (trace<->metric correlation acceptance)
+    span_sink = InMemoryExporter()
+    app.container.tracer.exporter = span_sink
 
     @app.get("/gen")
     def gen(ctx):
@@ -287,11 +433,43 @@ def test_full_app_generation_flight_recorder_and_telemetry():
         assert first_token["ttft_s"] > 0
         del rid
 
+        # -- wide event: one canonical row reconstructs the request -----------
+        wides = [e for e in mine if e["event"] == "request"]
+        assert len(wides) == 1
+        wide = wides[0]
+        assert wide["outcome"] == "finished" and wide["tokens"] == 100
+        assert wide["slo_class"] == "latency"
+        assert wide["queue_wait_s"] >= 0 and wide["chunks"] == 0
+
+        # -- exemplars: the TTFT bucket's trace id resolves to spans ----------
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{app.metrics_port}/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            om = r.read().decode()
+        assert om.endswith("# EOF\n")
+        ex_line = next(l for l in om.splitlines()
+                       if l.startswith("app_tpu_ttft_duration_bucket")
+                       and " # {" in l)
+        ex_tid = ex_line.split('trace_id="', 1)[1].split('"', 1)[0]
+        assert ex_tid == gen_entry["trace_id"]
+        exported = {s.trace_id for s in span_sink.spans}
+        assert ex_tid in exported  # the bucket links to real spans
+        assert any(s.name == "tpu.prefill" and s.trace_id == ex_tid
+                   for s in span_sink.spans)
+
+        # -- timeline: the serving window exported the schedule ---------------
+        _, body, _ = _get(app.metrics_port, "/debug/timeline")
+        trace = json.loads(body)
+        cats = {e.get("cat") for e in trace["traceEvents"]}
+        assert "decode" in cats and "prefill" in cats
+
         # -- /debug/vars: engine + generator state ----------------------------
         _, body, _ = _get(app.metrics_port, "/debug/vars")
         payload = json.loads(body)
         assert payload["tpu"]["model"] == "tiny"
         assert payload["tpu"]["generator"]["total_requests"] >= 1
         assert "score" in payload["tpu"]["batchers"]
+        assert payload["timeline"]["enabled"] is True
     finally:
         app.stop()

@@ -1,5 +1,8 @@
-from gofr_tpu.tracing import (InMemoryExporter, Tracer, ZipkinExporter,
-                              current_span, parse_traceparent)
+import time
+
+from gofr_tpu.tracing import (InMemoryExporter, Span, TailSampler, Tracer,
+                              ZipkinExporter, current_span,
+                              parse_traceparent)
 
 
 def test_traceparent_parse():
@@ -60,6 +63,224 @@ def test_record_span_exports_interval_without_context_stack():
     assert abs(s.duration_us - 250_000) < 1000
     assert exp.spans == [s]
     assert s.attributes == {"slot": 3}
+
+
+# -- tail-based sampling -----------------------------------------------------
+
+def _span(name, trace_id, *, root=False, dur_us=1000, **attrs):
+    s = Span(name=name, trace_id=trace_id, span_id="b" * 16, root=root,
+             attributes=dict(attrs))
+    s.end_ns = s.start_ns + dur_us * 1000
+    return s
+
+
+def test_tail_sampler_keeps_error_shed_and_expired_traces():
+    # rate 0: NOTHING healthy survives, so anything exported must have
+    # been kept by the must-keep rules — the deterministic form of the
+    # "100% of shed/expired/error" acceptance criterion
+    exp = InMemoryExporter()
+    ts = TailSampler(exp, sample_rate=0.0)
+    cases = {
+        "e1" * 16: _span("tpu.shed", "e1" * 16),                # shed marker
+        "e2" * 16: _span("GET /x", "e2" * 16, root=True,
+                         **{"http.status_code": 500}),          # 5xx error
+        "e3" * 16: _span("GET /y", "e3" * 16, root=True,
+                         **{"http.status_code": 429}),          # shed
+        "e4" * 16: _span("grpc/p", "e4" * 16, root=True,
+                         **{"rpc.grpc.status_code": 4}),        # deadline
+        "e5" * 16: _span("tpu.decode", "e5" * 16,
+                         error="device lost"),                  # error attr
+    }
+    for s in cases.values():
+        ts.export(s, "svc")
+    ts.flush_pending()  # settle rootless traces
+    kept = {s.trace_id for s in exp.spans}
+    assert kept == set(cases)
+
+    # healthy traces at rate 0: buffered, then dropped at the verdict
+    healthy = _span("GET /ok", "a0" * 16, root=True,
+                    **{"http.status_code": 200})
+    ts.export(healthy, "svc")
+    assert all(s.trace_id != "a0" * 16 for s in exp.spans)
+    assert ts.stats()["dropped_traces"] == 1
+
+
+def test_tail_sampler_buffers_whole_trace_until_root_and_keeps_order():
+    exp = InMemoryExporter()
+    ts = TailSampler(exp, sample_rate=1.0)
+    tid = "ab" * 16
+    ts.export(_span("tpu.prefill", tid), "svc")
+    ts.export(_span("tpu.decode", tid), "svc")
+    assert exp.spans == []  # buffered: no root yet
+    ts.export(_span("GET /gen", tid, root=True), "svc")
+    assert [s.name for s in exp.spans] == ["tpu.prefill", "tpu.decode",
+                                           "GET /gen"]
+    # late span of a decided trace follows the verdict immediately
+    ts.export(_span("tpu.late", tid), "svc")
+    assert exp.spans[-1].name == "tpu.late"
+
+
+def test_tail_sampler_rate_is_deterministic_in_the_trace_id():
+    # hash-fraction sampling: the FIRST 13 hex chars decide, so these
+    # two ids straddle any 0.5 rate deterministically
+    low = "0" * 32   # fraction 0.0 -> kept at rate 0.5
+    high = "f" * 32  # fraction ~1.0 -> dropped at rate 0.5
+    exp = InMemoryExporter()
+    ts = TailSampler(exp, sample_rate=0.5)
+    ts.export(_span("a", low, root=True), "svc")
+    ts.export(_span("b", high, root=True), "svc")
+    kept = {s.trace_id for s in exp.spans}
+    assert low in kept and high not in kept
+
+
+def test_tail_sampler_keeps_slow_tail_above_rolling_p99():
+    exp = InMemoryExporter()
+    ts = TailSampler(exp, sample_rate=0.0, min_samples=20)
+    # warm the latency estimator with healthy fast roots (all dropped
+    # at rate 0) ...
+    for i in range(30):
+        tid = f"{i:02d}" * 16
+        ts.export(_span("GET /fast", tid, root=True, dur_us=1000), "svc")
+    assert exp.spans == []
+    # ... then a root far above the rolling p99 must be kept
+    slow = _span("GET /slow", "ee" * 16, root=True, dur_us=500_000)
+    ts.export(slow, "svc")
+    assert [s.trace_id for s in exp.spans] == ["ee" * 16]
+
+
+def test_tail_sampler_late_root_overrides_a_premature_drop_verdict():
+    """A request longer than linger_s gets its stage spans swept and
+    judged before the root finishes. When the root then arrives
+    carrying an error (or slow-tail) signal, the verdict must FLIP:
+    the root span — status, duration, slo_class — exports instead of
+    being silently discarded against the stale drop."""
+    exp = InMemoryExporter()
+    ts = TailSampler(exp, sample_rate=0.0, linger_s=0.0)
+    tid = "dd" * 16
+    ts.export(_span("tpu.prefill", tid), "svc")       # healthy stage span
+    time.sleep(0.01)
+    ts.export(_span("other", "11" * 16), "svc")       # triggers the sweep
+    assert ts.stats()["dropped_traces"] >= 1          # judged prematurely
+    root = _span("GET /gen", tid, root=True, **{"http.status_code": 504})
+    ts.export(root, "svc")
+    assert any(s is root for s in exp.spans)          # late root kept
+    # and later spans of the flipped trace follow the kept verdict
+    ts.export(_span("tpu.decode", tid), "svc")
+    assert exp.spans[-1].name == "tpu.decode"
+    # a healthy late root stays dropped
+    ts.export(_span("GET /ok", "11" * 16, root=True,
+                    **{"http.status_code": 200}), "svc")
+    assert all(s.trace_id != "11" * 16 for s in exp.spans)
+
+
+def test_tail_sampler_span_cap_never_drops_the_root_and_is_visible():
+    exp = InMemoryExporter()
+    ts = TailSampler(exp, sample_rate=1.0, max_spans_per_trace=4)
+    tid = "cc" * 16
+    for i in range(10):
+        ts.export(_span(f"stage{i}", tid), "svc")
+    ts.export(_span("GET /gen", tid, root=True), "svc")
+    names = [s.name for s in exp.spans]
+    assert "GET /gen" in names          # root survived the full buffer
+    assert len(names) == 5              # 4 buffered stages + the root
+    assert ts.stats()["spans_truncated"] == 6
+
+
+def test_tail_sampler_activity_refreshes_the_linger_window():
+    # linger measures IDLE time: a trace still emitting spans is a live
+    # request, not an orphan — it must not be swept mid-flight
+    exp = InMemoryExporter()
+    ts = TailSampler(exp, sample_rate=0.0, linger_s=0.05)
+    tid = "ab" * 16
+    ts.export(_span("s0", tid), "svc")
+    for _ in range(4):
+        time.sleep(0.02)  # each gap < linger_s, total age > linger_s
+        ts.export(_span("sN", tid), "svc")
+    assert ts.stats()["pending_traces"] >= 1  # still buffered, not judged
+
+
+def test_tail_sampler_judges_rootless_traces_after_linger():
+    exp = InMemoryExporter()
+    ts = TailSampler(exp, sample_rate=0.0, linger_s=0.0)
+    ts.export(_span("tpu.decode", "aa" * 16, error="x"), "svc")
+    # a later export sweeps the lingered trace: interesting -> kept
+    # even though no root ever arrived
+    time.sleep(0.01)
+    ts.export(_span("other", "bb" * 16), "svc")
+    assert any(s.trace_id == "aa" * 16 for s in exp.spans)
+
+
+def test_tail_sampler_flushes_idle_traces_without_further_traffic():
+    """The idle sweeper: a rootless error trace buffered right before
+    traffic STOPS must still reach the collector — no later export()
+    call is ever coming to run the sweep for it."""
+    exp = InMemoryExporter()
+    ts = TailSampler(exp, sample_rate=0.0, linger_s=0.05)
+    try:
+        ts.export(_span("tpu.decode", "aa" * 16, error="device lost"),
+                  "svc")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if any(s.trace_id == "aa" * 16 for s in exp.spans):
+                break
+            time.sleep(0.05)
+        assert any(s.trace_id == "aa" * 16 for s in exp.spans), \
+            "idle trace never flushed by the sweeper thread"
+    finally:
+        ts.shutdown()
+    assert ts._thread is not None and not ts._thread.is_alive()
+
+
+def test_start_span_marks_process_local_roots():
+    t = Tracer("svc")
+    root = t.start_span("inbound", traceparent="00-" + "1" * 32 + "-"
+                        + "2" * 16 + "-01")
+    child = t.start_span("inner")
+    assert root.root is True       # no ambient parent -> local root
+    assert child.root is False     # ambient parent -> not a root
+    child.end()
+    root.end()
+    # record_span intervals never root (the serving loop's stage spans)
+    exp = InMemoryExporter()
+    t2 = Tracer("svc", exporter=exp)
+    s = t2.record_span("tpu.prefill", 1.0, 2.0)
+    assert s.root is False
+
+
+# -- bounded export buffer ---------------------------------------------------
+
+def test_zipkin_pending_buffer_is_bounded_when_collector_stalls(monkeypatch):
+    import urllib.request
+
+    from gofr_tpu.metrics import Manager, register_framework_metrics
+
+    def down_collector(req, timeout=None):
+        raise OSError("connection refused")
+
+    monkeypatch.setattr(urllib.request, "urlopen", down_collector)
+    m = Manager()
+    register_framework_metrics(m)
+    # flush interval long enough that the test controls every flush
+    exp = ZipkinExporter("tracer.invalid", batch_size=10_000,
+                         flush_interval=3600.0, max_pending=64, metrics=m)
+    try:
+        t = Tracer("svc", exporter=exp)
+        for i in range(200):
+            with t.span(f"s{i}"):
+                pass
+        with exp._lock:
+            assert len(exp._buf) == 64          # bounded
+            names = [z["name"] for z in exp._buf]
+        assert names[0] == "s136" and names[-1] == "s199"  # newest kept
+        assert exp.dropped == 136
+        text = m.render_prometheus()
+        assert "app_tpu_spans_dropped_total 136.0" in text
+        # fail-open: a flush against the dead collector must not raise
+        exp._flush()
+        with exp._lock:
+            assert len(exp._buf) == 0  # handed to the (failed) POST
+    finally:
+        exp.shutdown()
 
 
 def test_zipkin_shutdown_joins_thread_and_flushes(monkeypatch):
