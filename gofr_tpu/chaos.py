@@ -32,6 +32,13 @@ Seams (grep for ``chaos.fire``):
                       (cache reallocation, waiter fail-fast)
   GRPC_STREAM         grpcx/server._handle_stream, before dispatch —
                       transport-level latency/errors per RPC
+  HBM_ALLOC           tpu/hbm lease points (lease/alloc/check) — an
+                      injected ``ResourceExhausted`` models a device
+                      allocation failure that survived reclaim+retry:
+                      the arbiter sheds that request (429/
+                      RESOURCE_EXHAUSTED + Retry-After) and the
+                      process keeps serving. ``every=N`` kills
+                      allocation N deterministically
   HTTP_REQUEST        http/server._handle, before routing
   SERVICE_REQUEST     service/client._do, before the network hop —
                       feeds the retry/breaker composition tests
@@ -55,8 +62,8 @@ import time
 __all__ = [
     "BATCHER_DISPATCH", "GENERATOR_CHUNK", "GENERATOR_PREFILL",
     "GENERATOR_STEP",
-    "GRPC_STREAM", "HTTP_REQUEST", "SERVICE_REQUEST", "SEAMS",
-    "ChaosSchedule", "DeviceLost", "Rule",
+    "GRPC_STREAM", "HBM_ALLOC", "HTTP_REQUEST", "SERVICE_REQUEST", "SEAMS",
+    "ChaosSchedule", "DeviceLost", "ResourceExhausted", "Rule",
     "active", "fire", "install", "scope", "slow_h2_preface", "slow_loris",
     "uninstall",
 ]
@@ -66,11 +73,13 @@ GENERATOR_CHUNK = "generator.chunk"
 GENERATOR_PREFILL = "generator.prefill"
 GENERATOR_STEP = "generator.step"
 GRPC_STREAM = "grpc.stream"
+HBM_ALLOC = "hbm.alloc"
 HTTP_REQUEST = "http.request"
 SERVICE_REQUEST = "service.request"
 
 SEAMS = (BATCHER_DISPATCH, GENERATOR_CHUNK, GENERATOR_PREFILL,
-         GENERATOR_STEP, GRPC_STREAM, HTTP_REQUEST, SERVICE_REQUEST)
+         GENERATOR_STEP, GRPC_STREAM, HBM_ALLOC, HTTP_REQUEST,
+         SERVICE_REQUEST)
 
 
 class DeviceLost(RuntimeError):
@@ -78,6 +87,19 @@ class DeviceLost(RuntimeError):
     of error a real XLA dispatch surfaces when a chip drops off the
     tunnel). Raised at GENERATOR_STEP / BATCHER_DISPATCH it takes the
     same except-paths real device loss takes."""
+
+
+class ResourceExhausted(RuntimeError):
+    """Injected stand-in for a device allocation failure (the
+    RESOURCE_EXHAUSTED ``XlaRuntimeError`` a real OOM surfaces).
+    Raised at HBM_ALLOC it takes the arbiter's shed path; raised at
+    BATCHER_DISPATCH it exercises the batcher's reclaim-then-retry.
+    The message carries the marker ``tpu/hbm.is_oom_error`` keys on,
+    so the classifier treats injected and real OOMs identically."""
+
+    def __init__(self, msg: str = "injected RESOURCE_EXHAUSTED: device "
+                                  "memory exhausted (chaos)"):
+        super().__init__(msg)
 
 
 class Rule:

@@ -419,6 +419,21 @@ def register_framework_metrics(m: Manager) -> None:
     m.new_gauge("app_tpu_device_bytes",
                 "declared live device bytes, by serving subsystem "
                 "(engine, kvcache-t0, lora, spec-decode, batcher)")
+    # the HBM arbiter (docs/advanced-guide/memory.md): one budget the
+    # subsystems lease from, with demand-driven reclaim and an
+    # OOM-shed path instead of process death
+    m.new_gauge("app_tpu_hbm_budget_bytes",
+                "the arbiter's device-memory budget (0 = arbitration "
+                "off; TPU_HBM_BUDGET_MB or device limit minus "
+                "headroom)")
+    m.new_counter("app_tpu_hbm_reclaims_total",
+                  "arbiter reclaim callbacks that freed bytes, by the "
+                  "RECLAIMED subsystem (T0 pool shrink-to-host-tier, "
+                  "cold paged block release, scratch drops)")
+    m.new_counter("app_tpu_hbm_shed_total",
+                  "requests degraded to 429/RESOURCE_EXHAUSTED because "
+                  "an HBM lease could not be covered after reclaim, by "
+                  "requesting subsystem")
 
     # overload-safety family (gofr_tpu/resilience: deadlines, admission
     # control, brownout — see docs/advanced-guide/resilience.md)

@@ -241,6 +241,13 @@ def install_debug_routes(router, app) -> None:
         if hbm is not None:
             try:
                 payload["device_memory"] = hbm.live_bytes()
+                # the arbiter's live lease/reclaim table (budget,
+                # per-lease priority class + reclaimability, shed and
+                # reclaim counters) — empty when no budget is set and
+                # nothing has leased
+                arb = hbm.arbiter_stats()
+                if arb["budget_bytes"] or arb["leases"]:
+                    payload["hbm_arbiter"] = arb
             except Exception:
                 pass
         tpu = app.container.tpu

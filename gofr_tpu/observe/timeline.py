@@ -149,6 +149,15 @@ class Timeline:
     def hbm(self, subsystem: str, nbytes: float) -> None:
         self.append("hbm", time.monotonic(), None, subsystem, nbytes)
 
+    def hbm_event(self, subsystem: str, what: str,
+                  nbytes: float = 0.0) -> None:
+        """Arbiter decision instant (reclaim/shed) alongside the
+        subsystem's ``hbm:*`` counter track — a Perfetto window shows
+        WHY a counter stepped down (reclaim) or a request 429'd
+        (shed) at that timestamp."""
+        self.append("hbm_event", time.monotonic(), None, subsystem, what,
+                    nbytes)
+
     # -- read side -----------------------------------------------------------
     def events(self, last_ms: float | None = None) -> list[tuple]:
         """Seq-ordered snapshot of the live ring (oldest first),
@@ -281,6 +290,12 @@ class Timeline:
             elif kind == "hbm":
                 body.append({"ph": "C", "pid": 1, "name": f"hbm:{a}",
                              "ts": us, "args": {"bytes": b}})
+            elif kind == "hbm_event":
+                body.append({"ph": "i", "s": "t", "pid": 1,
+                             "tid": _TID_SCHED, "name": f"hbm:{a} {b}",
+                             "cat": "hbm", "ts": us,
+                             "args": {"subsystem": a, "what": b,
+                                      "bytes": c, "seq": seq}})
             else:  # unknown kind: surface, never drop silently
                 body.append({"ph": "i", "s": "t", "pid": 1,
                              "tid": _TID_SCHED, "name": str(kind),

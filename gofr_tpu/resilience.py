@@ -303,43 +303,77 @@ class AdmissionGate:
             return
         self._shed(depth, wait, program, slo_class)
 
-    def _shed(self, depth: int, wait: float, program: str,
-              slo_class: str = SLO_LATENCY) -> None:
+    def _record_shed(self, program: str, slo_class: str,
+                     attributes: dict, trace_id: str = "") -> None:
+        """The one shed-bookkeeping path (queue pressure AND memory
+        pressure): counters, the ``app_tpu_shed_total`` increment
+        exemplar'd by the request's trace, and the zero-length
+        ``tpu.shed`` marker span — so the two pressure kinds can never
+        drift apart in what they record. ``trace_id`` overrides the
+        ambient-span lookup for callers off the handler thread (the
+        generation loop)."""
         self.sheds += 1
         if slo_class in self.sheds_by_class:
             self.sheds_by_class[slo_class] += 1
-        # honest Retry-After: the current wait estimate, floored so a
-        # zero-estimate early shed doesn't invite an instant retry storm
-        retry_after = max(0.05, wait)
         now = time.monotonic()
-        if self.metrics is not None:
+        if not trace_id and (self.metrics is not None
+                             or self.tracer is not None):
             from . import tracing
 
             span = tracing.current_span()  # the shed caller's request
+            trace_id = span.trace_id if span is not None else ""
+        if self.metrics is not None:
             try:
                 self.metrics.increment_counter(
-                    "app_tpu_shed_total",
-                    exemplar=span.trace_id if span is not None else None,
-                    program=program or self.name,
-                    slo_class=slo_class)
+                    "app_tpu_shed_total", exemplar=trace_id or None,
+                    program=program or self.name, slo_class=slo_class)
             except Exception:
                 pass
         if self.tracer is not None:
             try:
-                # zero-length marker span: the request's trace shows WHERE
-                # it died (queue depth + wait estimate at the gate)
+                # zero-length marker span: the request's trace shows
+                # WHERE it died and WHY (queue state or memory reason)
                 self.tracer.record_span(
-                    "tpu.shed", now, now,
-                    attributes={"queue_depth": depth,
-                                "wait_ewma_ms": round(wait * 1e3, 3),
+                    "tpu.shed", now, now, trace_id=trace_id or None,
+                    attributes={**attributes,
                                 "program": program or self.name,
                                 "slo_class": slo_class})
             except Exception:
                 pass
+
+    def _shed(self, depth: int, wait: float, program: str,
+              slo_class: str = SLO_LATENCY) -> None:
+        # honest Retry-After: the current wait estimate, floored so a
+        # zero-estimate early shed doesn't invite an instant retry storm
+        self._record_shed(program, slo_class,
+                          {"queue_depth": depth,
+                           "wait_ewma_ms": round(wait * 1e3, 3)})
         raise TooManyRequests(
             f"{self.name or 'admission'}: queue depth {depth}, "
             f"estimated wait {wait * 1e3:.0f}ms — shed ({slo_class})",
-            retry_after=retry_after)
+            retry_after=max(0.05, wait))
+
+    def shed_memory(self, program: str = "",
+                    slo_class: str = SLO_LATENCY,
+                    retry_after: float = 1.0,
+                    trace_id: str = "") -> TooManyRequests:
+        """Route an HBM-arbiter allocation failure through the gate's
+        shed surface: same counters (``sheds``/``sheds_by_class``/
+        ``app_tpu_shed_total``), same ``tpu.shed`` marker span, same
+        429 + ``Retry-After`` contract as a queue shed — with
+        ``reason: hbm`` attached so dashboards can split memory
+        pressure from queue pressure. RETURNS the error (the caller
+        decides whether to raise it or deliver it into a stream that
+        already exists); the arbiter's own ``app_tpu_hbm_shed_total``
+        is counted by ``hbm.note_shed`` at the raise site, not here.
+        ``trace_id``: the request's trace when the caller is off the
+        handler thread (the generation loop), else the ambient span
+        is used."""
+        self._record_shed(program, slo_class, {"reason": "hbm"},
+                          trace_id=trace_id)
+        return TooManyRequests(
+            f"{self.name or 'admission'}: device memory exhausted — "
+            f"shed ({slo_class})", retry_after=max(0.05, retry_after))
 
     def cap_tokens(self, max_new_tokens: int,
                    slo_class: str = SLO_LATENCY) -> int:

@@ -109,6 +109,18 @@ Config keys (reference config style, pkg/gofr/config/config.go:3):
                       selection via generate(adapter=i); install
                       weights with engine.generator.load_adapter
   TPU_LORA_RANK       LoRA bottleneck rank (default 16)
+  TPU_HBM_BUDGET_MB   HBM arbiter budget in MiB (docs/advanced-guide/
+                      memory.md): one budget every subsystem leases
+                      from, with demand-driven reclaim (T0 shrinks
+                      toward the host tier, cold paged blocks release)
+                      and an OOM-shed path (429/RESOURCE_EXHAUSTED +
+                      Retry-After) instead of process death. Unset/0 =
+                      resolve from the device's reported limit minus
+                      the headroom fraction on accelerator backends;
+                      on CPU the budget stays off unless set
+  TPU_HBM_HEADROOM    fraction of the device limit the resolved budget
+                      leaves free for XLA workspace the accounting
+                      registry can't see (default 0.1)
   TPU_MAX_QUEUE_DEPTH admission control (resilience.AdmissionGate):
                       shed with 429/RESOURCE_EXHAUSTED once this many
                       requests wait in a queue (default 0 = off)
@@ -190,6 +202,12 @@ def new_engine_from_config(cfg, logger=None, metrics=None,
     seq_buckets = _csv_ints(cfg.get("TPU_SEQ_BUCKETS"), DEFAULT_SEQ_BUCKETS)
 
     from ..resilience import gate_from_config
+    from . import hbm
+
+    # the HBM arbiter budget (one per process — subsystems of every
+    # engine built after this lease from it)
+    hbm.configure(budget_mb=cfg.get_int("TPU_HBM_BUDGET_MB", 0) or None,
+                  headroom=cfg.get_float("TPU_HBM_HEADROOM", 0.1))
 
     tracer = getattr(observe, "tracer", None)
     batch_share = cfg.get_float("TPU_SLO_BATCH_SHARE", 0.0)

@@ -38,8 +38,9 @@ import time
 from typing import Any, Callable, Sequence
 
 from .. import chaos
-from ..errors import DeadlineExceeded
+from ..errors import DeadlineExceeded, TooManyRequests
 from ..resilience import SLO_LATENCY, SLO_THROUGHPUT, current_slo_class
+from . import hbm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -399,8 +400,30 @@ class CoalescingBatcher:
             except Exception:
                 pass
         try:
-            chaos.fire(chaos.BATCHER_DISPATCH)
-            results = self.runner([it.payload for it in batch])
+            try:
+                chaos.fire(chaos.BATCHER_DISPATCH)
+                results = self.runner([it.payload for it in batch])
+            except BaseException as e:
+                if not hbm.is_oom_error(e):
+                    raise
+                # device OOM at dispatch (transient batch buffers /
+                # output allocation): run one arbiter reclaim pass and
+                # retry the SAME batch once — predict programs are
+                # pure, so the re-dispatch is safe. A second failure
+                # SHEDS the batch (429/RESOURCE_EXHAUSTED +
+                # Retry-After) instead of surfacing a raw runtime
+                # error: memory pressure degrades these requests, it
+                # never fails them as 500s or kills the dispatcher.
+                hbm.reclaim()
+                try:
+                    results = self.runner([it.payload for it in batch])
+                except BaseException as e2:
+                    if not hbm.is_oom_error(e2):
+                        raise
+                    hbm.note_shed("batcher")
+                    raise TooManyRequests(
+                        f"{self.name}: device memory exhausted after "
+                        "reclaim+retry — shed", retry_after=1.0) from e2
             if len(results) != len(batch):
                 raise RuntimeError(
                     f"{self.name}: runner returned {len(results)} results "

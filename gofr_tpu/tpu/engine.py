@@ -117,6 +117,11 @@ class TPUEngine:
         self.generator = None  # set by config wiring for decoder models
         self._closed = False
         if metrics is not None:
+            # device-byte + arbiter gauges/counters (app_tpu_device_
+            # bytes, app_tpu_hbm_*): attach even for engines without a
+            # generator — the batcher's OOM-shed path counts through
+            # the same registry
+            hbm.set_metrics(metrics)
             try:
                 metrics.set_gauge("app_tpu_devices", len(self.devices))
             except Exception:
@@ -416,6 +421,14 @@ class TPUEngine:
         acct = hbm.live_bytes()
         if acct:
             details["device_memory"] = acct
+        # the arbiter's budget/lease/reclaim summary (full lease table
+        # on /debug/vars and tools/hbm_report.py)
+        arb = hbm.arbiter_stats()
+        if arb["budget_bytes"] or arb["leases"]:
+            details["hbm_arbiter"] = {
+                k: arb[k] for k in ("budget_bytes", "in_use_bytes",
+                                    "headroom_bytes", "reclaims",
+                                    "sheds", "oom_retries")}
         if self.generator is not None:
             details["generator"] = self.generator.stats()
         if self._closed:

@@ -327,6 +327,17 @@ class GenerationEngine:
         self.cfg = cfg
         self.params = params
         self.n_slots = slots
+        # serializes device-state mutation (the loop thread vs warmup/
+        # close). Created BEFORE the first hbm.alloc below: the
+        # arbiter's reclaim callbacks registered on those leases take
+        # this lock, and another engine's construction may invoke them
+        # while ours is still mid-__init__. REENTRANT because the
+        # serving loop itself can trigger reclaim (admission check ->
+        # budget overshoot -> our own pool shrink) while already
+        # holding the lock.
+        self._device_lock = threading.RLock()
+        # guards the _closed check-then-enqueue in generate() against close()
+        self._admission_lock = threading.Lock()
         # Multi-LoRA serving: n adapter slots of rank-r deltas on the
         # attention projections, stacked inside params["layers"] so the
         # layer scan slices them with the base weights; each request
@@ -337,20 +348,25 @@ class GenerationEngine:
         self._n_adapters = max(0, int(lora_adapters))
         if self._n_adapters:
             if "lora_a_wq" not in params["layers"]:
-                stacks = llama.init_lora(cfg, self._n_adapters,
-                                         int(lora_rank),
-                                         jax.random.PRNGKey(seed + 1))
-                if mesh is not None:
-                    # stacks shard like any stacked leaf (layer dim over
-                    # pp, rank-r matrices replicated — they're tiny next
-                    # to the weight stream); the per-row adapter gather
-                    # reads a replicated table with batch-sharded
-                    # indices, which GSPMD partitions cleanly
-                    from ..parallel import shardings_for
+                def _build_lora():
+                    built = llama.init_lora(cfg, self._n_adapters,
+                                            int(lora_rank),
+                                            jax.random.PRNGKey(seed + 1))
+                    if mesh is not None:
+                        # stacks shard like any stacked leaf (layer dim
+                        # over pp, rank-r matrices replicated — they're
+                        # tiny next to the weight stream); the per-row
+                        # adapter gather reads a replicated table with
+                        # batch-sharded indices, which GSPMD partitions
+                        # cleanly
+                        from ..parallel import shardings_for
 
-                    stacks = jax.device_put(stacks,
-                                            shardings_for(stacks, mesh))
-                stacks = hbm.account("lora", stacks, owner=self)
+                        built = jax.device_put(built,
+                                               shardings_for(built, mesh))
+                    return built
+
+                stacks = hbm.alloc("lora", _build_lora, owner=self,
+                                   priority=hbm.PRI_CACHE)
                 self.params = {**params, "layers": {
                     **params["layers"], **stacks}}
             else:
@@ -485,21 +501,29 @@ class GenerationEngine:
         self._kv_dtype = kv_dtype
         self._cache_sh = None  # set below for mesh engines
         self.down: str | None = None  # set when the device loop is bricked
-        # every persistent device buffer flows through hbm.account (the
-        # arbiter's accounting choke point — gofrlint GL202); keyed to
-        # this instance so close() releases exactly our bytes
+        # every persistent device buffer flows through hbm.alloc — the
+        # arbiter leases the bytes against the process budget BEFORE
+        # allocating (reclaiming other subsystems' holdings when it
+        # must), retries once on a real device OOM, and accounts the
+        # result (gofrlint GL202's choke point); keyed to this
+        # instance so close() releases exactly our bytes. The serving
+        # cache is PRI_SERVING: never auto-reclaimed, but the paged
+        # variant attaches the cold-prefix-block release so storms
+        # can still drain logical pool pressure.
         if self._paged:
             from ..models.paged_llama import init_paged_cache
 
-            self.cache = hbm.account(
-                "engine", init_paged_cache(cfg, slots, paged_blocks,
-                                           self._block_t, dtype=kv_dtype),
-                owner=self, tag="cache")
+            self.cache = hbm.alloc(
+                "engine", lambda: init_paged_cache(cfg, slots, paged_blocks,
+                                                   self._block_t,
+                                                   dtype=kv_dtype),
+                owner=self, tag="cache", priority=hbm.PRI_SERVING,
+                reclaim=self._hbm_paged_reclaim)
         else:
-            self.cache = hbm.account(
-                "engine", llama.init_cache(cfg, slots, self.max_seq,
-                                           dtype=kv_dtype),
-                owner=self, tag="cache")
+            self.cache = hbm.alloc(
+                "engine", lambda: llama.init_cache(cfg, slots, self.max_seq,
+                                                   dtype=kv_dtype),
+                owner=self, tag="cache", priority=hbm.PRI_SERVING)
         self._slots = [_Slot() for _ in range(slots)]
         self._last_tokens = np.zeros((slots,), np.int32)
         self._active = np.zeros((slots,), bool)
@@ -556,11 +580,18 @@ class GenerationEngine:
                         except Exception:
                             pass
                     opts = dataclasses.replace(opts, host_mb=0, redis=None)
-                self._pool = hbm.account(
-                    "kvcache-t0", llama.init_cache(cfg, prefix_cache_slots,
-                                                   self.max_seq,
-                                                   dtype=kv_dtype),
-                    owner=self, tag="pool")
+                # PRI_CACHE with the shrink callback: under budget
+                # pressure from ANY subsystem the arbiter spills this
+                # pool's entries to the host tier and reallocates it
+                # smaller (_hbm_pool_reclaim) — T0 shrinks so e.g. a
+                # paged engine's lease in the same process proceeds
+                self._pool = hbm.alloc(
+                    "kvcache-t0",
+                    lambda: llama.init_cache(cfg, prefix_cache_slots,
+                                             self.max_seq,
+                                             dtype=kv_dtype),
+                    owner=self, tag="pool", priority=hbm.PRI_CACHE,
+                    reclaim=self._hbm_pool_reclaim)
                 layout = KVLayout(cfg.n_layers, cfg.n_kv_heads,
                                   cfg.head_dim, self._pool.quantized,
                                   np.dtype(self._pool.k.dtype),
@@ -620,10 +651,6 @@ class GenerationEngine:
         # nothing when traffic is untagged (all-latency).
         self._lat_reserve = max(0, min(int(slo_latency_slots), slots - 1))
         self._work = threading.Event()
-        # serializes device-state mutation (the loop thread vs warmup/close)
-        self._device_lock = threading.Lock()
-        # guards the _closed check-then-enqueue in generate() against close()
-        self._admission_lock = threading.Lock()
         self._closed = False
         self._draining = False
         # requests popped off _pending but not yet visible in _active —
@@ -716,10 +743,10 @@ class GenerationEngine:
                 from ..models.paged_llama import (read_blocks_to_row,
                                                   write_row_to_blocks)
 
-                self._scratch = hbm.account(
-                    "engine", llama.init_cache(cfg, 1, self.max_seq,
-                                               dtype=kv_dtype),
-                    owner=self, tag="scratch")
+                self._scratch = hbm.alloc(
+                    "engine", lambda: llama.init_cache(cfg, 1, self.max_seq,
+                                                       dtype=kv_dtype),
+                    owner=self, tag="scratch", priority=hbm.PRI_SCRATCH)
                 self._chunk_mid_jit = jax.jit(self._chunk_mid,
                                               donate_argnums=(0,))
                 self._chunk_final_jit = jax.jit(self._chunk_final,
@@ -1564,6 +1591,19 @@ class GenerationEngine:
                                   error="deadline expired in queue",
                                   wait_s=round(wait_s, 6))
                     continue
+                try:
+                    # arbiter checkpoint: one zero-byte lease per
+                    # admission. The seeded HBM_ALLOC chaos seam and
+                    # the budget-overshoot reclaim both live behind
+                    # it, and a failure sheds THIS request (429 +
+                    # Retry-After through the gate's shed surface)
+                    # instead of raising into the loop's device-loss
+                    # recovery — memory pressure degrades the
+                    # request, never the engine
+                    hbm.check("engine")
+                except hbm.HBMExhausted as e:
+                    self._shed_oom(req, e)
+                    continue
                 blocks = None
                 if self._paged:
                     blocks = self._paged_admission_blocks(req)
@@ -2106,6 +2146,132 @@ class GenerationEngine:
                                        self._kv_row_get(self.cache, idx,
                                                         want))
 
+    def _shed_oom(self, req: _Request, e: "hbm.HBMExhausted") -> None:
+        """OOM-shed a popped admission: the arbiter could not cover a
+        lease (seeded HBM_ALLOC fault, or a real budget overshoot that
+        survived reclaim), so THIS request degrades to a served
+        429/RESOURCE_EXHAUSTED with the arbiter's Retry-After while
+        the engine keeps serving everything else — the memory-pressure
+        mirror of the gate's queue-pressure shed. The arbiter counted
+        app_tpu_hbm_shed_total at its raise site; here the failure
+        routes through the gate's shed surface (counters + tpu.shed
+        span with reason=hbm) and the stream's terminal wide event."""
+        retry_after = getattr(e, "retry_after", None) or 1.0
+        err: BaseException = e
+        if self.gate is not None:
+            err = self.gate.shed_memory(
+                program="generate", slo_class=req.slo_class,
+                retry_after=retry_after, trace_id=req.stream.trace_id)
+        else:
+            now = time.monotonic()
+            self._obs_span("tpu.shed", now, now, req.stream,
+                           {"reason": "hbm", "slo_class": req.slo_class})
+        if self._tl is not None:
+            self._tl.shed("generate", req.slo_class, req.stream.trace_id)
+        req.stream.failed = "hbm exhausted: shed"
+        req.stream._q.put(err)
+        req.stream._q.put(None)
+        self._obs_end(req.stream, "shed", tokens=0, error=str(e))
+
+    # -- arbiter reclaim callbacks (registered on the hbm leases) ------------
+    def _hbm_pool_reclaim(self, need: int) -> int:
+        """Shrink the T0 prefix pool toward the host tier: spill every
+        live entry's row to T1 (when configured), drop enough rows to
+        cover ``need`` bytes (always keeping one), and reallocate the
+        pool at the smaller size. Future hits promote back from T1/T2
+        exactly like post-recovery rewarming — the cache gets slower,
+        the process survives. Runs under the device lock (reentrant:
+        the serving loop may trigger its own shrink via the admission
+        checkpoint); a mesh engine skips (its pool is sharded and the
+        offload spill path is gated off there). Returns bytes freed."""
+        if self.mesh is not None:
+            return 0
+        with self._device_lock:
+            kvc = getattr(self, "_kvc", None)
+            pool = getattr(self, "_pool", None)
+            if kvc is None or pool is None:
+                return 0
+            slots = kvc.slots
+            if slots <= 1:
+                return 0
+            total = hbm.tree_nbytes(pool)
+            row_b = max(1, total // slots)
+            drop = min(slots - 1, -(-max(int(need), 1) // row_b))
+            new_slots = slots - drop
+            for entry in kvc.t0.entries():
+                # the same spill path T0's LRU eviction uses (host-tier
+                # guard included) — one convention for moving a pool
+                # row down a tier
+                self._offload_victim(entry)
+            kvc.shrink(new_slots)
+            # drop the old buffer BEFORE allocating the replacement:
+            # holding both would spike usage past the very budget this
+            # reclaim is trying to satisfy
+            self._pool = None
+            del pool
+            try:
+                self._pool = hbm.account(
+                    "kvcache-t0", llama.init_cache(self.cfg, new_slots,
+                                                   self.max_seq,
+                                                   dtype=self._kv_dtype),
+                    owner=self, tag="pool")
+            except BaseException:
+                # even the SMALLER pool failed to allocate (we are, by
+                # definition, under memory pressure here). A None pool
+                # behind a live CacheManager would AttributeError every
+                # later store/promote, so disable the prefix tiers
+                # outright — serving continues cache-less, the whole
+                # old pool's bytes count as freed, and the arbiter's
+                # caller gets the maximum this lease could give
+                self._disable_prefix_tiers()
+                hbm.release("kvcache-t0", owner=self, tag="pool")
+                if self.logger is not None:
+                    self.logger.error({
+                        "event": "kvcache t0 disabled: arbiter shrink "
+                                 "could not reallocate the smaller pool",
+                        "slots_attempted": new_slots})
+                return total
+            if self.logger is not None:
+                self.logger.warn({
+                    "event": "kvcache t0 shrunk by hbm arbiter reclaim",
+                    "slots": new_slots, "dropped_rows": drop,
+                    "freed_bytes": drop * row_b})
+            return drop * row_b
+
+    def _disable_prefix_tiers(self) -> None:
+        """Last-resort degradation: drop the hierarchical prefix cache
+        entirely (pool gone, manager detached, its Redis client closed)
+        so every cache path sees the same None it sees on engines built
+        without one — requests keep serving, they just prefill fully."""
+        kvc, self._kvc = self._kvc, None
+        self._pool = None
+        self._host_write_jit = None
+        if kvc is not None and kvc.redis is not None:
+            try:  # the engine owns the T2 client (KVCacheOptions.redis)
+                kvc.redis.client.close()
+            except Exception:
+                pass
+
+    def _hbm_paged_reclaim(self, need: int) -> int:
+        """Release ONE cold shared-prefix entry's blocks back to the
+        paged pool — the same one-at-a-time valve the in-pool pressure
+        paths use (_paged_admission_blocks/_ensure_blocks): flushing
+        the whole index would trade every future hit for a reclaim
+        that may have needed a single eviction. The pool tensor itself
+        is one preallocated buffer, so this frees BLOCK capacity (room
+        for live streams to grow / new admissions) rather than HBM
+        bytes — it reports 0 toward a byte deficit but still runs
+        under pressure so the next block-level allocation finds
+        room."""
+        del need
+        if not self._paged or self._prefix_idx is None:
+            return 0
+        with self._device_lock:
+            if self._prefix_idx.evict_one() and self.logger is not None:
+                self.logger.warn({"event": "paged prefix entry evicted "
+                                  "by hbm arbiter reclaim"})
+        return 0
+
     def _count_expired(self, where: str = "queue",
                        request_id=None) -> None:
         if self._tl is not None:
@@ -2526,43 +2692,68 @@ class GenerationEngine:
                         if self._pool is not None:
                             # _pool_store_jit donates the pool buffer —
                             # a failed store leaves it consumed/poisoned
-                            pool = llama.init_cache(
-                                self.cfg, self._kvc.slots,
-                                self.max_seq, dtype=self._kv_dtype)
-                            if self._pool_sh is not None:
-                                pool = jax.device_put(pool, self._pool_sh)
-                            # re-account (set semantics): the donated
-                            # old pool died with the failed dispatch
-                            self._pool = hbm.account(
-                                "kvcache-t0", jax.block_until_ready(pool),
-                                owner=self, tag="pool")
+                            def _realloc_pool():
+                                pool = llama.init_cache(
+                                    self.cfg, self._kvc.slots,
+                                    self.max_seq, dtype=self._kv_dtype)
+                                if self._pool_sh is not None:
+                                    pool = jax.device_put(pool,
+                                                          self._pool_sh)
+                                return jax.block_until_ready(pool)
+
+                            # re-lease + re-account (set semantics):
+                            # the donated old pool died with the failed
+                            # dispatch, and the arbiter's reclaim-then-
+                            # retry covers a recovery that lands while
+                            # HBM is contended
+                            self._pool = hbm.alloc(
+                                "kvcache-t0", _realloc_pool,
+                                owner=self, tag="pool",
+                                priority=hbm.PRI_CACHE,
+                                reclaim=self._hbm_pool_reclaim)
                         if self._paged:
                             from ..models.paged_llama import init_paged_cache
 
-                            cache = init_paged_cache(
-                                self.cfg, self.n_slots,
-                                self._alloc.n_blocks, self._block_t,
-                                dtype=self._kv_dtype)
+                            def _realloc_cache():
+                                return init_paged_cache(
+                                    self.cfg, self.n_slots,
+                                    self._alloc.n_blocks, self._block_t,
+                                    dtype=self._kv_dtype)
+
+                            cache_reclaim = self._hbm_paged_reclaim
                             if hasattr(self, "_scratch"):
                                 # the chunk jits donate the scratch row
                                 # too — a failed chunk dispatch leaves it
                                 # consumed, bricking every later
                                 # long-prompt admission
-                                self._scratch = hbm.account(
-                                    "engine", jax.block_until_ready(
+                                self._scratch = hbm.alloc(
+                                    "engine",
+                                    lambda: jax.block_until_ready(
                                         llama.init_cache(
                                             self.cfg, 1, self.max_seq,
                                             dtype=self._kv_dtype)),
-                                    owner=self, tag="scratch")
+                                    owner=self, tag="scratch",
+                                    priority=hbm.PRI_SCRATCH)
                         else:
-                            cache = llama.init_cache(self.cfg, self.n_slots,
-                                                     self.max_seq,
-                                                     dtype=self._kv_dtype)
-                        if self._cache_sh is not None:
-                            cache = jax.device_put(cache, self._cache_sh)
-                        self.cache = hbm.account(
-                            "engine", jax.block_until_ready(cache),
-                            owner=self, tag="cache")
+                            def _realloc_cache():
+                                return llama.init_cache(self.cfg,
+                                                        self.n_slots,
+                                                        self.max_seq,
+                                                        dtype=self._kv_dtype)
+
+                            cache_reclaim = None
+
+                        def _realloc_placed():
+                            cache = _realloc_cache()
+                            if self._cache_sh is not None:
+                                cache = jax.device_put(cache,
+                                                       self._cache_sh)
+                            return jax.block_until_ready(cache)
+
+                        self.cache = hbm.alloc(
+                            "engine", _realloc_placed, owner=self,
+                            tag="cache", priority=hbm.PRI_SERVING,
+                            reclaim=cache_reclaim)
                     if self.logger is not None:
                         self.logger.warn({"event": "generation cache "
                                           "reallocated after device failure"})

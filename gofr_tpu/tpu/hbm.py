@@ -1,58 +1,148 @@
-"""Device-memory accounting: the choke point every persistent HBM
-allocation flows through.
+"""Device-memory arbiter: one HBM budget every subsystem leases from.
 
-The ROADMAP's unified memory arbiter needs one thing before it can
-exist: visibility. ``BENCH_CANDIDATE.json`` shows the prefix-cache,
-engine and speculative arms dying with RESOURCE_EXHAUSTED and the
-paged engine OOMing at every batch size because each subsystem
-allocates HBM blindly — nobody can SEE device memory, so nobody can
-rebalance it. This module is the accounting substrate: every
-persistent device buffer a serving subsystem creates is declared here
-(gofrlint GL202 enforces it statically), so the registry always knows
-how many bytes each subsystem holds. The arbiter refactor will grow
-lease/rebalance semantics on top of exactly this table; today it
-feeds:
+``BENCH_CANDIDATE.json`` showed why this exists: the flat decode path
+is healthy at 2709 tok/s/chip while the prefix-cache, engine and
+speculative arms die with RESOURCE_EXHAUSTED and the paged engine OOMs
+at every batch size — each subsystem allocated HBM assuming it owned
+the whole device, and the first one to be wrong killed the process.
+This module is the arbitration point that makes the subsystems
+coexist: ONE budget, leased out per subsystem, with demand-driven
+reclaim and a shed path so an allocation failure degrades the
+*request* instead of killing the *process*.
 
-  - the ``app_tpu_device_bytes{subsystem=...}`` Prometheus gauges
-    (register a metrics Manager via :func:`set_metrics` — the engine
-    wiring does) and the ``device_memory`` section of ``/debug/vars``;
-  - ``gofr_tpu/testutil/hbmwatch.py``, which reconciles these declared
-    bytes against ``jax.live_arrays()`` ground truth under
-    ``pytest --hbmwatch``;
-  - ``tools/hbm_report.py``, the operator's attribution table.
+Three layers, lowest first:
 
-Usage — wrap the allocation at its persist point; ``account`` RETURNS
-the tree so it composes inline::
+  **Accounting** (the PR-6 substrate, unchanged contract): every
+  persistent device buffer is declared via :func:`account`, keyed
+  ``(subsystem, owner, tag)`` with SET semantics (recovery realloc /
+  mesh re-placement replace instead of double-count); gofrlint GL202
+  enforces the discipline statically and ``pytest --hbmwatch``
+  reconciles declared bytes against ``jax.live_arrays()`` ground
+  truth. ``release(owner=self)`` in ``close()``; a weakref finalizer
+  backstops owners that die without it.
 
-    self.cache = hbm.account("engine", llama.init_cache(...),
-                             owner=self, tag="cache")
+  **Leases** (the arbiter): :func:`lease` reserves bytes against the
+  budget BEFORE an allocation, tagged with a priority class —
+  ``PRI_SERVING`` (live serving state, never auto-reclaimed),
+  ``PRI_CACHE`` (performance caches that shrink toward lower tiers),
+  ``PRI_SCRATCH`` (workspace, dropped first) — and an optional
+  **reclaim callback** ``(need_bytes) -> freed_bytes``. When a lease
+  would exceed the budget the arbiter runs reclaim over the registered
+  callbacks (highest priority class first: scratch, then caches), then
+  re-checks; if the deficit survives it raises :class:`HBMExhausted`.
+  :func:`alloc` is the one-call form serving code uses: lease (sized
+  by ``jax.eval_shape`` when a budget is set), run the allocation
+  thunk, catch a REAL device OOM (``XlaRuntimeError`` /
+  RESOURCE_EXHAUSTED — :func:`is_oom_error`), reclaim, retry ONCE,
+  and account the result. :func:`check` is the zero-byte request-path
+  checkpoint the generation admission loop calls per admission.
 
-Entries are keyed ``(subsystem, owner, tag)`` with SET semantics:
-re-accounting the same key (recovery reallocation, mesh re-placement
-via ``device_put``) replaces the figure instead of double-counting —
-the old buffer was consumed/freed by whatever produced the new one.
-``owner`` scopes entries to an engine instance so two engines in one
-process (tests, A/B serving) attribute independently; an owner's
-``close()`` must call :func:`release`, which is how hbmwatch proves a
-closed engine actually let go of its bytes.
+  **Shed** (the degradation contract): :class:`HBMExhausted` IS a
+  ``TooManyRequests`` — it carries ``Retry-After`` and maps to
+  429/RESOURCE_EXHAUSTED at both transports, so an uncoverable lease
+  routes through the existing AdmissionGate shed surface
+  (``AdmissionGate.shed_memory``, ``tpu.shed`` span,
+  ``app_tpu_hbm_shed_total``) and the process keeps serving. The
+  seeded chaos seam ``HBM_ALLOC`` fires at every lease point, so
+  fault schedules can kill allocation N deterministically
+  (tests/test_hbm_arbiter.py, tools/hbm_report.py --pressure).
 
-Subsystem names are free-form but the serving stack uses a fixed
-vocabulary so dashboards line up: ``engine`` (serving KV cache +
-chunk scratch row), ``kvcache-t0`` (prefix-pool rows), ``lora``
-(adapter weight stacks), ``spec-decode`` (verify buffers, when they
-grow device state), ``batcher`` (coalesced staging, likewise).
+The budget: ``TPU_HBM_BUDGET_MB`` (config; 0/unset = resolve from the
+device) minus nothing, else on accelerator backends the device's
+reported ``bytes_limit`` minus the ``TPU_HBM_HEADROOM`` fraction
+(default 0.1 — XLA needs workspace the registry can't see). On the
+CPU backend the budget stays OFF unless set explicitly
+(``set_budget``) — tests opt in with a tiny synthetic budget.
+
+Observability: ``app_tpu_device_bytes{subsystem=}`` gauges on every
+accounting change, ``app_tpu_hbm_budget_bytes``,
+``app_tpu_hbm_reclaims_total{subsystem=}`` /
+``app_tpu_hbm_shed_total{subsystem=}`` counters, ``hbm:*`` counter
+tracks plus reclaim/shed instants on the serving timeline, the
+``hbm_arbiter`` section of ``/debug/vars`` and
+``TPUEngine.health_check``, and ``tools/hbm_report.py``'s lease
+table. Subsystem vocabulary: ``engine`` (serving KV cache + chunk
+scratch), ``kvcache-t0`` (prefix-pool rows), ``lora`` (adapter
+stacks), ``spec-decode``/``batcher`` (when they grow device state).
 """
 
 from __future__ import annotations
 
 import threading
 import weakref
-from typing import Any
+from typing import Any, Callable
 
-__all__ = ["account", "release", "live_bytes", "set_metrics",
-           "set_timeline", "tree_nbytes", "reset", "snapshot"]
+from .. import chaos
+from ..errors import TooManyRequests
+
+__all__ = ["HBMExhausted", "PRI_CACHE", "PRI_SCRATCH", "PRI_SERVING",
+           "account", "alloc", "arbiter_stats", "budget", "check",
+           "configure", "is_oom_error", "lease", "live_bytes",
+           "note_shed", "reclaim", "release", "reset", "set_budget",
+           "set_metrics", "set_timeline", "snapshot", "tree_nbytes"]
 
 GAUGE = "app_tpu_device_bytes"
+BUDGET_GAUGE = "app_tpu_hbm_budget_bytes"
+RECLAIMS_COUNTER = "app_tpu_hbm_reclaims_total"
+SHED_COUNTER = "app_tpu_hbm_shed_total"
+
+# Lease priority classes — the RECLAIM order, highest value first:
+# scratch/workspace is dropped before caches shrink, and live serving
+# state is never auto-reclaimed (a lease may still attach a callback
+# at PRI_SERVING — e.g. the paged engine's cold-block release — but it
+# runs last).
+PRI_SERVING = 0
+PRI_CACHE = 1
+PRI_SCRATCH = 2
+
+
+class HBMExhausted(TooManyRequests):
+    """A lease the budget cannot cover even after reclaim (or a real
+    device OOM that survived the reclaim-then-retry pass). A
+    ``TooManyRequests`` subclass on purpose: the failure is SERVED —
+    429 with ``Retry-After`` on HTTP, RESOURCE_EXHAUSTED with the
+    retry trailer on gRPC — through the same shed surface queue
+    overload uses (resilience.AdmissionGate), instead of killing the
+    process the way an unhandled allocation failure did in
+    BENCH_CANDIDATE.json."""
+
+    def __init__(self, subsystem: str, nbytes: int, *,
+                 budget: int | None = None, in_use: int | None = None,
+                 retry_after: float = 1.0):
+        detail = ""
+        if budget is not None:
+            detail = (f" (budget {budget >> 20} MiB, "
+                      f"in use {(in_use or 0) >> 20} MiB)")
+        super().__init__(
+            f"hbm arbiter: cannot cover {subsystem!r} lease of "
+            f"{int(nbytes)} bytes after reclaim{detail}",
+            retry_after=retry_after)
+        self.subsystem = subsystem
+        self.nbytes = int(nbytes)
+
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "OutOfMemory")
+
+
+def is_oom_error(e: BaseException) -> bool:
+    """Is this the allocation-failure class the arbiter owns? Covers
+    our own :class:`HBMExhausted`, the chaos harness's injected
+    ``ResourceExhausted``, and the runtime's ``XlaRuntimeError`` (or
+    any RuntimeError) whose message carries the RESOURCE_EXHAUSTED /
+    out-of-memory markers — jaxlib raises different concrete types
+    across versions, so the classifier is name+message based rather
+    than an isinstance check against a moving target."""
+    if isinstance(e, HBMExhausted):
+        return True
+    name = type(e).__name__
+    if "ResourceExhausted" in name or "OutOfMemory" in name:
+        return True
+    if "XlaRuntimeError" in name or isinstance(e, (RuntimeError,
+                                                   MemoryError)):
+        msg = str(e)
+        return any(m in msg for m in _OOM_MARKERS)
+    return False
 
 
 def tree_nbytes(tree: Any) -> int:
@@ -65,11 +155,52 @@ def tree_nbytes(tree: Any) -> int:
                for leaf in jax.tree.leaves(tree))
 
 
+def _estimate_nbytes(fn: Callable[[], Any]) -> int:
+    """Size an allocation thunk WITHOUT allocating: ``jax.eval_shape``
+    traces it abstractly and the ShapeDtypeStruct leaves give exact
+    byte figures. 0 when the thunk resists tracing (device_put of
+    host data, side effects) — the lease then reserves nothing and
+    enforcement falls to the post-hoc OOM retry."""
+    try:
+        import jax
+        import numpy as np
+
+        spec = jax.eval_shape(fn)
+        total = 0
+        for leaf in jax.tree.leaves(spec):
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                continue
+            total += int(np.prod(shape, dtype=np.int64)) \
+                * np.dtype(dtype).itemsize
+        return total
+    except Exception:
+        return 0
+
+
 class _Registry:
     def __init__(self) -> None:
         self._mu = threading.Lock()
         # (subsystem, owner_id, tag) -> bytes
         self._entries: dict[tuple[str, int, str], int] = {}
+        # lease metadata per key: (priority, reclaim-callable-or-ref).
+        # Bound-method callbacks are held via weakref.WeakMethod so a
+        # registered reclaimer never pins its engine alive; account()
+        # never touches this table, so a recovery re-account keeps the
+        # lease's class and callback.
+        self._meta: dict[tuple[str, int, str], tuple[int, Any]] = {}
+        self._budget: int | None = None
+        # single-flight reclaim: one pass at a time process-wide.
+        # Concurrent requesters return 0 and judge the budget as-is —
+        # which also breaks any cross-engine lock cycle a nested
+        # reclaim chain could otherwise build (engine A's callback
+        # holds A's device lock while B's callback wants B's).
+        self._reclaim_mu = threading.Lock()
+        self._reclaims: dict[str, int] = {}
+        self._reclaimed_bytes = 0
+        self._sheds: dict[str, int] = {}
+        self._oom_retries: dict[str, int] = {}
         # gauge sinks, weakly held: the registry outlives any Manager
         # and must neither pin one alive nor stop pushing to A because
         # B registered later (two engines, two Managers — both see the
@@ -80,6 +211,7 @@ class _Registry:
         # exported Perfetto trace carries an HBM track per subsystem
         self._timelines: "weakref.WeakSet[Any]" = weakref.WeakSet()
 
+    # -- accounting (PR-6 contract, unchanged) -------------------------------
     def account(self, subsystem: str, tree: Any, *, owner: Any = None,
                 tag: str = "") -> Any:
         key = (subsystem, id(owner) if owner is not None else 0, tag)
@@ -107,26 +239,34 @@ class _Registry:
             for key in list(self._entries):
                 if key[1] == oid:
                     self._entries.pop(key)
+                    self._meta.pop(key, None)
                     touched.add(key[0])
+            for key in list(self._meta):
+                if key[1] == oid:
+                    self._meta.pop(key)
         for sub in touched:
             self._push(sub)
 
     def release(self, subsystem: str | None = None, *,
-                owner: Any = None) -> int:
-        """Drop entries by subsystem and/or owner; returns the bytes
-        released. ``release(owner=self)`` in ``close()`` drops every
-        subsystem the instance accounted."""
+                owner: Any = None, tag: str | None = None) -> int:
+        """Drop entries by subsystem and/or owner (and optionally an
+        exact tag); returns the bytes released. ``release(owner=self)``
+        in ``close()`` drops every subsystem the instance accounted —
+        leases and their reclaim callbacks die with the entries."""
         oid = None if owner is None else id(owner)
         dropped = 0
         touched: set[str] = set()
         with self._mu:
             for key in list(self._entries):
-                sub, key_oid, _ = key
+                sub, key_oid, key_tag = key
                 if subsystem is not None and sub != subsystem:
                     continue
                 if oid is not None and key_oid != oid:
                     continue
+                if tag is not None and key_tag != tag:
+                    continue
                 dropped += self._entries.pop(key)
+                self._meta.pop(key, None)
                 touched.add(sub)
         for sub in touched:
             self._push(sub)
@@ -146,6 +286,328 @@ class _Registry:
         with self._mu:
             return dict(self._entries)
 
+    # -- the arbiter ---------------------------------------------------------
+    def set_budget(self, nbytes: int | None) -> None:
+        """Install (or clear, with None/0) the process HBM budget in
+        bytes. Tests use this directly with tiny synthetic budgets;
+        production resolves it via :func:`configure`."""
+        self._budget = int(nbytes) if nbytes else None
+        for m in list(self._sinks):
+            try:
+                m.set_gauge(BUDGET_GAUGE, float(self._budget or 0))
+            except Exception:
+                pass
+
+    def budget(self) -> int | None:
+        return self._budget
+
+    def configure(self, budget_mb: int | None = None,
+                  headroom: float = 0.1) -> int | None:
+        """Resolve and install the budget: an explicit ``budget_mb``
+        wins; otherwise, on accelerator backends, the device's
+        reported ``bytes_limit`` minus the ``headroom`` fraction (XLA
+        keeps workspace the registry can't see). The CPU backend
+        leaves the budget as-is — there is no meaningful device limit
+        to enforce, and every existing test would suddenly arbitrate
+        against host RAM. Returns the active budget."""
+        if budget_mb:
+            self.set_budget(int(budget_mb) << 20)
+            return self._budget
+        try:
+            import jax
+
+            dev = jax.devices()[0]
+            if dev.platform != "cpu":
+                stats = dev.memory_stats() or {}
+                limit = stats.get("bytes_limit")
+                if limit:
+                    frac = min(max(float(headroom), 0.0), 0.9)
+                    self.set_budget(int(limit * (1.0 - frac)))
+        except Exception:
+            pass  # no backend yet / stats unsupported: budget stays off
+        return self._budget
+
+    def _in_use_locked(self) -> int:
+        return sum(self._entries.values())
+
+    def lease(self, subsystem: str, nbytes: int, *, owner: Any = None,
+              tag: str = "", priority: int = PRI_CACHE,
+              reclaim: Callable[[int], int] | None = None) -> int:
+        """Reserve ``nbytes`` against the budget BEFORE allocating.
+        Fires the seeded ``HBM_ALLOC`` chaos seam (an injected
+        ResourceExhausted sheds deterministically), runs reclaim when
+        the budget can't cover the request, and raises
+        :class:`HBMExhausted` on a surviving deficit. On success the
+        reservation is recorded under ``(subsystem, owner, tag)`` —
+        the later :func:`account` of the real tree replaces the figure
+        (SET semantics), while the priority class and ``reclaim``
+        callback stay attached to the lease. Returns ``nbytes``."""
+        self._fire_seam(subsystem, int(nbytes))
+        need = int(nbytes)
+        key = (subsystem, id(owner) if owner is not None else 0, tag)
+        wrapped = self._wrap_reclaim(reclaim)
+
+        def try_reserve() -> bool:
+            # budget check and reservation insert under ONE lock hold:
+            # two concurrent leases (e.g. two engines constructing in
+            # one process) must not both pass a check neither has
+            # reserved against yet — that would jointly over-commit
+            # the budget with no reclaim and no shed
+            with self._mu:
+                b = self._budget
+                if b:
+                    effective = self._in_use_locked() \
+                        - self._entries.get(key, 0) + need
+                    if effective > b:
+                        return False
+                self._entries[key] = need
+                self._meta[key] = (int(priority), wrapped)
+                return True
+
+        if not try_reserve():
+            with self._mu:
+                deficit = self._in_use_locked() \
+                    - self._entries.get(key, 0) + need \
+                    - (self._budget or 0)
+            self._reclaim(max(deficit, 1), requester=subsystem)
+            if not try_reserve():
+                with self._mu:
+                    in_use = self._in_use_locked() \
+                        - self._entries.get(key, 0)
+                self.note_shed(subsystem)
+                raise HBMExhausted(subsystem, need, budget=self._budget,
+                                   in_use=in_use)
+        if owner is not None:
+            try:
+                weakref.finalize(owner, self._release_owner_id, id(owner))
+            except TypeError:
+                pass
+        self._push(subsystem)
+        return need
+
+    def alloc(self, subsystem: str, fn: Callable[[], Any], *,
+              owner: Any = None, tag: str = "",
+              priority: int = PRI_CACHE,
+              reclaim: Callable[[int], int] | None = None) -> Any:
+        """Reclaim-then-retry allocation: the one call serving code
+        wraps its persist-point allocations in (gofrlint GL202 accepts
+        it as the accounting API). Leases the thunk's ``eval_shape``
+        size when a budget is set, runs the thunk, and on a REAL
+        device OOM (:func:`is_oom_error`) runs demand-driven reclaim
+        and retries ONCE; a second failure raises
+        :class:`HBMExhausted` (ruling the 429/RESOURCE_EXHAUSTED shed
+        path) instead of letting the raw runtime error escape. The
+        result is accounted under ``(subsystem, owner, tag)``. A
+        failed allocation rolls the reservation back to the key's
+        pre-lease state — no phantom bytes stay registered eating
+        headroom for a buffer that never materialized."""
+        key = (subsystem, id(owner) if owner is not None else 0, tag)
+        with self._mu:
+            had = key in self._entries
+            prior_bytes = self._entries.get(key)
+            prior_meta = self._meta.get(key)
+
+        def rollback() -> None:
+            with self._mu:
+                if had:
+                    self._entries[key] = prior_bytes
+                    if prior_meta is not None:
+                        self._meta[key] = prior_meta
+                    else:
+                        self._meta.pop(key, None)
+                else:
+                    self._entries.pop(key, None)
+                    self._meta.pop(key, None)
+            self._push(subsystem)
+
+        need = _estimate_nbytes(fn) if self._budget else 0
+        self.lease(subsystem, need, owner=owner, tag=tag,
+                   priority=priority, reclaim=reclaim)
+        try:
+            tree = fn()
+        except BaseException as e:
+            if not is_oom_error(e):
+                rollback()
+                raise
+            with self._mu:
+                self._oom_retries[subsystem] = \
+                    self._oom_retries.get(subsystem, 0) + 1
+            # with no budget configured the lease skipped the size
+            # estimate — compute it NOW so the reclaim pass frees the
+            # allocation's worth, not one token byte (a real OOM is
+            # exactly the no-budget regime's enforcement path). NB
+            # eval_shape traces the thunk abstractly: pure allocation
+            # thunks (the contract) are side-effect free under it
+            self._reclaim(max(need or _estimate_nbytes(fn), 1),
+                          requester=subsystem)
+            try:
+                tree = fn()
+            except BaseException as e2:
+                rollback()
+                if is_oom_error(e2):
+                    self.note_shed(subsystem)
+                    raise HBMExhausted(subsystem, need,
+                                       budget=self._budget) from e2
+                raise
+        return self.account(subsystem, tree, owner=owner, tag=tag)
+
+    def check(self, subsystem: str) -> None:
+        """Zero-byte lease for request-path admission points (the
+        generation loop calls it once per admission): fires the
+        ``HBM_ALLOC`` seam and, when the process sits OVER its budget
+        (budget lowered at runtime, or actuals outgrew estimates),
+        runs reclaim and raises :class:`HBMExhausted` if the overshoot
+        survives — the caller sheds THAT request and keeps serving."""
+        self._fire_seam(subsystem, 0)
+        b = self._budget
+        if not b:
+            return
+        with self._mu:
+            in_use = self._in_use_locked()
+        if in_use > b:
+            self._reclaim(in_use - b, requester=subsystem)
+            with self._mu:
+                in_use = self._in_use_locked()
+            if in_use > b:
+                self.note_shed(subsystem)
+                raise HBMExhausted(subsystem, 0, budget=b, in_use=in_use)
+
+    def _fire_seam(self, subsystem: str, nbytes: int) -> None:
+        try:
+            chaos.fire(chaos.HBM_ALLOC)
+        except BaseException as e:
+            if is_oom_error(e):
+                # an injected allocation failure models one that
+                # survived retry: exercise the reclaim machinery (the
+                # recovery coverage the schedule is reproducing), then
+                # shed deterministically
+                self._reclaim(max(int(nbytes), 1), requester=subsystem)
+                self.note_shed(subsystem)
+                raise HBMExhausted(subsystem, nbytes,
+                                   budget=self._budget) from e
+            raise
+
+    def reclaim(self, nbytes: int | None = None) -> int:
+        """Manually run one demand-driven reclaim pass for ``nbytes``.
+        ``None`` asks EVERY registered reclaimer (need = the sum of
+        all reclaimable leases): the last-ditch form the batcher's OOM
+        retry uses, where the transient deficit is unknowable and the
+        alternative is shedding the whole batch. Returns bytes freed;
+        lease/alloc/check run sized passes implicitly."""
+        if nbytes is None:
+            with self._mu:
+                need = sum(self._entries.get(k, 0)
+                           for k in self._meta
+                           if self._meta[k][1] is not None) or 1
+        else:
+            need = max(int(nbytes), 1)
+        return self._reclaim(need, requester="manual")
+
+    def _wrap_reclaim(self, cb):
+        if cb is None:
+            return None
+        try:
+            return weakref.WeakMethod(cb)
+        except TypeError:
+            return cb  # plain function/lambda: held strongly
+
+    def _deref_reclaim(self, wrapped):
+        if isinstance(wrapped, weakref.WeakMethod):
+            return wrapped()
+        return wrapped
+
+    def _reclaim(self, need: int, requester: str = "") -> int:
+        """Run registered reclaim callbacks, highest priority class
+        first (PRI_SCRATCH before PRI_CACHE before PRI_SERVING), until
+        ``need`` bytes are freed or the candidates run out.
+        Single-flight: a pass already in progress makes this a no-op
+        returning 0 (the concurrent requester re-checks the budget
+        as-is)."""
+        if not self._reclaim_mu.acquire(blocking=False):
+            return 0
+        try:
+            with self._mu:
+                candidates = sorted(
+                    ((key, meta) for key, meta in self._meta.items()
+                     if meta[1] is not None),
+                    key=lambda kv: (-kv[1][0],
+                                    -self._entries.get(kv[0], 0)))
+            freed = 0
+            for key, (_, wrapped) in candidates:
+                if freed >= need:
+                    break
+                cb = self._deref_reclaim(wrapped)
+                if cb is None:
+                    with self._mu:  # owner died: drop the dead callback
+                        self._meta.pop(key, None)
+                    continue
+                try:
+                    got = int(cb(need - freed) or 0)
+                except Exception:
+                    got = 0  # a failing reclaimer must never take the
+                    # requesting allocation down with it
+                if got > 0:
+                    freed += got
+                    sub = key[0]
+                    with self._mu:
+                        self._reclaims[sub] = self._reclaims.get(sub, 0) + 1
+                        self._reclaimed_bytes += got
+                    self._count_metric(RECLAIMS_COUNTER, subsystem=sub)
+                    self._event_timeline(sub, "reclaim", got)
+            return freed
+        finally:
+            self._reclaim_mu.release()
+
+    def note_shed(self, subsystem: str) -> None:
+        """Record one request degraded (429/RESOURCE_EXHAUSTED) because
+        the arbiter could not cover an allocation:
+        ``app_tpu_hbm_shed_total{subsystem=}`` plus a timeline instant
+        on the subsystem's ``hbm:*`` track. Every :class:`HBMExhausted`
+        raise site in this module counts itself — a caller that merely
+        catches and re-routes one must NOT count it again (the
+        batcher's own persistent-OOM shed, which raises a plain
+        TooManyRequests, is the one external caller)."""
+        with self._mu:
+            self._sheds[subsystem] = self._sheds.get(subsystem, 0) + 1
+        self._count_metric(SHED_COUNTER, subsystem=subsystem)
+        self._event_timeline(subsystem, "shed", 0)
+
+    def arbiter_stats(self) -> dict:
+        """The lease/reclaim table: budget, in-use, per-lease rows
+        (subsystem/tag/bytes/priority/reclaimable), and the reclaim/
+        shed/retry counters — what /debug/vars, health_check and
+        tools/hbm_report.py render."""
+        with self._mu:
+            entries = dict(self._entries)
+            meta = dict(self._meta)
+            reclaims = dict(self._reclaims)
+            sheds = dict(self._sheds)
+            retries = dict(self._oom_retries)
+            reclaimed = self._reclaimed_bytes
+        in_use = sum(entries.values())
+        pri_names = {PRI_SERVING: "serving", PRI_CACHE: "cache",
+                     PRI_SCRATCH: "scratch"}
+        leases = []
+        for (sub, oid, tag), n in sorted(entries.items()):
+            pri, cb = meta.get((sub, oid, tag), (PRI_CACHE, None))
+            leases.append({
+                "subsystem": sub, "owner": oid, "tag": tag, "bytes": n,
+                "priority": pri_names.get(pri, str(pri)),
+                "reclaimable": self._deref_reclaim(cb) is not None,
+            })
+        return {
+            "budget_bytes": self._budget,
+            "in_use_bytes": in_use,
+            "headroom_bytes": (self._budget - in_use
+                               if self._budget else None),
+            "leases": leases,
+            "reclaims": reclaims,
+            "reclaimed_bytes": reclaimed,
+            "sheds": sheds,
+            "oom_retries": retries,
+        }
+
+    # -- fan-out sinks -------------------------------------------------------
     def set_metrics(self, metrics: Any) -> None:
         """Attach a metrics Manager (weakly held; every attached
         Manager receives every later change as
@@ -157,19 +619,30 @@ class _Registry:
         self._sinks.add(metrics)
         for sub in self.live_bytes():
             self._push(sub)
+        try:
+            metrics.set_gauge(BUDGET_GAUGE, float(self._budget or 0))
+        except Exception:
+            pass
 
     def reset(self) -> None:
-        """Test hook: forget everything (and zero pushed gauges)."""
+        """Test hook: forget everything — entries, leases, budget,
+        counters (and zero pushed gauges)."""
         with self._mu:
             subs = {sub for (sub, _, _) in self._entries}
             self._entries.clear()
+            self._meta.clear()
+            self._reclaims.clear()
+            self._sheds.clear()
+            self._oom_retries.clear()
+            self._reclaimed_bytes = 0
+        self.set_budget(None)
         for sub in subs:
             self._push(sub)
 
     def set_timeline(self, timeline: Any) -> None:
         """Attach a serving timeline (weakly held) that receives an
-        ``hbm`` counter sample on every accounting change. ``None``
-        detaches all timelines."""
+        ``hbm`` counter sample on every accounting change plus
+        reclaim/shed instants. ``None`` detaches all timelines."""
         if timeline is None:
             self._timelines.clear()
             return
@@ -177,6 +650,23 @@ class _Registry:
         for sub, n in self.live_bytes().items():
             try:
                 timeline.hbm(sub, float(n))
+            except Exception:
+                pass
+
+    def _count_metric(self, name: str, **labels) -> None:
+        for m in list(self._sinks):
+            try:
+                m.increment_counter(name, **labels)
+            except Exception:
+                pass  # accounting must never take the serving path down
+
+    def _event_timeline(self, subsystem: str, what: str,
+                        nbytes: int) -> None:
+        for tl in list(self._timelines):
+            try:
+                fn = getattr(tl, "hbm_event", None)
+                if fn is not None:
+                    fn(subsystem, what, float(nbytes))
             except Exception:
                 pass
 
@@ -201,9 +691,18 @@ class _Registry:
 _registry = _Registry()
 
 account = _registry.account
-release = _registry.release
+alloc = _registry.alloc
+arbiter_stats = _registry.arbiter_stats
+budget = _registry.budget
+check = _registry.check
+configure = _registry.configure
+lease = _registry.lease
 live_bytes = _registry.live_bytes
-snapshot = _registry.snapshot
+note_shed = _registry.note_shed
+reclaim = _registry.reclaim
+release = _registry.release
+reset = _registry.reset
+set_budget = _registry.set_budget
 set_metrics = _registry.set_metrics
 set_timeline = _registry.set_timeline
-reset = _registry.reset
+snapshot = _registry.snapshot

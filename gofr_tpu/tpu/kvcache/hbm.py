@@ -65,12 +65,30 @@ class HBMTier:
         self.touch(entry)
         return row, victim
 
+    def entries(self) -> list[Entry]:
+        """The live entries (arbitrary order) — the arbiter's pool
+        shrink reads them to spill each entry's row to the host tier
+        before the pool is reallocated smaller."""
+        return [e for e in self._rows if e is not None]
+
     def clear(self) -> int:
         """Drop every entry — engine recovery calls this after
         reallocating the side pool (stored keys would otherwise match
         prompts against zeroed rows and restore all-zero KV)."""
         n = len(self)
         self.index.clear()
+        self._rows = [None] * self.slots
+        return n
+
+    def resize(self, slots: int) -> int:
+        """Shrink (or regrow) the row table to ``slots``, dropping
+        EVERY entry — the HBM arbiter's reclaim path reallocates the
+        pool itself, so surviving row indices would point into a dead
+        buffer. Callers spill entries to the host tier first; returns
+        the number dropped."""
+        n = len(self)
+        self.index.clear()
+        self.slots = max(1, int(slots))
         self._rows = [None] * self.slots
         return n
 

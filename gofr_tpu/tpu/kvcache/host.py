@@ -30,6 +30,11 @@ class HostTier:
         self._tick = 0
         self.bytes = 0
         self.evictions = 0
+        # successful spills accepted from T0 (LRU eviction AND the
+        # arbiter's pool shrink both land here): the counter that
+        # shows "shrink T0 toward the host tier" actually moved KV
+        # down a tier instead of dropping it
+        self.spills = 0
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -68,6 +73,7 @@ class HostTier:
         self.index.insert(entry)
         self._entries[entry.eid] = entry
         self.bytes += need
+        self.spills += 1
         self.touch(entry)
         return True
 
@@ -98,4 +104,5 @@ class HostTier:
 
     def stats(self) -> dict:
         return {"entries": len(self), "bytes": self.bytes,
-                "max_bytes": self.max_bytes, "evictions": self.evictions}
+                "max_bytes": self.max_bytes, "evictions": self.evictions,
+                "spills": self.spills}

@@ -220,6 +220,18 @@ class CacheManager:
             return 0
         return self.redis.put(np.asarray(key, np.int32), adapter, kv)
 
+    def shrink(self, new_slots: int) -> int:
+        """HBM-arbiter reclaim: resize T0 to ``new_slots`` rows,
+        dropping every entry (the caller — the engine's pool-reclaim
+        callback — spills each entry's row to the host tier first,
+        then reallocates the pool itself at the new size). The version
+        bump drops memoized match verdicts that referenced dead rows;
+        future hits rewarm from T1/T2 exactly like post-recovery."""
+        self.version += 1
+        n = self.t0.resize(new_slots)
+        self._gauges()
+        return n
+
     def clear_device(self) -> int:
         """Recovery: the pool was reallocated, so T0 entries point at
         zeroed rows — drop them. T1 snapshots and T2 blocks are device-

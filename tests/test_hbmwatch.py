@@ -87,6 +87,12 @@ def test_session_fails_on_seeded_leak_and_passes_after_fix(tmp_path):
 # -- unit layer ---------------------------------------------------------------
 
 def test_live_device_bytes_sees_new_arrays():
+    import gc
+
+    # collect FIRST: cyclic garbage from earlier tests (engine object
+    # graphs) freeing between the two raw reads would shrink the live
+    # set and mask the new array's growth
+    gc.collect()
     base = live_device_bytes()
     a = jnp.zeros((50_000,), jnp.float32)
     assert live_device_bytes() >= base + a.nbytes

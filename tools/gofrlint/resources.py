@@ -24,8 +24,9 @@ device allocations: a ``jnp.zeros/ones/full/empty[_like]``,
 ``init_paged_cache`` / ``init_lora``) whose result is PERSISTED on the
 instance (assigned to ``self.X`` directly, or via locals that later
 flow into a ``self.X`` assignment) without flowing through the
-accounting API (a ``hbm.account(...)`` wrapping the allocation or its
-local). Transient allocations that die with the function are not
+accounting API (an ``hbm.account(...)`` or ``hbm.alloc(...)`` —
+the arbiter's reclaim-then-retry lease form — wrapping the allocation
+or its local). Transient allocations that die with the function are not
 flagged — persistent buffers are exactly the arbiter's future lease
 targets, and an allocation the registry cannot see is capacity the
 arbiter cannot rebalance (the RESOURCE_EXHAUSTED cascade in
@@ -43,7 +44,8 @@ RESOURCE_EXHAUSTED.
 
 GL204 — scope ``gofr_tpu/``. Fail-open OOM handling: an ``except`` arm
 that names an OOM-class exception (``XlaRuntimeError``,
-``ResourceExhausted*``, ``OutOfMemory*``) — or string-matches
+``ResourceExhausted*``, ``OutOfMemory*``, the arbiter's
+``HBMExhausted``) — or string-matches
 ``RESOURCE_EXHAUSTED`` / ``out of memory`` inside a generic handler —
 and neither re-raises nor routes to the admission-shed path
 (``raise``, a ``*shed*``/``*admit*`` call, ``TooManyRequests``).
@@ -65,8 +67,23 @@ _ALLOC_JNP = {"zeros", "ones", "full", "empty",
               "zeros_like", "ones_like", "full_like", "empty_like"}
 _ALLOC_ANY = {"device_put"}
 _ALLOC_SUBSTR = ("init_cache", "init_paged_cache", "init_lora")
-# the declared accounting API (gofr_tpu/tpu/hbm.py)
+# the declared accounting API (gofr_tpu/tpu/hbm.py): account() records
+# post-hoc; alloc()/lease() are the arbiter's budgeted forms (lease +
+# reclaim-then-retry + account) — those two match only as QUALIFIED
+# hbm.alloc/hbm.lease (see _is_account_call): "alloc" is far too
+# generic a method name to bless bare (the paged engine's block
+# allocator is literally self._alloc.alloc)
 _ACCOUNT_FNS = {"account"}
+_ARBITER_FNS = {"alloc", "lease"}
+
+
+def _is_account_call(func) -> bool:
+    last = _callee_last(func)
+    if last in _ACCOUNT_FNS:
+        return True
+    if last in _ARBITER_FNS:
+        return _callee_root(func) == "hbm"
+    return False
 # attribute reads that survive donation (metadata lives on the aval)
 _META_ATTRS = {"shape", "dtype", "ndim", "size", "nbytes", "sharding",
                "quantized"}
@@ -80,7 +97,8 @@ _SHRINK_CALLS = {"pop", "popitem", "popleft", "remove", "discard",
                  "clear"}
 _CONTAINER_CTORS = {"list", "dict", "set", "deque", "defaultdict",
                     "OrderedDict", "Counter"}
-_OOM_TYPE_SUBSTR = ("XlaRuntimeError", "ResourceExhausted", "OutOfMemory")
+_OOM_TYPE_SUBSTR = ("XlaRuntimeError", "ResourceExhausted", "OutOfMemory",
+                    "HBMExhausted")
 _OOM_STR_RE = re.compile(r"RESOURCE_EXHAUSTED|out of memory",
                          re.IGNORECASE)
 _SHED_SUBSTR = ("shed", "admit", "TooManyRequests")
@@ -390,8 +408,7 @@ def _account_wraps(stmt: ast.stmt, node: ast.Call) -> bool:
     """Is ``node`` (an allocation) nested inside an account(...) call
     within its own statement?"""
     for n in ast.walk(stmt):
-        if isinstance(n, ast.Call) and \
-                _callee_last(n.func) in _ACCOUNT_FNS:
+        if isinstance(n, ast.Call) and _is_account_call(n.func):
             if any(sub is node for sub in ast.walk(n)):
                 return True
     return False
@@ -507,7 +524,7 @@ class ResourcePass:
                 continue
             for n in ast.walk(value):
                 if isinstance(n, ast.Call) and \
-                        _callee_last(n.func) in _ACCOUNT_FNS and any(
+                        _is_account_call(n.func) and any(
                             isinstance(sub, ast.Name)
                             and sub.id in derived
                             for sub in ast.walk(n)):

@@ -1,28 +1,42 @@
 #!/usr/bin/env python
-"""Device-memory attribution report: who holds how much HBM.
+"""Device-memory attribution + arbiter report: who holds how much HBM,
+under what lease, and how the arbiter behaves under pressure.
 
-Renders the hbm accounting registry (``gofr_tpu/tpu/hbm.py`` — the
-table every GL202-checked allocation flows through) against
-``jax.live_arrays()`` ground truth. Two modes:
+Renders the hbm accounting/arbiter registry (``gofr_tpu/tpu/hbm.py`` —
+the table every GL202-checked allocation flows through, now the lease
+book of the memory arbiter) against ``jax.live_arrays()`` ground
+truth. Three modes:
 
   - attach mode (default when subsystems already accounted bytes in
     this process — e.g. imported from a notebook/REPL next to a live
-    engine): report what the registry holds right now;
+    engine): report what the registry holds right now, including the
+    live lease/reclaim table;
   - demo mode (the common CLI case, or ``--demo``): build a tiny CPU
     GenerationEngine with a prefix pool, serve a few requests, report
     with the engine live, then close it and report again — showing the
     release path works (the same reconciliation ``pytest --hbmwatch``
-    gates on).
+    gates on);
+  - pressure mode (``--pressure``, the CI smoke arm with ``--smoke``):
+    the memory-pressure acceptance run. One process, a deliberately
+    tiny synthetic budget, a contiguous engine with prefix cache
+    (T0 + host T1) PLUS a paged engine with spec decode: constructing
+    the second engine must force the arbiter to shrink the first's T0
+    pool (leases rebalance), a mixed workload under a seeded
+    ``HBM_ALLOC`` storm must produce ONLY served 429 sheds (zero
+    process deaths, zero non-shed errors, bounded shed rate), and
+    post-storm serving must return token-exact and leak-flat. A
+    passing full run commits ``HBM_BENCH.json``.
 
 CPU-only by default (JAX_PLATFORMS honored if already set): the point
-is attribution plumbing, not chip numbers — no chip lock taken.
-Stdout contract (tools/README.md): the LAST line is the JSON
-artifact; earlier lines are the human-readable table on stderr/stdout.
+is attribution/arbitration plumbing, not chip numbers — no chip lock
+taken. Stdout contract (tools/README.md): the LAST line is the JSON
+artifact; earlier lines are the human-readable tables on stderr.
 """
 
 from __future__ import annotations
 
 import argparse
+import gc
 import json
 import os
 import sys
@@ -46,11 +60,206 @@ def table(att: dict) -> str:
     return "\n".join(rows)
 
 
+def lease_table(arb: dict) -> str:
+    """The arbiter's live lease/reclaim book, human-shaped."""
+    b = arb["budget_bytes"]
+    rows = [f"  budget: {b if b is not None else '(off)'}  "
+            f"in_use: {arb['in_use_bytes']}  "
+            f"headroom: {arb['headroom_bytes']}"]
+    rows.append(f"  {'subsystem':<12} {'tag':<8} {'bytes':>12} "
+                f"{'priority':<8} reclaimable")
+    for ls in arb["leases"]:
+        rows.append(f"  {ls['subsystem']:<12} {ls['tag'] or '-':<8} "
+                    f"{ls['bytes']:>12} {ls['priority']:<8} "
+                    f"{'yes' if ls['reclaimable'] else 'no'}")
+    if arb["reclaims"] or arb["sheds"] or arb["oom_retries"]:
+        rows.append(f"  reclaims={arb['reclaims']} "
+                    f"(freed {arb['reclaimed_bytes']}B) "
+                    f"sheds={arb['sheds']} retries={arb['oom_retries']}")
+    return "\n".join(rows)
+
+
+def _tiny_params():
+    import jax
+
+    from gofr_tpu.models import LLAMA_CONFIGS, llama
+
+    cfg = LLAMA_CONFIGS["tiny"]
+    return cfg, llama.init(cfg, jax.random.PRNGKey(0))
+
+
+def run_demo(requests: int, artifact: dict) -> None:
+    import numpy as np
+
+    from gofr_tpu.testutil.hbmwatch import attribution
+    from gofr_tpu.tpu import GenerationEngine
+
+    cfg, params = _tiny_params()
+    log("hbm_report: demo mode — tiny engine + prefix pool, "
+        f"{requests} request(s)")
+    eng = GenerationEngine(cfg, params,
+                           slots=2, max_seq=128,
+                           prompt_buckets=(16, 32),
+                           prefix_cache_slots=2,
+                           prefix_store_min=16)
+    try:
+        rng = np.random.default_rng(0)
+        for _ in range(max(1, requests)):
+            prompt = rng.integers(1, cfg.vocab_size, size=24)
+            eng.generate(prompt, max_new_tokens=4).tokens()
+        att_live = attribution()
+        log("attribution with engine live:")
+        log(table(att_live))
+        from gofr_tpu.tpu import hbm
+
+        log("arbiter lease table:")
+        log(lease_table(hbm.arbiter_stats()))
+        artifact["serving"] = att_live
+        artifact["arbiter"] = hbm.arbiter_stats()
+    finally:
+        eng.close()
+    del eng
+
+    gc.collect()  # freed buffers must not read as live
+    att_closed = attribution()
+    log("attribution after close():")
+    log(table(att_closed))
+    artifact["after_close"] = att_closed
+    artifact["released_ok"] = not att_closed["accounted"]
+
+
+def run_pressure(smoke: bool, artifact: dict) -> None:
+    """Constrained budget + mixed workload + seeded HBM_ALLOC storm.
+    Gate: zero process deaths / non-shed errors, leases rebalanced
+    (T0 shrank, paged constructed), bounded shed rate, post-storm
+    token-exact and leak-flat."""
+    import numpy as np
+
+    from gofr_tpu import chaos
+    from gofr_tpu.errors import TooManyRequests
+    from gofr_tpu.testutil.hbmwatch import live_device_bytes
+    from gofr_tpu.tpu import GenerationEngine, hbm
+    from gofr_tpu.tpu.kvcache import KVCacheOptions
+
+    cfg, params = _tiny_params()
+    storm_n = 16 if smoke else 48
+    rng = np.random.default_rng(7)
+
+    def mk_prompt(n=24):
+        return rng.integers(1, cfg.vocab_size, size=n)
+
+    def contiguous():
+        return GenerationEngine(cfg, params, slots=2, max_seq=128,
+                                prompt_buckets=(16, 32),
+                                prefix_cache_slots=4, prefix_store_min=16,
+                                kvcache=KVCacheOptions(host_mb=8))
+
+    def paged():
+        return GenerationEngine(cfg, params, slots=2, max_seq=128,
+                                prompt_buckets=(16, 32), paged_blocks=12,
+                                paged_block_size=16, spec_decode_k=2)
+
+    hbm.reset()
+    log(f"hbm_report: pressure mode — storm of {storm_n} mixed requests "
+        "over contiguous(prefix T0+T1) + paged(spec) under a tiny budget")
+    a = contiguous()
+    p_a, p_b = mk_prompt(), mk_prompt(20)
+    ref_a = a.generate(p_a, max_new_tokens=6).tokens()
+    bytes_a = sum(hbm.live_bytes().values())
+    pool_bytes = hbm.live_bytes()["kvcache-t0"]
+    b_ref = paged()
+    ref_b = b_ref.generate(p_b, max_new_tokens=6).tokens()
+    bytes_b = sum(hbm.live_bytes().values()) - bytes_a
+    b_ref.close()
+    gc.collect()
+
+    # budget that fits A + B only if A's T0 gives up ~half its rows
+    row_b = pool_bytes // 4
+    budget = bytes_a + bytes_b - 2 * row_b + row_b // 2
+    hbm.set_budget(budget)
+    slots_before = a._kvc.slots
+    a.generate(p_a, max_new_tokens=6).tokens()  # rewarm T0
+    b = paged()
+    rebalanced = a._kvc.slots < slots_before
+    log(f"leases rebalanced: t0 slots {slots_before} -> {a._kvc.slots}, "
+        f"budget {budget}")
+    log(lease_table(hbm.arbiter_stats()))
+
+    counts = {"ok": 0, "shed": 0, "other": 0}
+    sched = chaos.ChaosSchedule(seed=42).on(
+        chaos.HBM_ALLOC, error=chaos.ResourceExhausted, p=0.3)
+    live_before = live_device_bytes()
+    with chaos.scope(sched):
+        for i in range(storm_n):
+            eng = a if i % 2 == 0 else b
+            try:
+                eng.generate(mk_prompt(16 + 4 * (i % 3)),
+                             max_new_tokens=4).tokens()
+                counts["ok"] += 1
+            except TooManyRequests:
+                counts["shed"] += 1  # the ONLY acceptable failure
+            except Exception as e:  # process must never die: record it
+                counts["other"] += 1
+                log(f"UNEXPECTED error class: {e!r}")
+    alive = a.down is None and b.down is None
+    # post-storm steady state: token-exact on both engines, leak-flat
+    post_a = a.generate(p_a, max_new_tokens=6).tokens()
+    post_b = b.generate(p_b, max_new_tokens=6).tokens()
+    gc.collect()
+    live_after = live_device_bytes()
+    shed_rate = counts["shed"] / max(1, storm_n)
+    # one seam fire per admission, sequential requests: the shed count
+    # must REPRODUCE the seeded schedule exactly — the same
+    # determinism contract the chaos smoke pins with its digest diff
+    expected_sheds = sum(f for f, _ in
+                         sched.decisions(chaos.HBM_ALLOC, storm_n))
+    checks = {
+        "rebalanced_t0_shrank": rebalanced,
+        "zero_process_deaths": alive,
+        "zero_non_shed_errors": counts["other"] == 0,
+        "some_sheds_observed": counts["shed"] > 0,
+        "sheds_match_schedule": counts["shed"] == expected_sheds,
+        "bounded_shed_rate": shed_rate <= 0.6,  # p=0.3 + seed variance
+        "post_storm_token_exact": post_a == ref_a and post_b == ref_b,
+        # tolerance: jit-constant noise, not per-request growth
+        "leak_flat": live_after - live_before <= 4 << 20,
+    }
+    arb = hbm.arbiter_stats()
+    log(f"storm counts: {counts}  shed_rate={shed_rate:.2f}")
+    log(f"arbiter after storm: reclaims={arb['reclaims']} "
+        f"sheds={arb['sheds']}")
+    log("checks: " + ", ".join(f"{k}={v}" for k, v in checks.items()))
+    slots_after = a._kvc.slots
+    a.close()
+    b.close()
+    hbm.reset()
+    artifact.update({
+        "bench": "hbm_pressure",
+        "smoke": smoke,
+        "budget_bytes": budget,
+        "t0_slots": {"before": slots_before, "after": slots_after},
+        "counts": counts,
+        "shed_rate": round(shed_rate, 4),
+        "schedule_digest": sched.digest(),
+        "arbiter": {"reclaims": arb["reclaims"], "sheds": arb["sheds"],
+                    "reclaimed_bytes": arb["reclaimed_bytes"]},
+        "checks": checks,
+        "ok": all(checks.values()),
+    })
+
+
 def main(argv: list[str] | None = None) -> int:
-    ap = argparse.ArgumentParser(description="HBM attribution report")
+    ap = argparse.ArgumentParser(
+        description="HBM attribution + arbiter report")
     ap.add_argument("--demo", action="store_true",
                     help="force the tiny-engine demo even if the "
                          "registry already has entries")
+    ap.add_argument("--pressure", action="store_true",
+                    help="memory-pressure acceptance run: constrained "
+                         "budget, mixed workload, seeded HBM_ALLOC "
+                         "storm; gate = zero deaths + bounded sheds")
+    ap.add_argument("--smoke", action="store_true",
+                    help="shorter pressure storm (CI)")
     ap.add_argument("--requests", type=int, default=3)
     args = ap.parse_args(argv)
 
@@ -58,47 +267,21 @@ def main(argv: list[str] | None = None) -> int:
     from gofr_tpu.tpu import hbm
 
     artifact: dict = {"tool": "hbm_report"}
+    if args.pressure:
+        run_pressure(args.smoke, artifact)
+        print(json.dumps(artifact))
+        return 0 if artifact.get("ok") else 1
     demo = args.demo or not hbm.live_bytes()
     if demo:
-        import jax
-        import numpy as np
-
-        from gofr_tpu.models import LLAMA_CONFIGS, llama
-        from gofr_tpu.tpu import GenerationEngine
-
-        log("hbm_report: demo mode — tiny engine + prefix pool, "
-            f"{args.requests} request(s)")
-        cfg = LLAMA_CONFIGS["tiny"]
-        eng = GenerationEngine(cfg, llama.init(cfg, jax.random.PRNGKey(0)),
-                               slots=2, max_seq=128,
-                               prompt_buckets=(16, 32),
-                               prefix_cache_slots=2,
-                               prefix_store_min=16)
-        try:
-            rng = np.random.default_rng(0)
-            for _ in range(max(1, args.requests)):
-                prompt = rng.integers(1, cfg.vocab_size, size=24)
-                eng.generate(prompt, max_new_tokens=4).tokens()
-            att_live = attribution()
-            log("attribution with engine live:")
-            log(table(att_live))
-            artifact["serving"] = att_live
-        finally:
-            eng.close()
-        del eng
-        import gc
-
-        gc.collect()  # freed buffers must not read as live
-        att_closed = attribution()
-        log("attribution after close():")
-        log(table(att_closed))
-        artifact["after_close"] = att_closed
-        artifact["released_ok"] = not att_closed["accounted"]
+        run_demo(args.requests, artifact)
     else:
         att = attribution()
         log("attribution (attach mode):")
         log(table(att))
+        log("arbiter lease table:")
+        log(lease_table(hbm.arbiter_stats()))
         artifact["serving"] = att
+        artifact["arbiter"] = hbm.arbiter_stats()
     print(json.dumps(artifact))
     return 0
 
