@@ -172,17 +172,24 @@ def init_backend(retries: int = 4, backoff_s: float = 20.0):
 
     import jax
 
-    if "--cpu" in sys.argv[1:] or os.environ.get("GOFR_BENCH_CPU"):
+    cpu = "--cpu" in sys.argv[1:] or bool(os.environ.get("GOFR_BENCH_CPU"))
+    if cpu:
         jax.config.update("jax_platforms", "cpu")
-    try:
-        # persistent compile cache: each section child re-traces the same
-        # programs; without this every child pays full XLA compiles
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                                         "/tmp/gofr_jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception:
-        pass  # older jax / backend without executable serialization
+    else:
+        try:
+            # persistent compile cache: each section child re-traces the
+            # same programs; without this every child pays full XLA
+            # compiles. TPU-path only: this container's XLA segfaults
+            # deserializing CPU executables written by a sibling
+            # process, and CPU compiles of the tiny structural configs
+            # are cheap anyway.
+            jax.config.update("jax_compilation_cache_dir",
+                              os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                             "/tmp/gofr_jax_cache"))
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.5)
+        except Exception:
+            pass  # older jax / backend without executable serialization
 
     done = threading.Event()
     budget = float(os.environ.get("GOFR_BENCH_INIT_BUDGET_S", "600"))
@@ -681,7 +688,8 @@ def bench_ttft(cfg, *, slots: int, probe_lens=(128, 256, 512),
 
 
 def bench_engine(cfg, *, slots: int = 48, new_tokens: int = 96,
-                 max_seq: int = 256, paged_blocks: int = 0) -> dict:
+                 max_seq: int = 256, paged_blocks: int = 0,
+                 engine=None) -> dict:
     """Throughput through the FULL serving stack — engine loop,
     admission, fused decode blocks, host delivery — not just raw steps:
     fill every slot with a stream, wall-clock all tokens out. The gap to
@@ -690,17 +698,24 @@ def bench_engine(cfg, *, slots: int = 48, new_tokens: int = 96,
 
     ``paged_blocks > 0`` runs the same workload over the paged engine —
     the serving-stack sibling of bench_paged_decode's raw-step number,
-    at slot counts the contiguous cache cannot hold."""
+    at slot counts the contiguous cache cannot hold.
+
+    ``engine``: drive a caller-built engine instead (the one-process
+    arms run builds each arm from its config rows); the caller keeps
+    ownership and closes it."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from gofr_tpu.tpu import GenerationEngine
 
-    params = int8_random_params(cfg, jax.random.PRNGKey(0))
-    engine = GenerationEngine(cfg, params, slots=slots, max_seq=max_seq,
-                              prompt_buckets=(32,), kv_dtype=jnp.int8,
-                              decode_block=8, paged_blocks=paged_blocks)
+    owns = engine is None
+    if owns:
+        params = int8_random_params(cfg, jax.random.PRNGKey(0))
+        engine = GenerationEngine(cfg, params, slots=slots, max_seq=max_seq,
+                                  prompt_buckets=(32,), kv_dtype=jnp.int8,
+                                  decode_block=8, paged_blocks=paged_blocks)
+    slots = engine.n_slots
     rng = np.random.default_rng(2)
     try:
         engine.warmup()
@@ -712,33 +727,47 @@ def bench_engine(cfg, *, slots: int = 48, new_tokens: int = 96,
         total = sum(len(s.tokens()) for s in streams)
         dt = time.perf_counter() - t0
         out = {"tok_s": total / dt, "tokens": total}
+        pipe = engine.stats()["scheduler"]["pipeline"]
+        out["gap_p50_ms"] = pipe["gap_p50_ms"]
+        out["overlapped_reaps"] = pipe["overlapped_reaps"]
+        out["reaps"] = pipe["reaps"]
         log(f"  engine throughput: {total} tokens in {dt:.2f}s -> "
             f"{out['tok_s']:.0f} tok/s (slots={slots}, K=8, incl. "
-            f"admission+delivery)")
+            f"admission+delivery; gap p50 {pipe['gap_p50_ms']} ms, "
+            f"{pipe['overlapped_reaps']}/{pipe['reaps']} overlapped reaps)")
         return out
     finally:
-        engine.close()
+        if owns:
+            engine.close()
 
 
 def bench_spec_decode(cfg, *, slots: int = 32, k: int = 4,
-                      new_tokens: int = 96) -> dict:
+                      new_tokens: int = 96, engine=None) -> dict:
     """Speculative-decoding win on a repetitive greedy workload (the
     workload class prompt-lookup exists for: code, JSON, templated
     text). Every slot streams a strongly periodic prompt, so the verify
     pass emits multiple tokens per weight stream; the realized
     multiplier is stats()['spec_decode']['tokens_per_window'] and the
     wall-clock number is directly comparable to engine_tok_s (same
-    serving stack, same slot count scale)."""
+    serving stack, same slot count scale).
+
+    ``engine``: drive a caller-built engine (the one-process arms run
+    builds the spec arm from its TPU_SPEC_DECODE config row); caller
+    closes it."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from gofr_tpu.tpu import GenerationEngine
 
-    params = int8_random_params(cfg, jax.random.PRNGKey(0))
-    engine = GenerationEngine(cfg, params, slots=slots, max_seq=256,
-                              prompt_buckets=(32,), kv_dtype=jnp.int8,
-                              decode_block=8, spec_decode_k=k)
+    owns = engine is None
+    if owns:
+        params = int8_random_params(cfg, jax.random.PRNGKey(0))
+        engine = GenerationEngine(cfg, params, slots=slots, max_seq=256,
+                                  prompt_buckets=(32,), kv_dtype=jnp.int8,
+                                  decode_block=8, spec_decode_k=k)
+    slots = engine.n_slots
+    k = engine._spec_k or k
     rng = np.random.default_rng(3)
     try:
         engine.warmup()
@@ -760,11 +789,12 @@ def bench_spec_decode(cfg, *, slots: int = 32, k: int = 4,
             f"K={k})")
         return out
     finally:
-        engine.close()
+        if owns:
+            engine.close()
 
 
 def bench_prefix(cfg, *, prefix_len: int = 896, tail_len: int = 64,
-                 probes: int = 5) -> dict:
+                 probes: int = 5, engine=None) -> dict:
     """Prefix-KV-cache win, idle engine: first-token latency for a
     960-token prompt, cold (full chunked prefill) vs warm (the shared
     896-token prefix restores as one HBM row copy; only the final
@@ -776,11 +806,13 @@ def bench_prefix(cfg, *, prefix_len: int = 896, tail_len: int = 64,
 
     from gofr_tpu.tpu import GenerationEngine
 
-    params = int8_random_params(cfg, jax.random.PRNGKey(0))
-    engine = GenerationEngine(cfg, params, slots=4, max_seq=1024,
-                              prompt_buckets=(128, 256, 512),
-                              kv_dtype=jnp.int8, prefix_cache_slots=4,
-                              prefix_store_min=256)
+    owns = engine is None
+    if owns:
+        params = int8_random_params(cfg, jax.random.PRNGKey(0))
+        engine = GenerationEngine(cfg, params, slots=4, max_seq=1024,
+                                  prompt_buckets=(128, 256, 512),
+                                  kv_dtype=jnp.int8, prefix_cache_slots=4,
+                                  prefix_store_min=256)
     rng = np.random.default_rng(1)
     prefix = rng.integers(1, cfg.vocab_size, prefix_len).tolist()
     try:
@@ -810,7 +842,120 @@ def bench_prefix(cfg, *, prefix_len: int = 896, tail_len: int = 64,
             f"({st.get('hits', 0)} hits)")
         return {"miss_ms": miss, "hit_ms": hit}
     finally:
-        engine.close()
+        if owns:
+            engine.close()
+
+
+def engine_from_rows(cfg, params, rows: dict, defaults: dict | None = None):
+    """GenerationEngine from ``TPU_*`` config rows — the same keys
+    ``new_engine_from_config`` reads, so an arm definition IS a
+    deployable serving config (bench injects its int8 random weights in
+    place of TPU_WEIGHTS; everything else is the config row). This is
+    what makes the spec arm "a config, not a code path": its whole
+    definition is ``{"TPU_SPEC_DECODE": "4"}`` and the engine it builds
+    leases every device buffer (cache, spec state, prefix pool) from
+    the HBM arbiter exactly like production serving."""
+    import jax.numpy as jnp
+
+    from gofr_tpu.config import MapConfig
+    from gofr_tpu.tpu import GenerationEngine
+
+    c = MapConfig({**(defaults or {}), **rows})
+    buckets = tuple(int(b) for b in
+                    c.get_or_default("TPU_SEQ_BUCKETS", "32").split(","))
+    kv = jnp.int8 if c.get_or_default("TPU_KV_DTYPE", "int8") == "int8" \
+        else None
+    return GenerationEngine(
+        cfg, params,
+        slots=c.get_int("TPU_SLOTS", 48),
+        max_seq=c.get_int("TPU_MAX_SEQ", 256),
+        prompt_buckets=buckets,
+        kv_dtype=kv,
+        decode_block=c.get_int("TPU_DECODE_BLOCK", 8),
+        decode_pipeline=c.get_int("TPU_DECODE_PIPELINE", 2),
+        spec_decode_k=c.get_int("TPU_SPEC_DECODE", 0),
+        prefix_cache_slots=c.get_int("TPU_PREFIX_CACHE", 0),
+        prefix_store_min=c.get_int("TPU_PREFIX_MIN", 0) or None,
+        paged_blocks=c.get_int("TPU_PAGED_BLOCKS", 0),
+        paged_block_size=c.get_int("TPU_PAGED_BLOCK", 128))
+
+
+def bench_arms(cfg, *, slots: int = 48, paged_slots: int = 128) -> dict:
+    """Every serving arm in ONE process under the HBM arbiter — the run
+    the PR 10 arbiter was built for. The 2026-07-31 capture ran each
+    arm in its own child and prefix/engine/spec/paged all DIED with
+    RESOURCE_EXHAUSTED; with the arbiter, construction leases bytes
+    against one process budget (reclaim-then-retry, 429-shed on
+    overshoot), so the honest outcomes are per-arm ``ok`` or ``shed``
+    — never a process death.
+
+    Arms are config-row dicts interpreted by engine_from_rows; one
+    int8 weight set loads once and streams through every arm. Records
+    per-arm status + timing + the arbiter's final lease book."""
+    import jax
+
+    from gofr_tpu.tpu import hbm
+
+    small = jax.default_backend() == "cpu"  # structural run (dev / CI)
+    if small:
+        slots, paged_slots = 8, 8
+    new_tokens = 24 if small else 96
+    params = int8_random_params(cfg, jax.random.PRNGKey(0))
+    defaults = {"TPU_KV_DTYPE": "int8", "TPU_DECODE_BLOCK": "8"}
+    # the structural run's prompts must fit the tiny config's 128-token
+    # cache (max_seq clamps to the model's)
+    pfx_len, pfx_tail, pfx_probes = (80, 16, 2) if small else (896, 64, 5)
+    pfx_rows = ({"TPU_SLOTS": "4", "TPU_MAX_SEQ": "128",
+                 "TPU_SEQ_BUCKETS": "32,64", "TPU_PREFIX_CACHE": "4",
+                 "TPU_PREFIX_MIN": "64"} if small else
+                {"TPU_SLOTS": "4", "TPU_MAX_SEQ": "1024",
+                 "TPU_SEQ_BUCKETS": "128,256,512", "TPU_PREFIX_CACHE": "4",
+                 "TPU_PREFIX_MIN": "256"})
+    order = (
+        ("engine",
+         {"TPU_SLOTS": str(slots), "TPU_MAX_SEQ": "256",
+          "TPU_SEQ_BUCKETS": "32"},
+         lambda e: bench_engine(cfg, new_tokens=new_tokens, engine=e)),
+        ("spec",
+         {"TPU_SLOTS": str(min(32, slots)), "TPU_MAX_SEQ": "256",
+          "TPU_SEQ_BUCKETS": "32", "TPU_SPEC_DECODE": "4"},
+         lambda e: bench_spec_decode(cfg, new_tokens=new_tokens, engine=e)),
+        ("prefix", pfx_rows,
+         lambda e: bench_prefix(cfg, prefix_len=pfx_len,
+                                tail_len=pfx_tail, probes=pfx_probes,
+                                engine=e)),
+        ("paged_engine",
+         {"TPU_SLOTS": str(paged_slots), "TPU_MAX_SEQ": "256",
+          "TPU_SEQ_BUCKETS": "32",
+          "TPU_PAGED_BLOCKS": str(paged_slots + 15)},
+         lambda e: bench_engine(cfg, new_tokens=new_tokens, engine=e)),
+    )
+    arms = {}
+    for name, rows, drive in order:
+        t0 = time.perf_counter()
+        engine = None
+        try:
+            engine = engine_from_rows(cfg, params, rows, defaults)
+            res = drive(engine)
+            arms[name] = {"status": "ok", "rows": rows,
+                          "seconds": round(time.perf_counter() - t0, 1),
+                          **{k: (round(v, 2) if isinstance(v, float) else v)
+                             for k, v in res.items()}}
+        except Exception as e:  # noqa: BLE001 — each arm reports its own fate
+            shed = isinstance(e, hbm.HBMExhausted) or _is_oom(e)
+            arms[name] = {"status": "shed" if shed else "error",
+                          "rows": rows,
+                          "seconds": round(time.perf_counter() - t0, 1),
+                          "error": f"{type(e).__name__}: {str(e)[:200]}"}
+        finally:
+            if engine is not None:
+                engine.close()
+        log(f"  arm {name}: {arms[name]['status']}")
+    sheds = sum(1 for a in arms.values() if a["status"] == "shed")
+    errors = sum(1 for a in arms.values() if a["status"] == "error")
+    return {"arms": arms, "one_process": True, "deaths": 0,
+            "sheds": sheds, "errors": errors,
+            "hbm": hbm.arbiter_stats()}
 
 
 def main_cpu() -> None:
@@ -871,7 +1016,11 @@ def run_section(args) -> None:
     if args.section == "probe":
         emit({"platform": platform, "devices": jax.device_count()})
         return
-    cfg = LLAMA_CONFIGS["llama3-8b"]
+    # sections are normally dispatched on the TPU path only; the tiny
+    # fallback lets any single section be exercised structurally with
+    # --cpu (e.g. `python bench.py --section arms --cpu`)
+    cfg = (LLAMA_CONFIGS["tiny"] if platform == "cpu"
+           else LLAMA_CONFIGS["llama3-8b"])
     try:
         if args.section == "headline":
             out = {}
@@ -905,6 +1054,8 @@ def run_section(args) -> None:
             emit(bench_engine(cfg))
         elif args.section == "spec":
             emit(bench_spec_decode(cfg))
+        elif args.section == "arms":
+            emit(bench_arms(cfg))
         elif args.section == "paged":
             # live_len matches the contiguous sweep's half-full point
             # (cache_len//2 = 512) so the promoted headline compares the
@@ -1055,27 +1206,37 @@ def main() -> None:
         payload["ttft_paged_error"] = tp["error"]
     else:
         payload["ttft_paged_p50_ms"] = round(tp["p50_ms"], 1)
-    emit({**payload, "partial": "sections after ttft_paged pending"})
-    pfx = section("prefix")
-    if "error" in pfx:
-        payload["prefix_error"] = pfx["error"]
+    emit({**payload, "partial": "arms + paged sweep pending"})
+    # ALL serving arms in ONE process under the HBM arbiter (the run
+    # PR 10 was built for): prefix/engine/spec/paged_engine construct
+    # through hbm.alloc leases, the spec arm is a TPU_SPEC_DECODE
+    # config row, and the outcome per arm is ok-or-shed, never a
+    # process death (the 2026-07-31 capture lost all four to
+    # RESOURCE_EXHAUSTED in per-section children).
+    arms = section("arms", timeout=2400.0)
+    if "error" in arms:
+        payload["arms_error"] = arms["error"]
     else:
-        payload["prefix_miss_ttft_ms"] = round(pfx["miss_ms"], 1)
-        payload["prefix_hit_ttft_ms"] = round(pfx["hit_ms"], 1)
-    emit({**payload, "partial": "sections after prefix pending"})
-    eng = section("engine")
-    if "error" in eng:
-        payload["engine_error"] = eng["error"]
-    else:
-        payload["engine_tok_s"] = round(eng["tok_s"], 1)
-    emit({**payload, "partial": "sections after engine pending"})
-    spec = section("spec")
-    if "error" in spec:
-        payload["spec_error"] = spec["error"]
-    else:
-        payload["spec_tok_s"] = round(spec["tok_s"], 1)
-        payload["spec_tokens_per_window"] = round(
-            spec["tokens_per_window"], 2)
+        payload["arms"] = arms["arms"]
+        payload["arms_one_process"] = {
+            "deaths": arms["deaths"], "sheds": arms["sheds"],
+            "errors": arms["errors"]}
+        a = arms["arms"]
+        # lift the headline per-arm numbers into their historical keys
+        # so dashboards and round-over-round diffs keep working
+        if a.get("prefix", {}).get("status") == "ok":
+            payload["prefix_miss_ttft_ms"] = round(a["prefix"]["miss_ms"], 1)
+            payload["prefix_hit_ttft_ms"] = round(a["prefix"]["hit_ms"], 1)
+        if a.get("engine", {}).get("status") == "ok":
+            payload["engine_tok_s"] = round(a["engine"]["tok_s"], 1)
+            payload["engine_gap_p50_ms"] = a["engine"].get("gap_p50_ms")
+        if a.get("spec", {}).get("status") == "ok":
+            payload["spec_tok_s"] = round(a["spec"]["tok_s"], 1)
+            payload["spec_tokens_per_window"] = round(
+                a["spec"]["tokens_per_window"], 2)
+        if a.get("paged_engine", {}).get("status") == "ok":
+            payload["paged_engine_tok_s"] = round(
+                a["paged_engine"]["tok_s"], 1)
     # a kill during the (long) paged sweep must not cost the measured
     # sections: the last stdout line stays a valid, honest artifact
     emit({**payload, "partial": "paged sweep pending"})
@@ -1098,11 +1259,8 @@ def main() -> None:
         payload["paged_error"] = paged["error"]
         break
     if "paged_tok_s" in payload:
-        pe = section("paged_engine", "--slots", str(payload["paged_batch"]))
-        if "error" in pe:
-            payload["paged_engine_error"] = pe["error"]
-        else:
-            payload["paged_engine_tok_s"] = round(pe["tok_s"], 1)
+        # (the paged serving-stack number now comes from the one-process
+        # arms section above; the raw sweep keeps the headline promotion)
         # headline = the best SERVING decode config. The paged pool is a
         # production path (TPU_PAGED_BLOCKS), not a synthetic sweep —
         # when it beats contiguous rows (more slots per weight stream),

@@ -351,6 +351,12 @@ TTFT_BUCKETS = (0.002, 0.005, 0.01, 0.02, 0.035, 0.05, 0.075, 0.1, 0.15,
 # lattice or compile interleaves — fine buckets below 100ms, coarse above:
 ITL_BUCKETS = (0.0005, 0.001, 0.002, 0.004, 0.008, 0.015, 0.03, 0.05, 0.1,
                0.2, 0.4, 0.8, 1.5, 3, 10)
+# Inter-block dispatch gaps: 0 when pipelined (a successor block was
+# already queued at reap), else the reap+delivery+admission+dispatch
+# host window — sub-ms through a few hundred ms (CPU backend / compile
+# interleaves). The first bucket splits "pipelined" from "not":
+GAP_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.002, 0.004, 0.008, 0.015,
+               0.03, 0.06, 0.12, 0.25, 0.5, 1, 3)
 
 
 def register_framework_metrics(m: Manager) -> None:
@@ -481,6 +487,16 @@ def register_framework_metrics(m: Manager) -> None:
                 "the split wait lines)")
     m.new_gauge("app_tpu_active_sequences",
                 "generation slots currently holding a live stream")
+    m.new_histogram("app_tpu_dispatch_gap_duration",
+                    "inter-block host-dispatch gap in seconds: how long "
+                    "the device stream sat idle between one fused decode "
+                    "block's outputs coming ready and the next dispatch "
+                    "(pipelined reaps with a successor already queued "
+                    "record 0; exemplar-capable like every histogram)",
+                    GAP_BUCKETS)
+    m.new_gauge("app_tpu_pipeline_depth",
+                "fused decode blocks in flight on the device stream "
+                "after the last pipeline top-up")
 
 
 def update_system_metrics(m: Manager) -> None:

@@ -643,6 +643,36 @@ def verify_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     return _logits(params, cfg, x), new
 
 
+EOS_PAD = -1  # unused entries of a per-slot on-device stop set
+
+
+def decode_stop_mask(tokens: jnp.ndarray, lengths: jnp.ndarray,
+                     budget: jnp.ndarray, eos_ids: jnp.ndarray,
+                     capacity: jnp.ndarray) -> jnp.ndarray:
+    """Per-slot stop verdict for one fused-decode scan step — the
+    on-device mirror of the serving engine's host retirement checks
+    (EOS set membership, token budget, cache capacity), evaluated
+    INSIDE the scan so a finished stream self-deactivates mid-block
+    instead of burning junk slot-steps until the host reaps (at
+    pipeline depth 2 that waste would be up to 2K-1 steps per stream).
+
+    ``tokens`` [B]: the step's sampled tokens. ``lengths`` [B]: the
+    post-step cursors. ``budget`` [B]: tokens the slot may still emit
+    AFTER this one (the device carry of ``_Slot.remaining``).
+    ``eos_ids`` [B, E]: each request's stop set, EOS_PAD-padded (token
+    ids are non-negative, so the pad can never match). ``capacity``:
+    the cursor bound at which the host retires (max_seq - 2 — the next
+    delivered token would reach serving capacity).
+
+    Returns bool [B]: True = this slot emitted its LAST token this step
+    (the token itself is still delivered; the slot freezes from the
+    next step on). Must stay exactly equivalent to the host checks in
+    ``GenerationEngine._deliver`` — depth-2 token-exactness vs depth-1
+    rests on the two retiring at the same position."""
+    at_eos = jnp.any(tokens[:, None] == eos_ids, axis=1)
+    return at_eos | (budget <= 0) | (lengths >= capacity)
+
+
 def multi_request_serving_config(cfg: ModelConfig) -> ModelConfig:
     """Config for any program that batches UNRELATED requests into one
     forward — decode over the slot pool, the engine's coalesced ``score``

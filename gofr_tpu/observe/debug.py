@@ -200,7 +200,21 @@ def install_debug_routes(router, app) -> None:
         if tl is None:
             return _json(w, {"enabled": False})
         if req.param("format") == "stats":
-            return _json(w, tl.stats())
+            out = tl.stats()
+            # the decode-pipeline figures the timeline's "device
+            # stream" track visualizes (dispatch-gap p50, overlapped
+            # reaps, live depth) ride along so the stats page answers
+            # "is the pipeline actually overlapping" without a trace
+            # download
+            gen = getattr(getattr(app.container, "tpu", None),
+                          "generator", None)
+            if gen is not None:
+                try:
+                    out["pipeline"] = \
+                        gen.stats()["scheduler"]["pipeline"]
+                except Exception:
+                    pass  # a down engine must not break the page
+            return _json(w, out)
         last_ms = None
         if req.param("last_ms"):
             try:

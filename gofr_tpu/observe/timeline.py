@@ -51,6 +51,7 @@ __all__ = ["Timeline", "timeline_from_config"]
 
 # track ids inside the single "serving" process of the exported trace
 _TID_SCHED = 1          # admission / shed / expiry decisions
+_TID_DEVICE = 2         # device-stream dispatch gaps (idle windows)
 _TID_SLOT0 = 10         # decode slot i -> tid 10 + i
 _TID_PREDICT0 = 1000    # predict program tracks, assigned in export order
 
@@ -130,6 +131,20 @@ class Timeline:
     def predict(self, t0: float, t1: float, program: str, size: int) -> None:
         self.append("predict", t0, t1 - t0, program, size)
 
+    def dispatch_gap(self, t0: float, t1: float) -> None:
+        """One inter-block host-dispatch gap: the device stream ran dry
+        at ``t0`` (a fused block's outputs came ready with no successor
+        queued) and the next dispatch landed at ``t1``. The pipelined
+        loop's whole job is keeping this track EMPTY during steady
+        decode — a Perfetto window makes the overlap (or its absence)
+        visible at a glance."""
+        self.append("gap", t0, t1 - t0)
+
+    def pipeline_depth(self, depth: int) -> None:
+        """Counter sample: fused decode blocks in flight after a
+        pipeline top-up (the Perfetto twin of app_tpu_pipeline_depth)."""
+        self.append("depth", time.monotonic(), None, depth)
+
     def admit(self, slot: int, slo_class: str, wait_s: float,
               request_id, trace_id: str = "") -> None:
         self.append("admit", time.monotonic(), None, slot, slo_class,
@@ -205,6 +220,10 @@ class Timeline:
              "args": {"name": "scheduler"}},
             {"ph": "M", "pid": 1, "tid": _TID_SCHED,
              "name": "thread_sort_index", "args": {"sort_index": 0}},
+            {"ph": "M", "pid": 1, "tid": _TID_DEVICE, "name": "thread_name",
+             "args": {"name": "device stream"}},
+            {"ph": "M", "pid": 1, "tid": _TID_DEVICE,
+             "name": "thread_sort_index", "args": {"sort_index": 1}},
         ]
         named_slots: set[int] = set()
         predict_tids: dict[str, int] = {}
@@ -287,6 +306,14 @@ class Timeline:
                              "tid": slot_tid(c), "name": f"kvcache {a}",
                              "cat": "kvcache", "ts": us,
                              "args": {"tier": a, "tokens": b, "seq": seq}})
+            elif kind == "gap":
+                body.append({"ph": "X", "pid": 1, "tid": _TID_DEVICE,
+                             "name": "dispatch gap", "cat": "gap",
+                             "ts": us, "dur": max(dur, 0.0) * 1e6,
+                             "args": {"seq": seq}})
+            elif kind == "depth":
+                body.append({"ph": "C", "pid": 1, "name": "pipeline_depth",
+                             "ts": us, "args": {"depth": a}})
             elif kind == "hbm":
                 body.append({"ph": "C", "pid": 1, "name": f"hbm:{a}",
                              "ts": us, "args": {"bytes": b}})

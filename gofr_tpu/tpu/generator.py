@@ -45,8 +45,8 @@ from .. import chaos
 from ..errors import DeadlineExceeded
 from ..models import llama
 from ..models.common import ModelConfig
-from ..resilience import (SLO_LATENCY, SLO_THROUGHPUT, current_deadline,
-                          current_slo_class)
+from ..resilience import (SLO_LATENCY, SLO_THROUGHPUT, DecodePipelinePolicy,
+                          current_deadline, current_slo_class)
 from ..wire import PushStream
 from . import hbm
 from .batcher import pad_bucket
@@ -285,12 +285,16 @@ class _Request:
 class _Inflight:
     """A dispatched-but-unreaped device tick. ``arrays``: the dispatch's
     output futures (readiness probe); ``reap``: fetch results and
-    deliver tokens — must run under the engine's device lock."""
-    __slots__ = ("arrays", "reap")
+    deliver tokens — must run under the engine's device lock.
+    ``ready_t``: when the loop observed the outputs ready (None until
+    then) — the instant the device stream ran dry unless another block
+    was already queued behind this one, i.e. the dispatch-gap anchor."""
+    __slots__ = ("arrays", "reap", "ready_t")
 
     def __init__(self, arrays, reap):
         self.arrays = arrays
         self.reap = reap
+        self.ready_t: float | None = None
 
 
 class _Slot:
@@ -314,6 +318,7 @@ class GenerationEngine:
                  logger=None, metrics=None, observe=None, seed: int = 0,
                  mesh=None, gate=None,
                  kv_dtype=None, decode_block: int = 4,
+                 decode_pipeline: int = 2,
                  admit_window_ms: float = 2.0,
                  prefix_cache_slots: int = 0,
                  prefix_store_min: int | None = None,
@@ -387,6 +392,28 @@ class GenerationEngine:
         # dispatch/tunnel latency K-fold. Cost: a finished stream wastes at
         # most K-1 slot-steps, and admission waits at most one block.
         self.decode_block = max(1, int(decode_block))
+        # Decode dispatch pipeline (TPU_DECODE_PIPELINE): how many fused
+        # blocks may be in flight on the device stream at once. At depth
+        # 2 the loop dispatches block N+1 BEFORE reaping block N — all
+        # of N+1's inputs (cache, PRNG key, slot-state carry) are device
+        # futures chained from N's outputs, so the dispatch queues with
+        # zero host feedback and the host overlaps N's reap/delivery/
+        # admission with N+1's compute. The policy collapses to 1 when
+        # queueing a second block would cost an SLO (latency admission
+        # waiting, chunk lattice deferred, spec decode) — see
+        # resilience.DecodePipelinePolicy.
+        self._pipeline = DecodePipelinePolicy(decode_pipeline)
+        self._lattice_deferred = False
+        self._depth_now = 0
+        # inter-block host-gap instrumentation: _idle_from marks when
+        # the device stream ran dry (reap with no successor queued);
+        # the next dispatch closes the gap into the histogram/timeline.
+        # Overlapped reaps (a block still queued at reap) record 0.0 —
+        # the pipelined steady state the A/B bench gates on.
+        self._idle_from: float | None = None
+        self._gap_samples: "deque[float]" = deque(maxlen=2048)
+        self._reaps = 0
+        self._overlapped_reaps = 0
         # In-flight admission poll cadence (seconds). While a decode
         # block runs on device, the serving loop waits on the submit
         # event in slices of this length and admits new arrivals
@@ -396,10 +423,22 @@ class GenerationEngine:
         # TPU_ADMIT_WINDOW_MS keeps the name. 0 falls back to 1 ms.
         self._admit_window = max(0.0, float(admit_window_ms)) / 1e3
         # flash-decode kernel (ops.flash_decode): single-device only
-        # (pallas is opaque to GSPMD) and opt-in while hardware timings
-        # are being validated — GOFR_FLASH_DECODE=1 enables.
-        self._flash_decode = (mesh is None
-                              and os.environ.get("GOFR_FLASH_DECODE") == "1")
+        # (pallas is opaque to GSPMD). FENCED, not just opt-in: the
+        # 2026-07-31 device capture (BENCH_CANDIDATE.json) measured the
+        # kernel SLOWER than the fused XLA step inside the K-step scan
+        # (2309 vs 2709 tok/s — see PERF.md "flash-decode regression"),
+        # so GOFR_FLASH_DECODE=1 alone now logs the recorded regression
+        # and stays on the XLA path; GOFR_FLASH_DECODE_FORCE=1 runs the
+        # kernel anyway (the A/B-profiling escape hatch).
+        self._flash_decode = False
+        if mesh is None and os.environ.get("GOFR_FLASH_DECODE") == "1":
+            if os.environ.get("GOFR_FLASH_DECODE_FORCE") == "1":
+                self._flash_decode = True
+            elif logger is not None:
+                logger.warn({"event": "GOFR_FLASH_DECODE ignored: known "
+                             "regression vs the fused XLA step (PERF.md "
+                             "2026-07-31: 2309 vs 2709 tok/s); set "
+                             "GOFR_FLASH_DECODE_FORCE=1 to run it anyway"})
         self.max_seq = min(max_seq or cfg.max_seq, cfg.max_seq)
         self.prompt_buckets = tuple(sorted(b for b in prompt_buckets
                                            if b <= self.max_seq)) or (self.max_seq,)
@@ -455,6 +494,14 @@ class GenerationEngine:
             self._table = np.zeros((slots, self._mb), np.int32)
             self._slot_blocks: list[list[int]] = [[] for _ in range(slots)]
             self._cursors = np.zeros((slots,), np.int64)  # device cursor
+            # the cursor each slot's on-device stop mask freezes at
+            # (budget/capacity; 0 = none): the host advances _cursors
+            # eagerly at dispatch, and under the depth-2 pipeline a
+            # stream can have finished on device one whole un-reaped
+            # block ago — without this bound _ensure_blocks would
+            # demand pool blocks the stream will never write and could
+            # starvation-retire it (or a neighbor) for them
+            self._stop_cursors = np.zeros((slots,), np.int64)
             self._paged_evictions = 0
             self._prefix_idx = None
             if prefix_cache_slots > 0:
@@ -529,6 +576,21 @@ class GenerationEngine:
         self._active = np.zeros((slots,), bool)
         self._temps = np.zeros((slots,), np.float32)
         self._top_ks = np.zeros((slots,), np.int32)
+        # on-device stop-mask state: each slot's remaining token budget
+        # (the device carry of _Slot.remaining) and its EOS stop set,
+        # EOS_PAD-padded to a fixed width (sets wider than EOS_MAX keep
+        # the host check as the only stop — correct, just K-step lazier)
+        self._budgets = np.zeros((slots,), np.int32)
+        self._eos_mat = np.full((slots, self.EOS_MAX), llama.EOS_PAD,
+                                np.int32)
+        # the coalesced dispatch pack: every host-owned per-slot decode
+        # input (last token, active, budget, temp, top-k, adapter,
+        # host-wins, EOS set, block table) rides to the device as ONE
+        # [B, W] int32 h2d transfer, rebuilt only when a mirror is
+        # dirty — in steady-state decode the dispatch is all-device
+        # (cache/key/carry chain from the previous block's outputs)
+        self._pack = None
+        self._pack_dirty = True
         self._seed = int(seed)  # recovery reseeds the chained key
         self._recoveries = 0
         self._key = jax.random.PRNGKey(seed)
@@ -690,14 +752,17 @@ class GenerationEngine:
             # arbiter leases buffers, not scalars.)
             self._key = jax.device_put(self._key, rep)  # noqa: GL202
             # outputs: (token, logprob, next_key, cache) for prefill/
-            # final-chunk, (tokens, logprobs, next_key, cache) for the
-            # fused step — the PRNG key chains through every sampling
-            # program (split in-trace, no host round-trip per block)
+            # final-chunk, (tokens, logprobs, emitted, slot-state carry,
+            # next_key, cache) for the fused step — the PRNG key chains
+            # through every sampling program (split in-trace, no host
+            # round-trip per block), and the carry chains the per-slot
+            # decode state the pipeline's next dispatch consumes
             self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(0,),
                                         out_shardings=(rep, rep, rep,
                                                        cache_sh))
             self._step_jit = jax.jit(self._step_fn, donate_argnums=(0,),
-                                     out_shardings=(rep, rep, rep, rep,
+                                     out_shardings=(rep, rep, rep,
+                                                    (rep, rep, rep), rep,
                                                     cache_sh))
             self._chunk_mid_jit = jax.jit(self._chunk_mid, donate_argnums=(0,),
                                           out_shardings=cache_sh)
@@ -777,6 +842,19 @@ class GenerationEngine:
     # top-k truncation width: per-request k is traced (no recompiles);
     # ranks past k are masked within this fixed top set
     TOP_K_MAX = 64
+
+    # on-device EOS stop-set width (llama.decode_stop_mask): requests
+    # with more stop ids than this keep host-side retirement as their
+    # only stop — still correct, the slot just burns up to a block of
+    # junk steps before the host notices. Never a compile key per
+    # request (the [B, EOS_MAX] matrix is fixed-shape dispatch data).
+    EOS_MAX = 8
+
+    # dispatch-pack column layout (_dispatch_pack / _fused_decode_scan
+    # must agree): 0 last_token, 1 active, 2 budget, 3 temp (f32 bits),
+    # 4 top_k, 5 adapter, 6 host_wins, 7.. EOS set, then (paged) the
+    # block-table row
+    _PACK_EXTRA = 7
 
     # -- jitted device functions --------------------------------------------
     def _sample(self, logits, temps, key, top_ks):
@@ -869,38 +947,73 @@ class GenerationEngine:
         return (tok[0], lp[0], key,
                 llama.KVCache(k_new, v_new, lengths, ks, vs))
 
-    def _fused_decode_scan(self, cache, last_tokens, active, temps,
-                           top_ks, key, step_model):
+    def _fused_decode_scan(self, cache, pack, carry, key, step_model):
         """K fused decode steps over all slots (K = decode_block); one
-        dispatch returns [K, B] tokens. Each step feeds its sampled token
-        to the next on device — the host is off the per-token critical
-        path entirely. Inactive cursors stay frozen every step (their
-        garbage KV scatter lands at the frozen position, which admission
-        either overwrites or — for parked slots — drops).
-        ``step_model(tokens, cache) -> (logits, stepped)`` is the only
-        thing that differs between the contiguous and paged engines.
+        dispatch returns [K, B] tokens + an emitted mask. Each step
+        feeds its sampled token to the next on device — the host is off
+        the per-token critical path entirely. Inactive cursors stay
+        frozen every step (their garbage KV scatter lands at the frozen
+        position, which admission either overwrites or — for parked
+        slots — drops). ``step_model(tokens, cache) -> (logits,
+        stepped)`` is the only thing that differs between the
+        contiguous and paged engines.
+
+        ``pack`` [B, W] int32 is the coalesced host dispatch state (one
+        h2d when dirty — see _dispatch_pack); ``carry`` is the device
+        slot-state chain (last token, active, budget) returned by the
+        PREVIOUS block — per slot, ``host_wins`` picks which side is
+        the truth (host after admission/retire/verify, device in steady
+        state). Chaining ACTIVE and BUDGET through the device is what
+        makes depth-2 pipelining exact: block N+1 is dispatched before
+        the host has seen block N's tokens, and a stream that hits EOS/
+        budget/capacity inside N self-deactivates via the in-scan stop
+        mask (llama.decode_stop_mask) so N+1 freezes it instead of
+        emitting junk. ``emitted`` [K, B] tells the host exactly which
+        tokens are real — host delivery replays it verbatim, so device
+        stop masks and host retirement stay token-equivalent.
 
         The PRNG key chains THROUGH the program (split in-trace, next
         key returned): the host never dispatches a separate
         random.split between blocks — through the tunnel that was a
-        full extra roundtrip per block."""
-        host_tokens, host_wins, carry0 = last_tokens
-        tokens0 = jnp.where(host_wins, host_tokens, carry0)
+        full extra roundtrip per block. Key consumption is shape-only
+        (every slot splits every step, active or not), so stop masks
+        never perturb a neighbor slot's sampling."""
+        E = self.EOS_MAX
+        host_tokens = pack[:, 0]
+        host_active = pack[:, 1].astype(bool)
+        host_budget = pack[:, 2]
+        temps = jax.lax.bitcast_convert_type(pack[:, 3], jnp.float32)
+        top_ks = pack[:, 4]
+        host_wins = pack[:, 6].astype(bool)
+        eos_ids = pack[:, self._PACK_EXTRA:self._PACK_EXTRA + E]
+        dev_tokens, dev_active, dev_budget = carry
+        tokens0 = jnp.where(host_wins, host_tokens, dev_tokens)
+        active0 = jnp.where(host_wins, host_active, dev_active)
+        budget0 = jnp.where(host_wins, host_budget, dev_budget)
+        # the host retires one delivered token before the cursor hits
+        # capacity (see _deliver's at_capacity): post-step cursors at
+        # max_seq - 2 mean the NEXT delivery would reach the bound
+        cap = jnp.int32(self.max_seq - 2)
         keys = jax.random.split(key, self.decode_block + 1)
         next_key = keys[0]
 
         def body(carry, step_key):
-            tokens, cache = carry
+            tokens, active, budget, cache = carry
             logits, stepped = step_model(tokens, cache)
             lengths = jnp.where(active, stepped.lengths, cache.lengths)
             stepped = stepped._replace(lengths=lengths)
             toks, lps = self._sample(logits, temps, step_key, top_ks)
             toks = jnp.where(active, toks, tokens)
-            return (toks, stepped), (toks, lps)
+            emitted = active
+            budget = jnp.where(active, budget - 1, budget)
+            stop = active & llama.decode_stop_mask(toks, lengths, budget,
+                                                   eos_ids, cap)
+            return (toks, active & ~stop, budget, stepped), \
+                (toks, lps, emitted)
 
-        (last, cache), (toks, lps) = jax.lax.scan(body, (tokens0, cache),
-                                                  keys[1:])
-        return toks, lps, last, next_key, cache
+        (last, active, budget, cache), (toks, lps, emitted) = jax.lax.scan(
+            body, (tokens0, active0, budget0, cache), keys[1:])
+        return toks, lps, emitted, (last, active, budget), next_key, cache
 
     def _verify_epilogue(self, logits, window, active, stepped):
         """Shared verify-pass tail: greedy tokens + their logprobs, the
@@ -916,16 +1029,16 @@ class GenerationEngine:
         lengths = stepped.lengths + emit
         return greedy, lps, emit, stepped._replace(lengths=lengths)
 
-    def _step_fn(self, cache, params, last_tokens, active, temps, top_ks,
-                 key, adapter=None):
+    def _step_fn(self, cache, params, pack, carry, key):
+        adapter = pack[:, 5] if self._n_adapters else None
+
         def step_model(tokens, cache):
             return llama.decode_step(
                 params, self.cfg, tokens, cache,
                 rope_tables=self.rope_tables, flash=self._flash_decode,
                 adapter=adapter)
 
-        return self._fused_decode_scan(cache, last_tokens, active, temps,
-                                       top_ks, key, step_model)
+        return self._fused_decode_scan(cache, pack, carry, key, step_model)
 
     def _paged_prefill_fn(self, cache, params, tokens, length, blocks,
                           slot, temp, top_k, key, adapter=None):
@@ -959,20 +1072,23 @@ class GenerationEngine:
             rope_tables=self.rope_tables, adapter=adapter)
         return self._verify_epilogue(logits, window, active, stepped)
 
-    def _paged_step_fn(self, cache, params, last_tokens, active, temps,
-                       top_ks, key, table, adapter=None):
-        """_step_fn over the block pool. ``table`` [B, MB] is host-owned
-        and constant through the block (the host pre-allocates blocks
-        covering K tokens per slot)."""
+    def _paged_step_fn(self, cache, params, pack, carry, key):
+        """_step_fn over the block pool. The table rides in the pack's
+        trailing [B, MB] columns — host-owned and constant through the
+        block (the host pre-allocates blocks covering K tokens per
+        slot)."""
         from ..models import paged_llama
+
+        lo = self._PACK_EXTRA + self.EOS_MAX
+        table = pack[:, lo:lo + self._mb]
+        adapter = pack[:, 5] if self._n_adapters else None
 
         def step_model(tokens, cache):
             return paged_llama.paged_decode_step(
                 params, self.cfg, tokens, cache, table,
                 rope_tables=self.rope_tables, adapter=adapter)
 
-        return self._fused_decode_scan(cache, last_tokens, active, temps,
-                                       top_ks, key, step_model)
+        return self._fused_decode_scan(cache, pack, carry, key, step_model)
 
     def _verify_fn(self, cache, params, window, active, key, adapter=None):
         """One speculative verify pass. ``window`` [B, W]: col 0 = each
@@ -1189,6 +1305,7 @@ class GenerationEngine:
                 "queued_latency": self._pending.qsize_class(SLO_LATENCY),
                 "queued_throughput":
                     self._pending.qsize_class(SLO_THROUGHPUT),
+                "pipeline": self._pipeline_stats(),
             },
         }
         if self.gate is not None:
@@ -1221,6 +1338,34 @@ class GenerationEngine:
                     if self._spec_windows else None),
             }
         return out
+
+    def _pipeline_stats(self) -> dict:
+        """Decode-pipeline observability (also the deterministic probe
+        the depth tests poll): the configured ceiling, the depth the
+        NEXT top-up would target (computed from the same facts the loop
+        reads), the depth currently in flight, and the measured
+        inter-block host-gap distribution — overlapped reaps are the
+        blocks whose successor was already queued on-device."""
+        # lock-free snapshot: the serving loop appends concurrently and
+        # CPython raises if an append lands mid-iteration — retry a few
+        # times rather than taking the device lock on a stats poll
+        samples: list = []
+        for _ in range(4):
+            try:
+                samples = list(self._gap_samples)
+                break
+            except RuntimeError:
+                continue
+        return {
+            "depth": self._pipeline.depth,
+            "target_depth": self._target_depth(),
+            "depth_now": self._depth_now,
+            "reaps": self._reaps,
+            "overlapped_reaps": self._overlapped_reaps,
+            "gap_p50_ms": (round(float(np.median(samples)) * 1e3, 4)
+                           if samples else None),
+            "gap_samples": len(samples),
+        }
 
     def warmup(self) -> None:
         """Prime every compiled shape (prefill per bucket + the step).
@@ -1328,49 +1473,26 @@ class GenerationEngine:
                     jnp.asarray(kv.k_scale[:, None]) if quant else None,
                     jnp.asarray(kv.v_scale[:, None]) if quant else None,
                     jnp.int32(0)))
-            if self._paged:
-                # ZEROED table, not the live one: an active slot whose
-                # cursor sits at an unallocated block boundary would have
-                # its clamped row redirect the dummy write INTO its last
-                # live block (offset 0 = position cursor-T); with zeros
-                # every garbage write lands in the trash block
-                # two calls: the first covers the host-built carry
-                # signature (first live block, _last_dev=None); the
-                # second feeds the returned carry + chained key back —
-                # the STEADY-STATE signature, whose inputs are
-                # jit-output-committed (mesh: rep-sharded). Warming only
-                # one would re-lower the big fused scan mid-serving.
-                _, _, carry_w, self._key, self.cache = \
-                    jax.block_until_ready(self._step_jit(
-                        self.cache, self.params, self._warm_last3(),
-                        jnp.zeros((self.n_slots,), bool),
-                        jnp.asarray(self._temps), jnp.asarray(self._top_ks),
-                        self._key, jnp.zeros_like(jnp.asarray(self._table)),
-                        self._adapters()))
-                _, _, _, self._key, self.cache = jax.block_until_ready(
-                    self._step_jit(
-                        self.cache, self.params,
-                        (jnp.asarray(np.array(self._last_tokens)),
-                         jnp.zeros((self.n_slots,), bool), carry_w),
-                        jnp.zeros((self.n_slots,), bool),
-                        jnp.asarray(self._temps), jnp.asarray(self._top_ks),
-                        self._key, jnp.zeros_like(jnp.asarray(self._table)),
-                        self._adapters()))
-            else:
-                _, _, carry_w, self._key, self.cache = \
-                    jax.block_until_ready(self._step_jit(
-                        self.cache, self.params, self._warm_last3(),
-                        jnp.zeros((self.n_slots,), bool),
-                        jnp.asarray(self._temps), jnp.asarray(self._top_ks),
-                        self._key, self._adapters()))
-                _, _, _, self._key, self.cache = jax.block_until_ready(
-                    self._step_jit(
-                        self.cache, self.params,
-                        (jnp.asarray(np.array(self._last_tokens)),
-                         jnp.zeros((self.n_slots,), bool), carry_w),
-                        jnp.zeros((self.n_slots,), bool),
-                        jnp.asarray(self._temps), jnp.asarray(self._top_ks),
-                        self._key, self._adapters()))
+            # All-inactive warm pack (host_wins set, active clear, EOS
+            # padded, paged table ZEROED — not the live one: an active
+            # slot whose cursor sits at an unallocated block boundary
+            # would have its clamped row redirect the dummy write INTO
+            # its last live block; with zeros every garbage write lands
+            # in the trash block). Two calls: the first covers the
+            # host-built carry signature (first live block,
+            # _last_dev=None); the second feeds the returned carry +
+            # chained key back — the STEADY-STATE signature, whose
+            # inputs are jit-output-committed (mesh: rep-sharded).
+            # Warming only one would re-lower the big fused scan
+            # mid-serving.
+            warm_pack = self._warm_pack()
+            _, _, _, carry_w, self._key, self.cache = \
+                jax.block_until_ready(self._step_jit(
+                    self.cache, self.params, warm_pack,
+                    self._host_carry(), self._key))
+            _, _, _, _, self._key, self.cache = jax.block_until_ready(
+                self._step_jit(self.cache, self.params, warm_pack,
+                               carry_w, self._key))
             if self._spec_k:
                 # the verify program too — its first real tick would
                 # otherwise compile mid-serving under the device lock,
@@ -1496,9 +1618,58 @@ class GenerationEngine:
             self._obs_end(req.stream, "failed", error="engine closed")
 
     # -- the serving loop ----------------------------------------------------
-    def _warm_last3(self):
-        host = jnp.asarray(self._last_tokens)
-        return (host, jnp.ones((self.n_slots,), bool), host)
+    def _pack_width(self) -> int:
+        return (self._PACK_EXTRA + self.EOS_MAX
+                + (self._mb if self._paged else 0))
+
+    def _warm_pack(self):
+        """All-inactive dispatch pack for warmup: host_wins set so the
+        carry is ignored, active clear so no cursor moves, EOS rows
+        padded, (paged) table zeroed so garbage lands in the trash
+        block."""
+        p = np.zeros((self.n_slots, self._pack_width()), np.int32)
+        p[:, 6] = 1
+        p[:, self._PACK_EXTRA:self._PACK_EXTRA + self.EOS_MAX] = \
+            llama.EOS_PAD
+        return jnp.asarray(p)
+
+    def _host_carry(self):
+        """Host-built device slot-state carry — the first block's (and
+        post-recovery's) stand-in for the previous dispatch's outputs.
+        np.array copies before conversion: see _dev's aliasing note."""
+        return (jnp.asarray(np.array(self._last_tokens)),
+                jnp.asarray(np.array(self._active)),
+                jnp.asarray(np.array(self._budgets)))
+
+    def _dispatch_pack(self):
+        """The decode dispatch's ONE host input: every host-owned
+        per-slot array packed into a [B, W] int32 matrix (temps ride as
+        f32 bit patterns; the scan prologue bitcasts them back). These
+        arrays change only at admission/retirement — re-uploading them
+        as a handful of separate h2d transfers per block cost real
+        milliseconds through the tunnel (the 1.9 ms dispatch floor the
+        ROADMAP names), so the pack re-uploads as a single transfer and
+        ONLY when a mutation site marked it dirty (_touch); in steady
+        state the cached device copy is reused and the dispatch carries
+        zero host payload. The np staging buffer is fresh per build and
+        never mutated after conversion, so CPU-backend zero-copy
+        aliasing (the r4 token-carry flake) cannot bite."""
+        if self._pack is None or self._pack_dirty:
+            E = self.EOS_MAX
+            p = np.empty((self.n_slots, self._pack_width()), np.int32)
+            p[:, 0] = self._last_tokens
+            p[:, 1] = self._active
+            p[:, 2] = self._budgets
+            p[:, 3] = self._temps.view(np.int32)
+            p[:, 4] = self._top_ks
+            p[:, 5] = self._slot_adapter
+            p[:, 6] = self._host_wins
+            p[:, self._PACK_EXTRA:self._PACK_EXTRA + E] = self._eos_mat
+            if self._paged:
+                p[:, self._PACK_EXTRA + E:] = self._table
+            self._pack = jnp.asarray(p)
+            self._pack_dirty = False
+        return self._pack
 
     def _dev(self, name: str, host):
         """Device mirror of a host-owned dispatch array. These arrays
@@ -1519,7 +1690,11 @@ class GenerationEngine:
         return self._mirror[name]
 
     def _touch(self, *names: str) -> None:
+        # one call dirties both representations: the legacy per-name
+        # mirrors (_dev — verify/predict paths) and the coalesced
+        # decode dispatch pack
         self._dirty.update(names)
+        self._pack_dirty = True
 
     def _adapters(self):
         """[B] adapter ids for batch dispatches, or None when LoRA is
@@ -1570,7 +1745,11 @@ class GenerationEngine:
                     # next synchronous pass. Pop-then-push-front
                     # instead of peek: with per-class lines a
                     # concurrent put() could otherwise change which
-                    # head the verdict applied to.
+                    # head the verdict applied to. The flag drops the
+                    # pipeline to depth 1 so that synchronous pass
+                    # arrives within one reap instead of never (a full
+                    # pipeline would otherwise re-dispatch forever).
+                    self._lattice_deferred = True
                     self._pending.put_front(req)
                     return started
                 if req.stream.cancelled.is_set():
@@ -1845,6 +2024,30 @@ class GenerationEngine:
                 prompt_len=len(req.prompt))
         return True
 
+    def _expire_decoding(self, idx: int, slot: _Slot) -> bool:
+        """Deadline check at the reap, once per slot per block: a
+        decoding stream whose caller's wire deadline ran out stops
+        consuming its slot NOW — even with further blocks already in
+        flight (the pipelined dispatches' tokens for this slot are
+        dropped by the snapshot/emitted guards, and _retire's host_wins
+        deactivates it for every dispatch after those). Fails the
+        stream with DeadlineExceeded and retires the slot."""
+        req = slot.request
+        if req is None or req.deadline is None or not req.deadline.expired():
+            return False
+        self._count_expired(where="mid-decode",
+                            request_id=req.stream.request_id)
+        req.stream.failed = "deadline expired mid-decode"
+        req.stream._q.put(DeadlineExceeded(
+            f"deadline expired after {slot.generated} generated tokens"))
+        req.stream.cancel()
+        if self._observe is not None:
+            self._observe.recorder.record(
+                "expired_mid_decode", request_id=req.stream.request_id,
+                trace_id=req.stream.trace_id, tokens=slot.generated)
+        self._retire(idx, slot)
+        return True
+
     # -- paged-mode host side ------------------------------------------------
     def _paged_admit_prefill(self, idx: int, req: _Request,
                              shared: list[int], m: int,
@@ -1944,7 +2147,19 @@ class GenerationEngine:
         for idx, slot in enumerate(self._slots):
             if not self._active[idx]:
                 continue
-            need = min((int(self._cursors[idx]) + K - 1) // T + 1, self._mb)
+            cur = int(self._cursors[idx])
+            hi = cur + K  # highest write is at position hi - 1
+            stop = int(self._stop_cursors[idx])
+            if horizon is None and stop > 0:
+                # decode writes freeze at the device stop cursor: never
+                # demand (or starvation-retire for) blocks a finished
+                # stream will not touch. Verify windows keep the full
+                # horizon — their junk rows past acceptance are the
+                # clamped-table contract.
+                hi = min(hi, stop)
+                if hi <= cur:
+                    continue  # device-stopped; awaiting the reap
+            need = min((hi - 1) // T + 1, self._mb)
             if len(self._slot_blocks[idx]) >= need:
                 continue  # row already written at admission/last growth
             starved = False
@@ -2506,9 +2721,36 @@ class GenerationEngine:
         if slot.request is not None:  # not finished by the first token
             self._last_tokens[idx] = first
             self._active[idx] = True
+            # device-side stop state: the budget mirrors slot.remaining
+            # (tokens still allowed after the prefill's first one); the
+            # EOS row arms the in-scan stop set. host_wins forces all
+            # of it over whatever the device carry held for this slot.
+            self._budgets[idx] = slot.remaining
+            self._eos_row(idx, req.eos_id)
+            if self._paged:
+                # where the device's budget/capacity stop masks will
+                # freeze this slot's cursor (EOS may stop earlier —
+                # the over-advance is bounded by one reap)
+                self._stop_cursors[idx] = min(
+                    req.stream.prompt_len + slot.remaining,
+                    self.max_seq - 2)
             self._host_wins[idx] = True
-            self._touch("active", "last_tokens", "host_wins")
+            self._touch("active", "last_tokens", "host_wins", "budgets",
+                        "eos")
         self._obs_gauges()
+
+    def _eos_row(self, idx: int, eos_id) -> None:
+        """Arm slot ``idx``'s on-device EOS stop set. Sets wider than
+        EOS_MAX fall back to host-only retirement (the extra ids simply
+        never match on device; the stream stays exact, the slot just
+        burns junk steps until the reap notices)."""
+        row = self._eos_mat[idx]
+        row[:] = llama.EOS_PAD
+        if eos_id is None:
+            return
+        ids = (eos_id,) if isinstance(eos_id, int) else tuple(eos_id)
+        for j, t in zip(range(self.EOS_MAX), ids):
+            row[j] = t
 
     def _deliver(self, idx: int, slot: _Slot, token: int,
                  lp: float | None = None) -> None:
@@ -2595,7 +2837,16 @@ class GenerationEngine:
         self._temps[idx] = 0.0
         self._top_ks[idx] = 0
         self._slot_adapter[idx] = 0
-        self._touch("active", "temps", "top_ks", "adapters")
+        self._budgets[idx] = 0
+        self._eos_mat[idx, :] = llama.EOS_PAD
+        # host wins the next dispatch's merge for this slot: a host-only
+        # retirement (cancel, deadline, paged starvation) deactivates a
+        # slot the device carry still believes is live — without this
+        # an already-pipelined block would be the LAST junk it emits,
+        # but the carry would keep it running forever
+        self._host_wins[idx] = True
+        self._touch("active", "temps", "top_ks", "adapters", "budgets",
+                    "eos", "host_wins")
         if self._paged:
             # freed blocks may be re-issued immediately; the retired
             # slot's frozen-cursor garbage writes go to the trash block
@@ -2605,28 +2856,71 @@ class GenerationEngine:
                 self._slot_blocks[idx] = []
             self._table[idx, :] = 0
             self._cursors[idx] = 0
+            self._stop_cursors[idx] = 0
             self._touch("table")
         self._obs_gauges()
 
     def _loop(self) -> None:
+        # the decode dispatch pipeline: oldest-first deque of in-flight
+        # fused blocks. Depth 1 reproduces the old dispatch->overlap->
+        # reap loop exactly; at depth 2 the loop keeps a SECOND block
+        # queued on the device stream while reaping the first, so the
+        # host-side reap/delivery/admission work (the ~23% per-block
+        # dispatch gap BENCH_CANDIDATE.json measured) overlaps device
+        # compute instead of idling it.
+        pipe: "deque[_Inflight]" = deque()
         while not self._closed:
             try:
-                if self._active.any() or not self._pending.empty():
+                if pipe or self._active.any() or not self._pending.empty():
                     with self._device_lock:
-                        self._admit()
+                        if not pipe:
+                            # synchronous admission pass — the only one
+                            # allowed to run a chunk lattice (its
+                            # interleaved decode blocks need a fully
+                            # reaped loop)
+                            self._lattice_deferred = False
+                            self._admit()
                         chaos.fire(chaos.GENERATOR_STEP)
-                        inflight = self._tick()
-                    if inflight is not None:
-                        # serve admissions WHILE the block runs on
-                        # device, then fetch its results — see
-                        # _admit_inflight for why this is the TTFT fix
-                        self._admit_inflight(inflight)
-                        with self._device_lock:
-                            inflight.reap()
+                        depth = self._target_depth()
+                        while len(pipe) < depth:
+                            inflight = self._tick(decode_only=bool(pipe))
+                            if inflight is None:
+                                break
+                            pipe.append(inflight)
+                        self._note_depth(len(pipe))
+                    if not pipe:
+                        continue
+                    # serve admissions WHILE the oldest block runs on
+                    # device, then fetch its results — see
+                    # _admit_inflight for why this is the TTFT fix
+                    self._admit_inflight(pipe[0])
+                    with self._device_lock:
+                        inflight = pipe.popleft()
+                        self._reaps += 1
+                        if pipe:
+                            # >= 1 block still queued on-device: the
+                            # inter-block host gap is zero by
+                            # construction — record it so the A/B gap
+                            # p50 reflects the pipelining win
+                            self._overlapped_reaps += 1
+                            self._record_gap(0.0)
+                        else:
+                            # the stream ran dry when this block's
+                            # outputs came ready; the next dispatch
+                            # closes the gap
+                            self._idle_from = (inflight.ready_t
+                                               or time.monotonic())
+                        inflight.reap()
                 else:
                     self._work.wait(timeout=0.05)
                     self._work.clear()
             except BaseException as e:  # noqa: BLE001 — waiters must not hang
+                # unwind EVERY in-flight dispatch first: their output
+                # futures (and the donated cache chained through them)
+                # died with the failure — reaping one would only
+                # re-raise the same error; recovery below reseeds ONCE
+                # for however many dispatches were in flight
+                pipe.clear()
                 if self._closed:
                     return
                 if self.logger is not None:
@@ -2650,7 +2944,10 @@ class GenerationEngine:
                     # device-mirror buffers may have died with the
                     # failed dispatch — rebuild them all on next use
                     self._mirror.clear()
+                    self._pack = None
+                    self._pack_dirty = True
                     self._last_dev = None
+                    self._idle_from = None
                     self._host_wins[:] = True
                     self._recoveries += 1
                     if self._prefix_idx is not None:
@@ -2800,6 +3097,7 @@ class GenerationEngine:
         while not self._closed and time.monotonic() < deadline:
             try:
                 if all(a.is_ready() for a in inflight.arrays):
+                    inflight.ready_t = time.monotonic()
                     return
             except Exception:  # no readiness probe on this backend
                 return
@@ -2817,12 +3115,53 @@ class GenerationEngine:
             self._work.clear()
             self._work.wait(poll)
 
-    def _tick(self) -> "_Inflight | None":
+    def _target_depth(self) -> int:
+        """Pipeline depth for the next top-up — the engine-side facts
+        feeding resilience.DecodePipelinePolicy. Also surfaced by
+        stats() so tests and dashboards see the same verdict the loop
+        acts on."""
+        return self._pipeline.target(
+            latency_waiting=self._pending.qsize_class(SLO_LATENCY) > 0,
+            lattice_deferred=self._lattice_deferred,
+            spec_decode=bool(self._spec_k))
+
+    def _note_depth(self, depth: int) -> None:
+        if depth == self._depth_now:
+            return
+        self._depth_now = depth
+        if self.metrics is not None:
+            self.metrics.set_gauge("app_tpu_pipeline_depth", float(depth),
+                                   program="generate")
+        if self._tl is not None:
+            self._tl.pipeline_depth(depth)
+
+    def _note_dispatch(self, now: float) -> None:
+        """Close an open inter-block gap: the device stream ran dry at
+        ``_idle_from`` and this dispatch is the first work queued
+        since."""
+        if self._idle_from is None:
+            return
+        gap, self._idle_from = max(0.0, now - self._idle_from), None
+        self._record_gap(gap, now)
+
+    def _record_gap(self, gap: float, now: float | None = None) -> None:
+        self._gap_samples.append(gap)
+        if self.metrics is not None:
+            self.metrics.record_histogram("app_tpu_dispatch_gap_duration",
+                                          gap, program="generate")
+        if self._tl is not None and now is not None and gap > 0.0:
+            self._tl.dispatch_gap(now - gap, now)
+
+    def _tick(self, decode_only: bool = False) -> "_Inflight | None":
         """Dispatch one serving tick: a speculative verify pass when the
         engine can use one (spec enabled, every active slot greedy and
         clear of capacity, at least one slot has a draft), else a decode
-        block. Returns the in-flight handle (reap delivers) or None."""
-        if self._spec_k and self._spec_eligible():
+        block. Returns the in-flight handle (reap delivers) or None.
+        ``decode_only``: a pipeline top-up behind an un-reaped block —
+        verify windows are built from host-delivered history, which
+        does not exist yet (the depth policy already pins spec engines
+        to depth 1; this is the structural guard)."""
+        if not decode_only and self._spec_k and self._spec_eligible():
             drafts = {idx: self._draft(idx)
                       for idx in range(self.n_slots) if self._active[idx]}
             drafted = sum(d is not None for d in drafts.values())
@@ -2911,6 +3250,8 @@ class GenerationEngine:
         for idx, slot in enumerate(self._slots):
             if not snap_active[idx] or slot.request is not snap_reqs[idx]:
                 continue
+            if self._expire_decoding(idx, slot):
+                continue
             self._record_itl(slot, emit_l[idx])
             for k in range(emit_l[idx]):
                 if not self._active[idx]:
@@ -2919,43 +3260,54 @@ class GenerationEngine:
                 self._last_tokens[idx] = t
                 self._hist_append(idx, t)
                 self._deliver(idx, slot, t, lps_l[idx][k])
+        # a verify pass advanced host state outside the decode carry
+        # chain: host wins the next decode dispatch's merge, so sync
+        # the budget mirror to what the deliveries left behind
+        for idx in np.flatnonzero(snap_active):
+            s = self._slots[idx]
+            self._budgets[idx] = s.remaining if s.request is not None else 0
+            if self._paged:
+                self._stop_cursors[idx] = (
+                    min(int(self._cursors[idx]) + s.remaining,
+                        self.max_seq - 2)
+                    if s.request is not None else 0)
         self._host_wins |= snap_active
-        self._touch("last_tokens", "host_wins")
+        self._touch("last_tokens", "host_wins", "budgets")
 
     def _decode_tick(self) -> "_Inflight | None":
         """Dispatch one fused decode block; the reap fetches [K, B]
-        tokens and delivers in step order. A slot that finishes
-        (EOS/budget/capacity) at step k has its later tokens discarded
-        on the host — bounded waste that buys K-fold fewer device
-        roundtrips."""
+        tokens + the emitted mask and delivers in step order. A slot
+        that finishes (EOS/budget/capacity) at step k self-deactivates
+        ON DEVICE (llama.decode_stop_mask in the scan carry), so the
+        waste of an already-finished stream is bounded within ONE block
+        even when a second block was dispatched before this one's
+        tokens reached the host (pipeline depth 2)."""
         if not self._active.any():
             return None
         if self._paged:
             self._ensure_blocks()  # may retire starving slots
             if not self._active.any():
                 return None
-        if self._last_dev is None:  # first block / post-recovery;
-            # np.array copy: see _dev's aliasing note
-            self._last_dev = jnp.asarray(np.array(self._last_tokens))
-        last3 = (self._dev("last_tokens", self._last_tokens),
-                 self._dev("host_wins", self._host_wins), self._last_dev)
+        if self._last_dev is None:  # first block / post-recovery:
+            # no previous dispatch to chain from — build the slot-state
+            # carry from the host arrays
+            self._last_dev = self._host_carry()
+        t_dispatch = time.monotonic()
+        self._note_dispatch(t_dispatch)
+        toks, lps, emitted, self._last_dev, self._key, self.cache = \
+            self._step_jit(self.cache, self.params, self._dispatch_pack(),
+                           self._last_dev, self._key)
         if self._paged:
-            toks, lps, self._last_dev, self._key, self.cache = \
-                self._step_jit(
-                    self.cache, self.params, last3,
-                    self._dev("active", self._active),
-                    self._dev("temps", self._temps),
-                    self._dev("top_ks", self._top_ks), self._key,
-                    self._dev("table", self._table), self._adapters())
-            self._cursors[self._active] += self.decode_block
-        else:
-            toks, lps, self._last_dev, self._key, self.cache = \
-                self._step_jit(
-                    self.cache, self.params, last3,
-                    self._dev("active", self._active),
-                    self._dev("temps", self._temps),
-                    self._dev("top_ks", self._top_ks), self._key,
-                    self._adapters())
+            # advance bounded by each slot's device stop cursor: the
+            # scan freezes a slot there (budget/capacity), so the host
+            # view must not run past it while un-reaped blocks pile up
+            # behind the pipeline. EOS stops land wherever they land —
+            # that over-advance is bounded by one reap.
+            adv = np.minimum(
+                self.decode_block,
+                np.maximum(self._stop_cursors - self._cursors, 0))
+            adv = np.where(self._stop_cursors > 0, adv, self.decode_block)
+            self._cursors[self._active] += adv[self._active]
         if self._host_wins.any():
             self._host_wins[:] = False
             self._touch("host_wins")
@@ -2963,15 +3315,15 @@ class GenerationEngine:
         # the slots as dispatched, not as mutated by in-flight admissions
         snap_active = self._active.copy()
         snap_reqs = [s.request for s in self._slots]
-        return _Inflight((toks, lps), functools.partial(
-            self._decode_reap, toks, lps, snap_active, snap_reqs,
-            time.monotonic()))
+        return _Inflight((toks, lps, emitted), functools.partial(
+            self._decode_reap, toks, lps, emitted, snap_active, snap_reqs,
+            t_dispatch))
 
     # invoked through _Inflight.reap, always under the engine's device
     # lock (see _loop)  # gl: holds self._device_lock
-    def _decode_reap(self, toks, lps, snap_active, snap_reqs,
+    def _decode_reap(self, toks, lps, emitted, snap_active, snap_reqs,
                      t0: float = 0.0) -> None:
-        toks_np, lps_np = jax.device_get((toks, lps))  # [K, B] each
+        toks_np, lps_np, emit_np = jax.device_get((toks, lps, emitted))
         if self._tl is not None:
             # one ring event per fused block, fanned out to per-slot
             # slices only at export time — the hot path pays one append
@@ -2986,15 +3338,25 @@ class GenerationEngine:
         # bulk-convert once: per-element int()/float() on numpy scalars
         # costs real milliseconds per reap at high slot counts
         toks_l, lps_l = toks_np.tolist(), lps_np.tolist()
+        emit_l = emit_np.tolist()
+        counts = emit_np.sum(axis=0)  # real tokens per slot this block
         for idx, slot in enumerate(self._slots):
             if snap_active[idx] and self._active[idx] \
                     and slot.request is snap_reqs[idx]:
-                self._record_itl(slot, len(toks_l))
+                if self._expire_decoding(idx, slot):
+                    continue
+                if counts[idx]:
+                    self._record_itl(slot, int(counts[idx]))
         for k in range(len(toks_l)):
-            trow, lrow = toks_l[k], lps_l[k]
+            trow, lrow, erow = toks_l[k], lps_l[k], emit_l[k]
             for idx, slot in enumerate(self._slots):
                 if not snap_active[idx] or not self._active[idx] \
-                        or slot.request is not snap_reqs[idx]:
+                        or slot.request is not snap_reqs[idx] \
+                        or not erow[idx]:
+                    # the emitted mask replays the device stop masks:
+                    # tokens a self-deactivated slot carried (frozen
+                    # repeats) are never delivered, keeping the stream
+                    # identical to host-side retirement
                     continue
                 self._last_tokens[idx] = trow[idx]
                 if self._spec_k:

@@ -459,6 +459,22 @@ def test_gl203_reassignment_counts_as_eviction(tmp_path):
     assert analyze(dst) == []
 
 
+def test_gl203_bounded_deque_is_not_growth(tmp_path):
+    """deque(maxlen=N) is a bounded ring — append() evicts from the
+    head once full, so request-path appends are not a leak (the decode
+    pipeline's gap-sample reservoir). An UNbounded deque still flags."""
+    src = ("from collections import deque\n\n\nclass C:\n"
+           "    def __init__(self):\n"
+           "        self._ring = deque(maxlen=64)\n"
+           "        self._open = deque()\n\n"
+           "    def handle(self, x):\n"
+           "        self._ring.append(x)\n"
+           "        self._open.append(x)\n")
+    dst = scaffold(tmp_path, "mod.py", src)
+    got = analyze(dst)
+    assert got == [(11, "GL203")], got  # only the unbounded deque
+
+
 def test_repo_reports_zero_unbaselined_findings():
     """The CI `analysis` job's exact gate: the checked-in baseline
     covers the whole repo, with no stale entries."""

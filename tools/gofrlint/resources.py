@@ -583,6 +583,15 @@ class ResourcePass:
                 and _callee_last(value.func) in _CONTAINER_CTORS)
             if not is_container:
                 continue
+            if (isinstance(value, ast.Call)
+                    and _callee_last(value.func) == "deque"
+                    and any(kw.arg == "maxlen"
+                            and not (isinstance(kw.value, ast.Constant)
+                                     and kw.value.value is None)
+                            for kw in value.keywords)):
+                # deque(maxlen=N) is a bounded ring: append() evicts
+                # from the head once full — growth there is not a leak
+                continue
             targets = node.targets if isinstance(node, ast.Assign) \
                 else [node.target]
             for t in targets:
