@@ -953,8 +953,16 @@ def bench_arms(cfg, *, slots: int = 48, paged_slots: int = 128) -> dict:
         log(f"  arm {name}: {arms[name]['status']}")
     sheds = sum(1 for a in arms.values() if a["status"] == "shed")
     errors = sum(1 for a in arms.values() if a["status"] == "error")
+    # the first-class-serving-mode gate: speculative decoding is a
+    # supported config row (TPU_SPEC_DECODE, config-reference.md), so
+    # the spec arm must pass ALONGSIDE prefix/engine/paged in this one
+    # process — "ok" for every required arm, or the section is red
+    required = [name for name, _, _ in order]
     return {"arms": arms, "one_process": True, "deaths": 0,
             "sheds": sheds, "errors": errors,
+            "required": required,
+            "all_required_ok": all(
+                arms.get(n, {}).get("status") == "ok" for n in required),
             "hbm": hbm.arbiter_stats()}
 
 
@@ -1221,6 +1229,13 @@ def main() -> None:
         payload["arms_one_process"] = {
             "deaths": arms["deaths"], "sheds": arms["sheds"],
             "errors": arms["errors"]}
+        # GATE: spec is a first-class serving mode — the run is only
+        # green when the spec arm passes alongside prefix/engine/paged
+        # in one process under the arbiter (ROADMAP leftover, PR 11)
+        payload["arms_gate"] = {
+            "required": arms.get("required", []),
+            "all_required_ok": bool(arms.get("all_required_ok")),
+            "spec_ok": arms["arms"].get("spec", {}).get("status") == "ok"}
         a = arms["arms"]
         # lift the headline per-arm numbers into their historical keys
         # so dashboards and round-over-round diffs keep working

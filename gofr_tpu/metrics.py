@@ -461,6 +461,28 @@ def register_framework_metrics(m: Manager) -> None:
     m.new_gauge("app_tpu_brownout_active",
                 "1 while the admission gate's brownout band is engaged")
 
+    # disaggregated prefill/decode serving (gofr_tpu/pd/ — see
+    # docs/advanced-guide/disaggregated-serving.md): the KV-ship path
+    # between dedicated prefill and decode pools
+    m.new_counter("app_tpu_pd_requests_total",
+                  "P/D-split requests, by role (prefill = relayed to "
+                  "the decode pool, decode = ingested from a prefill "
+                  "worker)")
+    m.new_counter("app_tpu_pd_ingests_total",
+                  "shipped-KV row installs admitted into decode slots "
+                  "(zero prefill FLOPs on the decode pool)")
+    m.new_counter("app_tpu_pd_kv_frames_total",
+                  "checksummed KV block frames crossing the pool "
+                  "boundary, by direction (byte totals live on the "
+                  "role's health/stats surface)")
+    m.new_counter("app_tpu_pd_frame_rejects_total",
+                  "KV frames rejected at the transfer boundary "
+                  "(checksum/truncation/layout) — each one failed a "
+                  "single request typed, never a pool row")
+    m.new_counter("app_tpu_pd_peer_losses_total",
+                  "decode-peer connection losses that shed in-flight "
+                  "relayed streams (503 + Retry-After)")
+
     # tracing export health (tracing.ZipkinExporter): spans dropped
     # because the pending buffer hit its bound while the collector was
     # down/stalled — fail-open export must cost bounded memory, and

@@ -1,0 +1,115 @@
+"""Disaggregated prefill/decode serving (docs/advanced-guide/
+disaggregated-serving.md).
+
+Prefill is compute-bound and bursty; decode is memory-bound and steady.
+``TPU_SERVING_ROLE`` splits them into dedicated pools that scale
+independently: **prefill workers** compute prompt KV and ship it as
+checksummed int8 block frames (the ``tpu/kvcache/quant.py`` codec)
+over a ``wire.py``-backed stream to **decode workers**, which own the
+slot lattice and the token stream. Each pool draws its own HBM-arbiter
+budget with its own reclaim policy; deadlines, SLO classes and W3C
+trace context cross the boundary with the request.
+
+``wire_role`` is the config seam: called by ``new_engine_from_config``
+when ``TPU_SERVING_ROLE`` is ``prefill`` or ``decode`` (``fused``, the
+default, wires nothing and serves exactly as before).
+"""
+
+from __future__ import annotations
+
+from .ingest import KVIngestServer
+from .prefill import PDPrefill, RelayStream
+from .protocol import DecodePeerUnavailable, KVTransferError
+
+__all__ = ["DecodePeerUnavailable", "KVIngestServer", "KVTransferError",
+           "PDPrefill", "ROLES", "RelayStream", "parse_role", "wire_role"]
+
+ROLE_FUSED = "fused"
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLES = (ROLE_FUSED, ROLE_PREFILL, ROLE_DECODE)
+
+DEFAULT_LISTEN = "127.0.0.1:9400"
+
+
+def parse_role(val: str | None) -> str:
+    """``TPU_SERVING_ROLE`` -> role. Unknown values raise: a typo'd
+    role silently serving fused would be a silently mis-deployed pool,
+    the one misconfiguration class that must fail at startup."""
+    role = (val or ROLE_FUSED).strip().lower()
+    if role not in ROLES:
+        raise ValueError(f"TPU_SERVING_ROLE={val!r}: expected one of "
+                         f"{ROLES}")
+    return role
+
+
+def _parse_addr(spec: str, what: str) -> tuple[str, int]:
+    host, _, port = spec.strip().rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"{what}={spec!r}: expected host:port")
+    return host, int(port)
+
+
+def wire_role(engine, role: str, cfg, *, logger=None, metrics=None):
+    """Attach the role's PD half to a built engine: a decode worker
+    grows the KV-ingest listener (``TPU_PD_LISTEN``); a prefill worker
+    grows the coordinator against ``TPU_PD_PEER``. Both sides derive
+    the handshake identity from the SAME model fingerprint the Redis
+    tier namespaces by — two pools serving different weights refuse
+    each other at hello instead of exchanging wrong attention state."""
+    gen = engine.generator
+    if gen is None:
+        raise ValueError(f"TPU_SERVING_ROLE={role}: requires a decoder "
+                         "model (TPU_MODEL llama family)")
+    from ..tpu.kvcache import model_fingerprint
+
+    fingerprint = model_fingerprint(gen.cfg, gen.params, extra="pd")
+    window = max(1, cfg.get_int("TPU_PD_WINDOW_MB", 8)) << 20
+    if role == ROLE_DECODE:
+        if gen.mesh is not None:
+            # same startup-loud contract as the prefill role: a sharded
+            # decode worker would handshake fine and then 500 every
+            # KV_EOF at _validate_ingest — fail the deploy, not the
+            # requests
+            raise ValueError("TPU_SERVING_ROLE=decode requires a "
+                             "single-device engine (sharded KV install "
+                             "does not partition; unset TPU_SHARDING "
+                             "on the decode pool)")
+        host, port = _parse_addr(
+            cfg.get_or_default("TPU_PD_LISTEN", DEFAULT_LISTEN),
+            "TPU_PD_LISTEN")
+        engine.pd_ingest = KVIngestServer(
+            gen, fingerprint, host, port, logger=logger, metrics=metrics,
+            window_bytes=window)
+        engine.serving_role = ROLE_DECODE
+        if logger is not None:
+            logger.info({"event": "pd decode role wired",
+                         "listen": f"{host}:{engine.pd_ingest.port}"})
+        return engine.pd_ingest
+    if role == ROLE_PREFILL:
+        if gen.mesh is not None:
+            raise ValueError("TPU_SERVING_ROLE=prefill requires a "
+                             "single-device engine (KV row snapshots "
+                             "don't gather sharded caches)")
+        if getattr(gen, "_paged", False):
+            raise ValueError("TPU_SERVING_ROLE=prefill requires a "
+                             "contiguous engine (set TPU_PAGED_BLOCKS=0 "
+                             "on the prefill pool; the DECODE pool may "
+                             "be paged)")
+        peer = cfg.get("TPU_PD_PEER")
+        if not peer:
+            raise ValueError("TPU_SERVING_ROLE=prefill requires "
+                             "TPU_PD_PEER=host:port (the decode "
+                             "worker's TPU_PD_LISTEN address)")
+        host, port = _parse_addr(peer, "TPU_PD_PEER")
+        engine.pd_prefill = PDPrefill(
+            gen, fingerprint, host, port, logger=logger, metrics=metrics,
+            ship_block=max(1, cfg.get_int("TPU_PD_BLOCK", 16)),
+            window_bytes=window)
+        engine.serving_role = ROLE_PREFILL
+        if logger is not None:
+            logger.info({"event": "pd prefill role wired",
+                         "peer": f"{host}:{port}"})
+        return engine.pd_prefill
+    engine.serving_role = ROLE_FUSED
+    return None

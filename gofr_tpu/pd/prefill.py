@@ -1,0 +1,480 @@
+"""Prefill-side coordinator: the client half of disaggregated serving.
+
+A prefill worker (``TPU_SERVING_ROLE=prefill``) runs ONLY prefill
+compute: each ``generate`` call admits through the local engine's
+normal gate/deadline/SLO machinery in KV-only mode (the chunk lattice
+runs, the first token samples, no decode slot is held past the
+prefill), ships the slot's KV to the decode peer as checksummed int8
+block frames — streamed per ship block as prefill chunks complete, so
+the peer's host-side assembly overlaps this worker's compute — and
+relays the decode worker's token stream back to the client through a
+``RelayStream`` (a ``PushStream``: the transports' zero-handoff sink
+protocol works unchanged).
+
+The failure contract mirrors the gate's shed discipline: a down or
+mid-stream-lost decode peer surfaces as ``DecodePeerUnavailable``
+(503 + Retry-After) — a SHED, not a failure — while this worker keeps
+serving prefills and the reconnect backoff re-arms the path; decode-
+side sheds (429), deadline expiries (504) and transfer faults (502)
+arrive typed through the ERR relay and re-raise as themselves.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import socket
+import threading
+import time
+
+from ..errors import GofrError
+from ..resilience import current_deadline, current_slo_class
+from ..tpu.kvcache.quant import concat_blocks, encode_block
+from ..wire import PushStream
+from . import protocol as p
+
+_BACKOFF_S = 0.5
+_BACKOFF_CAP_S = 15.0
+
+
+class RelayStream(PushStream):
+    """The client-facing stream of a P/D-split request: tokens pushed
+    by the peer reader thread (or straight into a transport sink),
+    terminals follow GenStream's convention (error then None). Carries
+    the attribute surface transports read off GenStream (``trace``,
+    ``prompt_len``, ``request_id``, ``cancel``)."""
+
+    def __init__(self, request_id: int, owner: "PDPrefill",
+                 logprobs: bool = False):
+        super().__init__()
+        self.request_id = request_id
+        self.logprobs = logprobs
+        self.prompt_len = 0
+        self.trace: dict[str, float] = {}
+        self.cancelled = threading.Event()
+        self.failed: str | None = None
+        self._owner = owner
+        self._local = None  # the prefill-side GenStream while it runs
+        self._done = False
+
+    def tokens(self) -> list[int]:
+        return [t[0] if isinstance(t, tuple) else t for t in self]
+
+    def cancel(self) -> None:
+        self.cancelled.set()
+        local = self._local
+        if local is not None:
+            local.cancel()
+        self._owner._cancel(self.request_id)
+
+
+class _Shipper:
+    """Accumulates the generator's KV-sink ranges and emits checksummed
+    block frames (``quant.encode_block`` — the Redis tier's codec) in
+    token order through the connection's windowed send path. Raises out
+    of the sink on ship failure; the generator converts that into a
+    per-request failure, never loop recovery."""
+
+    def __init__(self, conn: p.Conn, req_id: int, block: int,
+                 deadline=None, metrics=None):
+        self.conn = conn
+        self.req_id = req_id
+        self.block = max(1, int(block))
+        self.deadline = deadline
+        self.metrics = metrics
+        self.parts: list = []
+        self.buffered = 0
+        self.sent = 0
+        self.frames = 0
+        self.error: BaseException | None = None
+
+    def _window_deadline(self) -> float:
+        if self.deadline is not None:
+            return max(0.05, min(30.0, self.deadline.remaining()))
+        return 30.0
+
+    def _emit(self, kv) -> None:
+        frame = encode_block(kv)
+        self.conn.send_windowed(p.pack_kv(self.req_id, self.sent, frame),
+                                deadline_s=self._window_deadline())
+        self.sent += kv.plen
+        self.frames += 1
+        if self.metrics is not None:
+            try:
+                self.metrics.increment_counter("app_tpu_pd_kv_frames_total",
+                                               direction="out")
+            except Exception:
+                pass
+
+    def ship(self, kv, start: int, total: int) -> None:
+        """The generator's kv_sink: one host KV slab covering prompt
+        positions [start, start+kv.plen) — called per prefill chunk,
+        in order. Frames cut at ship-block boundaries; the trailing
+        partial flushes in finish()."""
+        try:
+            if start != self.sent + self.buffered:
+                raise p.KVTransferError(
+                    f"kv ship discontinuity: range starts at {start}, "
+                    f"expected {self.sent + self.buffered}")
+            self.parts.append(kv)
+            self.buffered += kv.plen
+            if self.buffered < self.block:
+                return
+            merged = (self.parts[0] if len(self.parts) == 1
+                      else concat_blocks(self.parts))
+            off = 0
+            while self.buffered - off >= self.block:
+                self._emit(merged.slice_tokens(off, off + self.block))
+                off += self.block
+            self.parts = [merged.slice_tokens(off, self.buffered)] \
+                if self.buffered > off else []
+            self.buffered -= off
+        except BaseException as e:
+            self.error = e
+            raise
+
+    def finish(self) -> None:
+        try:
+            if self.parts:
+                merged = (self.parts[0] if len(self.parts) == 1
+                          else concat_blocks(self.parts))
+                self._emit(merged)
+                self.parts = []
+                self.buffered = 0
+        except BaseException as e:
+            self.error = e
+            raise
+
+
+class PDPrefill:
+    """Coordinates KV-only prefill + ship + token relay against one
+    decode peer. Thread model: ``generate`` runs on transport handler
+    threads; the KV sink runs on the serving loop thread; one reader
+    thread per connection dispatches TOK/END/ERR to RelayStreams; one
+    finisher thread per request observes the local prefill's outcome
+    and sends KV_EOF."""
+
+    def __init__(self, generator, fingerprint: str, peer_host: str,
+                 peer_port: int, *, logger=None, metrics=None,
+                 ship_block: int = 16, window_bytes: int = 8 << 20,
+                 connect_timeout_s: float = 3.0):
+        self.gen = generator
+        self.fingerprint = fingerprint
+        self.peer = (peer_host, int(peer_port))
+        self.logger = logger
+        self.metrics = metrics
+        self.ship_block = int(ship_block)
+        self.window_bytes = int(window_bytes)
+        self.connect_timeout_s = float(connect_timeout_s)
+        import numpy as np
+
+        from ..tpu.kvcache import KVLayout
+
+        cache = generator.cache
+        self.layout = KVLayout(
+            generator.cfg.n_layers, generator.cfg.n_kv_heads,
+            generator.cfg.head_dim, cache.k_scale is not None,
+            np.dtype(str(cache.k.dtype)), generator.max_seq)
+        self._hello = p.hello_payload(fingerprint, self.layout)
+        self._ids = itertools.count(1)
+        self._conn: p.Conn | None = None
+        self._conn_lock = threading.Lock()
+        self._streams: dict[int, RelayStream] = {}
+        self._streams_lock = threading.Lock()
+        self._down_until = 0.0
+        self._backoff = _BACKOFF_S
+        self._closed = False
+        self.relayed = 0
+        self.reconnects = 0
+        self.peer_losses = 0
+
+    # -- connection management ----------------------------------------------
+    @property
+    def connected(self) -> bool:
+        return self._conn is not None
+
+    def _ensure_conn(self) -> p.Conn:
+        conn = self._conn
+        if conn is not None and not conn.closed:
+            return conn
+        if self._closed:
+            raise p.DecodePeerUnavailable("pd prefill coordinator closed")
+        now = time.monotonic()
+        if now < self._down_until:
+            raise p.DecodePeerUnavailable(
+                f"decode peer {self.peer[0]}:{self.peer[1]} in reconnect "
+                "backoff", retry_after=self._down_until - now)
+        with self._conn_lock:
+            conn = self._conn
+            if conn is not None and not conn.closed:
+                return conn
+            sock = None
+            conn = None
+            try:
+                sock = socket.create_connection(
+                    self.peer, timeout=self.connect_timeout_s)
+                # the handshake stays under the SAME timeout: a peer
+                # that accepts but never answers hello (stopped
+                # process, wrong service) must not wedge this
+                # generate() — and everyone behind _conn_lock — forever
+                sock.settimeout(self.connect_timeout_s)
+                conn = p.Conn(sock, window_bytes=self.window_bytes)
+                conn.send(p.pack_json(p.HELLO, 0, self._hello), block=True)
+                msg = p.read_msg(sock)
+                if msg is None:
+                    raise EOFError("peer closed during hello")
+                mtype, _, payload = msg
+                if mtype == p.ERR:
+                    err = p.error_from_wire(json.loads(bytes(payload)))
+                    raise GofrError(f"decode peer refused hello: {err}")
+                if mtype != p.HELLO_OK:
+                    raise GofrError("unexpected hello reply")
+                sock.settimeout(None)
+            except GofrError:
+                # a REFUSED hello is a configuration error (wrong model/
+                # weights behind the address): no silent retry loop —
+                # surface it and back off long. Close what we opened:
+                # every failed attempt must cost zero fds.
+                self._close_handshake(conn, sock)
+                self._down_until = time.monotonic() + _BACKOFF_CAP_S
+                raise
+            except Exception as e:  # noqa: BLE001 — down peer = shed
+                self._close_handshake(conn, sock)
+                self._down_until = time.monotonic() + self._backoff
+                retry = self._backoff
+                self._backoff = min(self._backoff * 2, _BACKOFF_CAP_S)
+                raise p.DecodePeerUnavailable(
+                    f"decode peer {self.peer[0]}:{self.peer[1]} "
+                    f"unreachable: {e!r}", retry_after=retry) from e
+            self._backoff = _BACKOFF_S
+            self._down_until = 0.0
+            self._conn = conn
+            self.reconnects += 1
+            threading.Thread(target=self._read_loop, args=(conn,),
+                             name="gofr-pd-relay", daemon=True).start()
+            if self.logger is not None:
+                self.logger.info({"event": "pd decode peer connected",
+                                  "peer": f"{self.peer[0]}:{self.peer[1]}"})
+            return conn
+
+    @staticmethod
+    def _close_handshake(conn, sock) -> None:
+        try:
+            if conn is not None:
+                conn.close()
+            elif sock is not None:
+                sock.close()
+        except OSError:
+            pass
+
+    def _read_loop(self, conn: p.Conn) -> None:
+        while True:
+            msg = p.read_msg(conn.sock)
+            if msg is None:
+                break
+            mtype, req_id, payload = msg
+            with self._streams_lock:
+                rs = self._streams.get(req_id)
+            if rs is None:
+                continue
+            if mtype == p.TOK:
+                tok, lp = p.unpack_tok(payload)
+                if not rs.trace.get("first_put"):
+                    rs.trace["first_put"] = time.monotonic()
+                rs._push((tok, lp) if rs.logprobs else tok)
+            elif mtype == p.END:
+                with self._streams_lock:
+                    self._streams.pop(req_id, None)
+                rs._done = True
+                rs._push(None)
+            elif mtype == p.ERR:
+                err = p.error_from_wire(json.loads(bytes(payload)))
+                with self._streams_lock:
+                    self._streams.pop(req_id, None)
+                rs.failed = str(err)
+                rs._done = True
+                rs._q.put(err)
+                rs._q.put(None)
+        self._on_conn_lost(conn)
+
+    def _on_conn_lost(self, conn: p.Conn) -> None:
+        """The decode peer vanished (crash, kill, network): every
+        in-flight relay is SHED typed (503 + Retry-After — clients
+        retry like any shed) and the path enters reconnect backoff.
+        This worker's engine is untouched: new prefills keep serving
+        and the next request after the peer returns re-handshakes."""
+        with self._conn_lock:
+            if self._conn is conn:
+                self._conn = None
+                self._down_until = time.monotonic() + self._backoff
+        conn.close()
+        with self._streams_lock:
+            orphans = list(self._streams.items())
+            self._streams.clear()
+        if orphans:
+            self.peer_losses += 1
+            if self.logger is not None:
+                self.logger.warn({"event": "pd decode peer lost",
+                                  "in_flight": len(orphans)})
+        err = p.DecodePeerUnavailable(
+            "decode peer lost mid-stream", retry_after=self._backoff)
+        for _, rs in orphans:
+            rs.failed = str(err)
+            rs._done = True
+            rs._q.put(err)
+            rs._q.put(None)
+        if self.metrics is not None and orphans:
+            try:
+                self.metrics.increment_counter(
+                    "app_tpu_pd_peer_losses_total")
+            except Exception:
+                pass
+
+    def _cancel(self, req_id: int) -> None:
+        with self._streams_lock:
+            self._streams.pop(req_id, None)
+        conn = self._conn
+        if conn is not None and not conn.closed:
+            try:
+                conn.send(p.pack_msg(p.CANCEL, req_id), block=True)
+            except Exception:
+                pass
+
+    # -- the serving path ----------------------------------------------------
+    def generate(self, prompt, max_new_tokens: int = 128,
+                 temperature: float = 0.0, top_k: int = 0,
+                 eos_id=None, adapter: int = 0, logprobs: bool = False,
+                 deadline=None, slo_class: str | None = None) -> RelayStream:
+        """The prefill worker's ``generate``: same signature and same
+        ambient deadline/SLO pickup as the fused engine's, returning a
+        RelayStream of the decode peer's tokens."""
+        if deadline is None:
+            deadline = current_deadline()
+        if slo_class is None:
+            slo_class = current_slo_class()
+        conn = self._ensure_conn()
+        import numpy as np
+
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        req_id = next(self._ids)
+        rs = RelayStream(req_id, self, logprobs=logprobs)
+        rs.prompt_len = len(prompt)
+        rs.trace["submit"] = time.monotonic()
+        traceparent = None
+        from .. import tracing
+
+        span = tracing.current_span()
+        if span is not None:
+            traceparent = span.traceparent()
+        if isinstance(eos_id, (set, frozenset, list, tuple)):
+            eos_wire: object = sorted(int(t) for t in eos_id)
+        else:
+            eos_wire = int(eos_id) if eos_id is not None else None
+        meta = {"prompt": prompt.tolist(), "plen": int(len(prompt)),
+                "max_new": int(max_new_tokens),
+                "temperature": float(temperature), "top_k": int(top_k),
+                "eos": eos_wire, "adapter": int(adapter),
+                "slo_class": slo_class,
+                "deadline_s": (round(deadline.remaining(), 6)
+                               if deadline is not None else None),
+                "traceparent": traceparent}
+        with self._streams_lock:
+            self._streams[req_id] = rs
+        shipper = _Shipper(conn, req_id, self.ship_block,
+                           deadline=deadline, metrics=self.metrics)
+        try:
+            # REQ leaves BEFORE the local submit: the serving loop may
+            # admit and ship the first KV frame before this thread runs
+            # again, and the peer must already know the request
+            conn.send(p.pack_json(p.REQ, req_id, meta), block=True)
+            local = self.gen.generate(
+                prompt, max_new_tokens=max_new_tokens,
+                temperature=temperature, top_k=top_k, eos_id=eos_id,
+                adapter=adapter, logprobs=True, deadline=deadline,
+                slo_class=slo_class, kv_sink=shipper.ship)
+        except (EOFError, OSError) as e:
+            # the peer died under the REQ send: a SHED, not a 500 —
+            # the typed-503 contract holds at every loss site
+            self._cancel(req_id)
+            raise p.DecodePeerUnavailable(
+                f"decode peer lost during submit: {e!r}",
+                retry_after=self._backoff) from e
+        except BaseException:
+            self._cancel(req_id)
+            raise
+        rs._local = local
+        threading.Thread(target=self._finish, args=(conn, req_id, rs,
+                                                    local, shipper),
+                         name=f"gofr-pd-finish-{req_id}",
+                         daemon=True).start()
+        self.relayed += 1
+        if self.metrics is not None:
+            try:
+                self.metrics.increment_counter("app_tpu_pd_requests_total",
+                                               role="prefill")
+            except Exception:
+                pass
+        return rs
+
+    def _finish(self, conn: p.Conn, req_id: int, rs: RelayStream,
+                local, shipper: _Shipper) -> None:
+        """Wait out the local KV-only prefill (its single delivered
+        token IS the first token), flush the trailing partial frame,
+        then hand the stream off with KV_EOF. A local failure (shed,
+        deadline, ship fault, device recovery) cancels the peer's
+        assembly and fails the relay with the TYPED local error."""
+        try:
+            toks = list(local)  # [ (first_token, first_lp) ] or raises
+            if not toks:
+                raise GofrError("kv-only prefill delivered no first token")
+            first, first_lp = toks[0]
+            shipper.finish()
+            rs.trace["prefill_done"] = time.monotonic()
+            # FIRST TOKEN LEAVES HERE, from the prefill pool: TTFT is
+            # the prefill worker's latency alone — no handoff, no
+            # decode-slot wait on its critical path (the decode worker
+            # knows not to re-relay it; tokens 2+ are its stream). The
+            # push precedes KV_EOF, so wire tokens can only follow it.
+            if not rs._done:
+                rs.trace.setdefault("first_put", time.monotonic())
+                rs._push((int(first), float(first_lp)) if rs.logprobs
+                         else int(first))
+            conn.send(p.pack_json(p.KV_EOF, req_id, {
+                "first_token": int(first), "first_lp": float(first_lp),
+                "plen": rs.prompt_len, "blocks": shipper.frames}),
+                block=True)
+        except BaseException as e:  # noqa: BLE001 — typed per-request fail
+            err: BaseException = shipper.error or e
+            if isinstance(err, (EOFError, OSError)):
+                err = p.DecodePeerUnavailable(
+                    "decode peer lost during kv ship",
+                    retry_after=self._backoff)
+            self._cancel(req_id)
+            if not rs._done:
+                rs.failed = str(err)
+                rs._done = True
+                rs._q.put(err)
+                rs._q.put(None)
+
+    def stats(self) -> dict:
+        with self._streams_lock:
+            in_flight = len(self._streams)
+        return {"peer": f"{self.peer[0]}:{self.peer[1]}",
+                "connected": self.connected, "in_flight": in_flight,
+                "relayed": self.relayed, "reconnects": self.reconnects,
+                "peer_losses": self.peer_losses,
+                "ship_block": self.ship_block,
+                "window_bytes": self.window_bytes}
+
+    def close(self) -> None:
+        self._closed = True
+        conn = self._conn
+        if conn is not None:
+            conn.close()
+        with self._streams_lock:
+            orphans = list(self._streams.values())
+            self._streams.clear()
+        for rs in orphans:
+            if not rs._done:
+                rs._q.put(GofrError("pd prefill coordinator closed"))
+                rs._q.put(None)

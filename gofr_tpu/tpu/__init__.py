@@ -145,6 +145,27 @@ Config keys (reference config style, pkg/gofr/config/config.go:3):
   TPU_SHARDING        "tp=8" / "tp=4,dp=2" mesh axes for sharded serving
                       (axes from gofr_tpu.parallel; weights get
                       NamedShardings, XLA inserts the ICI collectives)
+  TPU_SERVING_ROLE    disaggregated prefill/decode serving
+                      (docs/advanced-guide/disaggregated-serving.md):
+                      "fused" (default — one process serves both
+                      phases), "prefill" (this worker computes prompt
+                      KV and ships checksummed int8 block frames to
+                      the decode pool, relaying its token stream), or
+                      "decode" (this worker listens for shipped KV,
+                      owns the slot lattice and the token stream).
+                      Each pool draws its own TPU_HBM_BUDGET_MB with
+                      its own reclaim policy
+  TPU_PD_LISTEN       decode role: host:port the KV-ingest listener
+                      binds (default 127.0.0.1:9400)
+  TPU_PD_PEER         prefill role: the decode worker's TPU_PD_LISTEN
+                      address (required)
+  TPU_PD_BLOCK        KV-ship frame granularity in tokens (default 16
+                      — one frame per radix-sized block, streamed as
+                      prefill chunks complete)
+  TPU_PD_WINDOW_MB    KV-ship backpressure window in MiB (default 8):
+                      unsent bytes past this block the shipper until
+                      the peer drains (typed 502 when a wedged peer
+                      stalls past the request deadline)
   TPU_WARMUP          "true" to precompile all buckets at startup
 """
 
@@ -336,6 +357,18 @@ def new_engine_from_config(cfg, logger=None, metrics=None,
         seq_b = tuple(b for b in seq_buckets if b <= max_seq) or (max_seq,)
         engine.register("score", score_fn, params, kind="tokens",
                         batch_buckets=batch_buckets, seq_buckets=seq_b)
+
+    role_key = cfg.get("TPU_SERVING_ROLE")
+    if role_key:
+        # disaggregated prefill/decode serving (gofr_tpu/pd/,
+        # docs/advanced-guide/disaggregated-serving.md): non-fused
+        # roles attach their PD half here — after the generator exists,
+        # before warmup — so a misconfigured role fails startup loudly
+        from ..pd import ROLE_FUSED, parse_role, wire_role
+
+        role = parse_role(role_key)
+        if role != ROLE_FUSED:
+            wire_role(engine, role, cfg, logger=logger, metrics=metrics)
 
     if cfg.get_bool("TPU_WARMUP"):
         engine.warmup()

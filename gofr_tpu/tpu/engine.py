@@ -115,6 +115,13 @@ class TPUEngine:
         self._batchers: dict[str, CoalescingBatcher] = {}
         self._lock = threading.Lock()
         self.generator = None  # set by config wiring for decoder models
+        # disaggregated serving (gofr_tpu/pd/): the config wiring sets
+        # exactly one of these for non-fused roles — a prefill worker's
+        # coordinator (generate() routes through it) or a decode
+        # worker's KV-ingest listener
+        self.serving_role = "fused"
+        self.pd_prefill = None
+        self.pd_ingest = None
         self._closed = False
         if metrics is not None:
             # device-byte + arbiter gauges/counters (app_tpu_device_
@@ -357,7 +364,13 @@ class TPUEngine:
 
     def generate(self, *args, **kw):
         """Streaming token generation (decoder models). See
-        ``generator.GenerationEngine.generate``."""
+        ``generator.GenerationEngine.generate``. On a prefill-role
+        worker (``TPU_SERVING_ROLE=prefill``) this routes through the
+        P/D coordinator: local prefill-only compute, KV shipped to the
+        decode pool, tokens relayed back — same signature, same
+        ambient deadline/SLO pickup, the handler never knows."""
+        if self.pd_prefill is not None:
+            return self.pd_prefill.generate(*args, **kw)
         if self.generator is None:
             raise RuntimeError("no decoder model configured (TPU_MODEL must "
                                "be a llama-family model for generate)")
@@ -431,17 +444,44 @@ class TPUEngine:
                                     "sheds", "oom_retries")}
         if self.generator is not None:
             details["generator"] = self.generator.stats()
+        if self.serving_role != "fused":
+            # role-aware health (disaggregated-serving.md): a decode
+            # worker reports its ingest listener, a prefill worker its
+            # peer path — load balancers and the gateway read THIS to
+            # know which pool a replica serves and whether the
+            # cross-pool path is up
+            details["serving_role"] = self.serving_role
+            if self.pd_ingest is not None:
+                details["pd"] = self.pd_ingest.stats()
+            elif self.pd_prefill is not None:
+                details["pd"] = self.pd_prefill.stats()
         if self._closed:
             return Health(STATUS_DOWN, details)
         if self.generator is not None and self.generator.down is not None:
             # device loop bricked (donated cache lost and unrecoverable)
             return Health(STATUS_DOWN, details)
+        if self.pd_ingest is not None and not self.pd_ingest.stats()["listening"]:
+            # a decode worker that cannot accept KV is not serving its
+            # role, whatever its local engine thinks
+            return Health(STATUS_DOWN, details)
         # A live engine with no programs can't serve yet.
         status = STATUS_UP if (self._programs or self.generator) else STATUS_DEGRADED
+        if self.pd_prefill is not None and not self.pd_prefill.connected:
+            # prefill worker with no decode path: still alive (it can
+            # prefill, reconnect is armed) but degraded — readiness
+            # surfaces let the balancer prefer connected replicas
+            status = STATUS_DEGRADED
         return Health(status, details)
 
     def close(self) -> None:
         self._closed = True
+        # PD halves first: the ingest listener stops accepting and the
+        # coordinator fails its relays typed BEFORE the generator they
+        # feed shuts down
+        if self.pd_ingest is not None:
+            self.pd_ingest.close()
+        if self.pd_prefill is not None:
+            self.pd_prefill.close()
         for b in self._batchers.values():
             b.close(drain=False)
         if self.generator is not None:

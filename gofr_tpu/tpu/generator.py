@@ -239,6 +239,10 @@ class GenStream(PushStream):
         self.chunks = 0             # mid-chunk dispatches of this prefill
         self.cache_tier: str | None = None  # kvcache tier that served it
         self.cache_tokens = 0       # prompt positions the tier covered
+        # deadline-expiry site for the wide event ("queue"/"mid-prefill"/
+        # "mid-decode"; "post-handoff" for ingested P/D requests — the
+        # decode-side record that a request died AFTER the pool boundary)
+        self.where: str | None = None
 
     def tokens(self) -> list[int]:
         """Drain the whole stream (blocking) into a list of ids
@@ -252,7 +256,8 @@ class GenStream(PushStream):
 class _Request:
     __slots__ = ("stream", "prompt", "max_new", "temperature", "top_k",
                  "eos_id", "adapter", "enqueued_at", "lattice_peek",
-                 "kv_match", "deadline", "slo_class")
+                 "kv_match", "deadline", "slo_class", "kv_sink",
+                 "kv_shipped", "ingest")
 
     @property
     def logprobs(self) -> bool:
@@ -280,6 +285,16 @@ class _Request:
         # resilience SLO class: selects the pending line, the gate's
         # degradation band, and the per-class telemetry labels
         self.slo_class = slo_class
+        # disaggregated serving (gofr_tpu/pd/): ``kv_sink`` marks a
+        # PREFILL-ONLY request — prefill runs normally, the slot's KV
+        # streams out through the sink per chunk, the single delivered
+        # token is the sampled first token, and the slot retires
+        # without decoding. ``ingest`` is the DECODE-side mirror:
+        # (HostKV, first_token, first_lp) shipped by a prefill worker —
+        # admission installs the rows instead of dispatching a prefill.
+        self.kv_sink = None
+        self.kv_shipped = 0
+        self.ingest: "tuple | None" = None
 
 
 class _Inflight:
@@ -623,6 +638,10 @@ class GenerationEngine:
         self._pool = None
         self._kvc = None
         self._host_write_jit = None
+        # P/D ingest row-install program (pd/ingest.py): compiled on
+        # first shipped-KV admission — decode-role engines pay one
+        # compile there instead of every engine paying it at startup
+        self._ingest_write_jit = None
         if not self._paged:
             self._prefix_idx = None
             if prefix_cache_slots > 0:
@@ -1142,7 +1161,9 @@ class GenerationEngine:
                  temperature: float = 0.0, top_k: int = 0,
                  eos_id=None, adapter: int = 0,
                  logprobs: bool = False, deadline=None,
-                 slo_class: str | None = None) -> GenStream:
+                 slo_class: str | None = None,
+                 kv_sink=None, ingest=None,
+                 traceparent: str | None = None) -> GenStream:
         """Enqueue a prompt (sequence of token ids); returns a GenStream
         yielding generated ids as the device produces them.
 
@@ -1170,13 +1191,38 @@ class GenerationEngine:
         ``slo-class`` gRPC metadata): latency-class requests pick up
         slots first; throughput-class tolerates longer queueing, is
         shed/browned-out FIRST under pressure, and still drains via the
-        pending line's weighted anti-starvation pickup."""
+        pending line's weighted anti-starvation pickup.
+
+        Disaggregated serving (gofr_tpu/pd/, docs/advanced-guide/
+        disaggregated-serving.md): ``kv_sink`` runs the request
+        PREFILL-ONLY — the stream delivers exactly the sampled first
+        token while the slot's KV ships out through the sink
+        ``(HostKV, start, total)`` per prefill chunk (single-device
+        contiguous engines only). ``ingest=(HostKV, first_token,
+        first_lp)`` is the decode-side mirror: admission installs the
+        shipped rows under an ``hbm`` stage lease instead of running a
+        prefill, then decodes normally. ``traceparent`` overrides the
+        ambient trace context — the cross-process propagation seam, so
+        both pools' spans join ONE distributed trace and the tail
+        sampler's deterministic trace-id verdict keeps or drops the
+        whole handoff together."""
         if self._closed:
             raise GenerationError("generation engine is closed")
         if self._draining:
             raise GenerationError("generation engine is draining")
         if self.down is not None:
             raise GenerationError(f"generation engine is down: {self.down}")
+        if kv_sink is not None and ingest is not None:
+            raise GenerationError("kv_sink and ingest are exclusive "
+                                  "(a request is prefill-only OR "
+                                  "decode-only, never both)")
+        if kv_sink is not None and (self._paged or self.mesh is not None):
+            raise GenerationError("kv_sink (prefill-only serving) "
+                                  "requires a single-device contiguous "
+                                  "engine")
+        if ingest is not None:
+            self._validate_ingest(ingest, np.asarray(prompt,
+                                                     np.int32).reshape(-1))
         if deadline is None:
             deadline = current_deadline()
         if slo_class is None:
@@ -1184,7 +1230,8 @@ class GenerationEngine:
         elif slo_class not in (SLO_LATENCY, SLO_THROUGHPUT):
             raise GenerationError(f"unknown slo_class {slo_class!r}")
         if deadline is not None and deadline.expired():
-            self._count_expired(where="pre-queue")
+            self._count_expired(where="post-handoff" if ingest is not None
+                                else "pre-queue")
             raise DeadlineExceeded("deadline expired before generate() "
                                    "was queued")
         if self.gate is not None:
@@ -1240,17 +1287,30 @@ class GenerationEngine:
                     "TPU_PAGED_BLOCK)"))
                 stream._q.put(None)
                 return stream
+        if traceparent:
+            # explicit cross-process context (the P/D ingest path): the
+            # shipped request's spans must join the PREFILL worker's
+            # trace, not a fresh local one — that one shared trace id
+            # is also what makes both processes' tail samplers agree
+            from .. import tracing
+
+            ids = tracing.parse_traceparent(traceparent)
+            if ids is not None:
+                stream.traceparent = traceparent
+                stream.trace_id = ids[0]
         if self._observe is not None:
             from .. import tracing
 
-            span = tracing.current_span()
-            if span is not None:  # inherit the submitter's trace context
-                stream.traceparent = span.traceparent()
-                stream.trace_id = span.trace_id
-            else:  # mint a trace id so the stage spans still correlate;
-                # no traceparent — they export as roots of that trace
-                # rather than children of a span nobody ever emits
-                stream.trace_id = tracing._new_trace_id()
+            if not stream.trace_id:
+                span = tracing.current_span()
+                if span is not None:  # inherit the submitter's context
+                    stream.traceparent = span.traceparent()
+                    stream.trace_id = span.trace_id
+                else:  # mint a trace id so the stage spans still
+                    # correlate; no traceparent — they export as roots
+                    # of that trace rather than children of a span
+                    # nobody ever emits
+                    stream.trace_id = tracing._new_trace_id()
             # detail.request_id is the FLIGHT-RECORDER key: registry
             # entry ids and stream request ids are separate counters, so
             # /debug/requests must surface the one /debug/events filters
@@ -1274,11 +1334,13 @@ class GenerationEngine:
                     # re-check a racing generate() could slip a request in
                     # after the drain snapshot and silently extend the window
                     raise GenerationError("generation engine is draining")
-                self._pending.put(_Request(stream, prompt, max_new_tokens,
-                                           temperature, top_k, eos_id,
-                                           adapter=int(adapter),
-                                           deadline=deadline,
-                                           slo_class=slo_class))
+                req = _Request(stream, prompt, max_new_tokens,
+                               temperature, top_k, eos_id,
+                               adapter=int(adapter), deadline=deadline,
+                               slo_class=slo_class)
+                req.kv_sink = kv_sink
+                req.ingest = ingest
+                self._pending.put(req)
         except BaseException:
             self._obs_end(stream, "failed", error="rejected at admission")
             raise
@@ -1758,9 +1820,14 @@ class GenerationEngine:
                     continue
                 if req.deadline is not None and req.deadline.expired():
                     # the caller's wire deadline ran out while queued:
-                    # fail fast, never dispatch its prefill
-                    self._count_expired(where="queue",
+                    # fail fast, never dispatch its prefill. Ingested
+                    # (P/D-shipped) requests record where=post-handoff:
+                    # the budget burned AFTER the pool boundary, and
+                    # the wide event on THIS worker is the record
+                    where = self._expiry_where(req, "queue")
+                    self._count_expired(where=where,
                                         request_id=req.stream.request_id)
+                    req.stream.where = where
                     wait_s = time.monotonic() - req.enqueued_at
                     req.stream._q.put(DeadlineExceeded(
                         f"deadline expired after {wait_s:.3f}s in the "
@@ -1785,7 +1852,9 @@ class GenerationEngine:
                     continue
                 blocks = None
                 if self._paged:
-                    blocks = self._paged_admission_blocks(req)
+                    blocks = (self._ingest_blocks(req)
+                              if req.ingest is not None
+                              else self._paged_admission_blocks(req))
                     if blocks is None:
                         # transient pool pressure: requeue and let active
                         # slots retire blocks. (FIFO order is not
@@ -1810,6 +1879,11 @@ class GenerationEngine:
         admission path re-peeks the queue head every ~2 ms poll, and an
         O(entries x prompt) LCP rescan of an unchanged index on the
         serving-loop thread is pure waste."""
+        if req.ingest is not None:
+            # shipped-KV admission: the install is one row write, no
+            # prefill dispatch and no chunk lattice regardless of
+            # prompt length — always safe under an un-reaped block
+            return False
         L = len(req.prompt)
         if L > self._chunk:
             # past the chunk budget (== the largest bucket by default;
@@ -1946,6 +2020,21 @@ class GenerationEngine:
         L = len(req.prompt)
         T = self._chunk
         tslot = slot if track_slot is None else track_slot
+        ship_cap = L
+        if req.kv_sink is not None:
+            # prefill-only: the FINAL chunk re-computes its window
+            # [L - Sb, L) reading already-quantized cache for the
+            # earlier positions, so on int8 caches the overlap's
+            # layer>0 KV differs from the mid-chunk version by one
+            # int8 round trip — and the slot row keeps the FINAL
+            # version. Mid-chunk shipping stops at the final window's
+            # start; the overlap ships from the settled row in _start,
+            # keeping the shipped stream bit-identical to the row (the
+            # decode pool must replicate THIS engine's cache exactly).
+            rem = L - pos
+            while rem > T:
+                rem -= T
+            ship_cap = L - pad_bucket(rem, self.prompt_buckets)
         while L - pos > T:
             if req.stream.cancelled.is_set():
                 return 0, 0.0
@@ -1971,6 +2060,18 @@ class GenerationEngine:
                                req.stream.request_id)
             if self.metrics is not None:
                 self.metrics.increment_counter("app_tpu_prefill_chunks_total")
+            if req.kv_sink is not None and attr == "cache":
+                # prefill-only: stream the chunk's KV out NOW — the
+                # decode peer's host-side assembly (and the wire
+                # transfer) overlaps the remaining chunks' compute, so
+                # the handoff costs one tail ship, not a whole-prompt
+                # serialization (capped before the final window — see
+                # ship_cap above). The row read blocks on this chunk's
+                # dispatch; a ship failure cancels the request (never
+                # the loop).
+                if not self._ship_range(attr, slot, req,
+                                        min(pos, ship_cap)):
+                    return 0, 0.0
             if not self._chunk_interleave:
                 continue
             # Yield between chunks — everything below already runs
@@ -2012,6 +2113,7 @@ class GenerationEngine:
             return False
         self._count_expired(where="mid-prefill",
                             request_id=req.stream.request_id)
+        req.stream.where = "mid-prefill"
         req.stream.failed = "deadline expired mid-prefill"
         req.stream._q.put(DeadlineExceeded(
             f"deadline expired after {pos}/{len(req.prompt)} prompt "
@@ -2035,8 +2137,10 @@ class GenerationEngine:
         req = slot.request
         if req is None or req.deadline is None or not req.deadline.expired():
             return False
-        self._count_expired(where="mid-decode",
+        where = self._expiry_where(req, "mid-decode")
+        self._count_expired(where=where,
                             request_id=req.stream.request_id)
+        req.stream.where = where
         req.stream.failed = "deadline expired mid-decode"
         req.stream._q.put(DeadlineExceeded(
             f"deadline expired after {slot.generated} generated tokens"))
@@ -2207,17 +2311,20 @@ class GenerationEngine:
         req.kv_match = (ver, mt)
         return mt
 
-    def _kv_row_get(self, store, row: int, plen: int) -> HostKV:
-        """Fetch the first ``plen`` positions of one pool/cache row to
-        host numpy — the spill half of T1 offload and the read half of
-        T2 write-through. Single-device only (on a mesh this would
-        gather the sharded row; offload tiers are gated off there)."""
+    def _kv_row_get(self, store, row: int, plen: int,
+                    start: int = 0) -> HostKV:
+        """Fetch positions ``[start, plen)`` of one pool/cache row to
+        host numpy — the spill half of T1 offload, the read half of
+        T2 write-through, and (``start > 0``) the incremental KV-ship
+        reads of a prefill worker. Single-device only (on a mesh this
+        would gather the sharded row; offload tiers are gated off
+        there)."""
         quant = store.k_scale is not None
         return HostKV(
-            np.asarray(store.k[:, row, :plen]),
-            np.asarray(store.v[:, row, :plen]),
-            np.asarray(store.k_scale[:, row, :plen]) if quant else None,
-            np.asarray(store.v_scale[:, row, :plen]) if quant else None)
+            np.asarray(store.k[:, row, start:plen]),
+            np.asarray(store.v[:, row, start:plen]),
+            np.asarray(store.k_scale[:, row, start:plen]) if quant else None,
+            np.asarray(store.v_scale[:, row, start:plen]) if quant else None)
 
     def _offload_victim(self, victim) -> None:
         """Spill a T0-evicted entry's pool row to the host tier. MUST
@@ -2257,6 +2364,197 @@ class GenerationEngine:
             pad(kv.v_scale, self._pool.v_scale) if quant else None,
             jnp.int32(row))
         return row
+
+    # -- disaggregated serving (gofr_tpu/pd/) --------------------------------
+    @staticmethod
+    def _expiry_where(req: _Request, default: str) -> str:
+        """Expiry-site label for telemetry: ingested (P/D-shipped)
+        requests died AFTER the pool handoff — the decode worker's
+        wide event says so, whatever stage the local default names."""
+        return "post-handoff" if req.ingest is not None else default
+
+    def _ship_range(self, attr: str, row: int, req: _Request,
+                    upto: int) -> bool:
+        """Prefill-only KV ship: snapshot prompt positions
+        ``[req.kv_shipped, upto)`` of the slot row and hand them to the
+        request's sink (the PD shipper frames + sends them). A sink
+        failure — peer gone, ship window stalled past its deadline —
+        fails THIS request (cancel-retire path) and returns False; it
+        must never surface into the loop's device-loss recovery, the
+        engine is healthy."""
+        if req.kv_sink is None or upto <= req.kv_shipped:
+            return True
+        if req.stream.cancelled.is_set():
+            # a dead request (client cancel, or an earlier ship failure
+            # that already cancelled it) must not re-block the serving
+            # loop for another window deadline shipping KV nobody will
+            # ingest — _start's tail ship hits this after a mid-lattice
+            # failure
+            return False
+        try:
+            kv = self._kv_row_get(getattr(self, attr), row, upto,
+                                  start=req.kv_shipped)
+            req.kv_sink(kv, req.kv_shipped, len(req.prompt))
+            req.kv_shipped = upto
+            return True
+        except BaseException as e:  # noqa: BLE001 — per-request failure
+            req.stream.failed = f"kv ship failed: {e!r}"
+            req.stream._q.put(GenerationError(f"kv ship failed: {e!r}"))
+            req.stream.cancel()
+            if self._observe is not None:
+                self._observe.recorder.record(
+                    "kv_ship_failed", request_id=req.stream.request_id,
+                    trace_id=req.stream.trace_id,
+                    shipped=req.kv_shipped, prompt_len=len(req.prompt),
+                    error=repr(e))
+            if self.logger is not None:
+                self.logger.warn({"event": "pd kv ship failed",
+                                  "request_id": req.stream.request_id,
+                                  "shipped": req.kv_shipped,
+                                  "error": repr(e)})
+            return False
+
+    def _validate_ingest(self, ingest, prompt: np.ndarray) -> None:
+        """Reject a shipped-KV payload that cannot land in THIS
+        engine's cache before it is ever queued: the ingest server
+        relays the raised error typed; nothing here touches the
+        device. (Frame-level integrity — checksum, truncation — was
+        already enforced per frame by quant.decode_block at the
+        transfer boundary.)"""
+        kv, _, _ = ingest
+        if self.mesh is not None:
+            raise GenerationError("KV ingest requires a single-device "
+                                  "decode engine (sharded install does "
+                                  "not partition)")
+        if kv.plen != len(prompt):
+            raise GenerationError(
+                f"ingest KV covers {kv.plen} tokens but the prompt has "
+                f"{len(prompt)} — the transfer is incomplete")
+        cfg = self.cfg
+        if (kv.k.shape[0] != cfg.n_layers
+                or kv.k.shape[2:] != (cfg.n_kv_heads, cfg.head_dim)):
+            raise GenerationError(
+                f"ingest KV layout {kv.k.shape} does not match this "
+                f"engine ({cfg.n_layers} layers, {cfg.n_kv_heads} KV "
+                f"heads, head_dim {cfg.head_dim})")
+        quant = self.cache.k_scale is not None
+        if quant and kv.k_scale is None:
+            raise GenerationError("ingest KV lacks scale planes but the "
+                                  "serving cache is int8-quantized")
+        if str(kv.k.dtype) != str(self.cache.k.dtype):
+            raise GenerationError(
+                f"ingest KV dtype {kv.k.dtype} != serving cache dtype "
+                f"{self.cache.k.dtype}")
+
+    def _ingest_blocks(self, req: _Request) -> "tuple[list, int, list] | None":
+        """Paged-pool blocks for one shipped-KV admission: all fresh
+        (the shipped rows are installed, not prefix-matched), evicting
+        LRU stored prefixes under pressure exactly like a local
+        admission. None = transient shortage, requeue."""
+        need = -(-len(req.prompt) // self._block_t)
+        fresh = self._alloc.alloc(need)
+        while fresh is None and self._prefix_idx is not None \
+                and self._prefix_idx.evict_one():
+            fresh = self._alloc.alloc(need)
+        if fresh is None:
+            return None
+        return [], 0, fresh
+
+    def _ingest_install(self, idx: int, req: _Request,
+                        fresh: "list | None") -> tuple[int, float]:
+        """Land a prefill worker's shipped KV in slot ``idx`` with ZERO
+        prefill FLOPs: pad the host rows to the compiled row shape and
+        install them — contiguous engines write the serving row
+        directly; paged engines stage through the dense scratch row
+        and land it in their ``fresh`` pool blocks (the same two
+        programs the T1/T2 promote and long-prompt admission paths
+        compile). The transient padded upload is leased from the HBM
+        arbiter first (``pd-ingest`` stage, PRI_SCRATCH): under memory
+        pressure the request SHEDS 429 at the boundary instead of
+        OOMing the decode pool. T0 promotion then rides the normal
+        ``_prefix_store`` in _start — an ingested prompt's KV lands in
+        a pool row / shared-block entry exactly like a locally
+        prefilled one, so repeat traffic hits locally next time."""
+        kv, first, first_lp = req.ingest
+        L = kv.plen
+        self._slot_adapter[idx] = req.adapter
+        self._touch("adapters")
+        if self._paged:
+            self._ensure_scratch()
+            target_attr = "_scratch"
+            row = 0
+        else:
+            target_attr = "cache"
+            row = idx
+        target = getattr(self, target_attr)
+        quant = target.k_scale is not None
+
+        def pad(a, like):
+            out = np.zeros((a.shape[0], 1, self.max_seq) + a.shape[2:],
+                           np.dtype(str(like.dtype)))
+            out[:, 0, :L] = a
+            return out
+
+        k_p, v_p = pad(kv.k, target.k), pad(kv.v, target.v)
+        ks_p = pad(kv.k_scale, target.k_scale) if quant else None
+        vs_p = pad(kv.v_scale, target.v_scale) if quant else None
+        stage = k_p.nbytes + v_p.nbytes \
+            + (ks_p.nbytes + vs_p.nbytes if quant else 0)
+        # the stage lease is the admission's honest memory claim: the
+        # padded device upload lives until the row write consumes it
+        hbm.lease("pd-ingest", stage, owner=self, tag="stage",
+                  priority=hbm.PRI_SCRATCH)
+        try:
+            if self._ingest_write_jit is None:
+                self._ingest_write_jit = jax.jit(_write_row_from_host,
+                                                 donate_argnums=(0,))
+            installed = self._ingest_write_jit(
+                target, jnp.asarray(k_p), jnp.asarray(v_p),
+                jnp.asarray(ks_p) if quant else None,
+                jnp.asarray(vs_p) if quant else None, jnp.int32(row))
+            setattr(self, target_attr, installed)
+            if self._paged:
+                self._slot_blocks[idx] = list(fresh)
+                self._cursors[idx] = L
+                write_blocks = list(fresh) + [0] * (self._mb - len(fresh))
+                self.cache = self._row_to_blocks_jit(
+                    self.cache, self._scratch,
+                    jnp.asarray(write_blocks, jnp.int32))
+                self._write_table_row(idx)
+            self.cache = self.cache._replace(
+                lengths=self.cache.lengths.at[idx].set(L))
+        finally:
+            hbm.release("pd-ingest", owner=self, tag="stage")
+        req.stream.cache_tier = "pd-ship"
+        req.stream.cache_tokens = L
+        if self._tl is not None:
+            self._tl.kvcache("pd", L, idx)
+        if self.metrics is not None:
+            try:
+                self.metrics.increment_counter(
+                    "app_tpu_pd_ingests_total")
+            except Exception:
+                pass
+        return int(first), float(first_lp)
+
+    def _ensure_scratch(self) -> None:
+        """Paged decode workers built without a chunk scratch (short
+        max_seq, no prefix index) grow one lazily at the first ingest:
+        the dense staging row and the row->blocks program are the same
+        machinery long-prompt admission compiles."""
+        if hasattr(self, "_scratch"):
+            return
+        from ..models.paged_llama import (read_blocks_to_row,
+                                          write_row_to_blocks)
+
+        self._scratch = hbm.alloc(
+            "engine", lambda: llama.init_cache(self.cfg, 1, self.max_seq,
+                                               dtype=self._kv_dtype),
+            owner=self, tag="scratch", priority=hbm.PRI_SCRATCH)
+        self._row_to_blocks_jit = jax.jit(write_row_to_blocks,
+                                          donate_argnums=(0,))
+        self._blocks_to_row_jit = jax.jit(read_blocks_to_row,
+                                          donate_argnums=(0,))
 
     def _prefix_restore(self, idx: int, req: _Request, L: int,
                         C: int) -> int:
@@ -2544,6 +2842,10 @@ class GenerationEngine:
         })
         if "error" in fields:
             wide["error"] = fields["error"]
+        if stream.where is not None:
+            # the deadline-expiry site — "post-handoff" on a decode
+            # worker says the budget died AFTER the P/D pool boundary
+            wide["where"] = stream.where
         if self._observe is not None:
             self._observe.recorder.record(
                 "request", request_id=stream.request_id,
@@ -2659,12 +2961,32 @@ class GenerationEngine:
         slot.request = req
         try:
             chaos.fire(chaos.GENERATOR_PREFILL)
-            if self._paged:
+            if req.ingest is not None:
+                first, first_lp = self._ingest_install(
+                    idx, req, blocks[2] if blocks else None)
+            elif self._paged:
                 shared, m, fresh = blocks
                 first, first_lp = self._paged_admit_prefill(
                     idx, req, shared, m, fresh)
             else:
                 first, first_lp = self._admit_prefill(idx, req)
+        except hbm.HBMExhausted as e:
+            # the ingest stage lease (or any admission-path lease)
+            # could not be covered: this is MEMORY pressure, served as
+            # a 429 shed of THIS request — never loop recovery. The
+            # typed error rides the stream back (for P/D requests: over
+            # the wire through the prefill worker to the client).
+            if self._paged and blocks:
+                shared, _, fresh = blocks
+                self._slot_blocks[idx] = []
+                self._table[idx, :] = 0
+                self._cursors[idx] = 0
+                self._touch("table")
+                self._alloc.free(shared + fresh)
+            slot.request = None
+            self._shed_oom(req, e)
+            self._obs_gauges()
+            return
         except BaseException as e:  # noqa: BLE001 — the request is already
             # off the pending queue and owns no slot: fail ITS stream here,
             # then let _loop's handler deal with engine-level fallout.
@@ -2703,6 +3025,13 @@ class GenerationEngine:
         self._obs_span("tpu.prefill", t0, prefill_done, req.stream,
                        {"slot": idx, "prompt_len": len(req.prompt),
                         "slo_class": req.slo_class})
+        if req.kv_sink is not None:
+            # prefill-only: ship the tail the chunk hooks haven't sent
+            # (the whole row for bucket prompts) BEFORE the first-token
+            # delivery — frame order on the wire is the ingest
+            # contract. A ship failure cancelled the stream; _deliver
+            # retires the slot on that flag below.
+            self._ship_range("cache", idx, req, len(req.prompt))
         self._prefix_store(idx, req)
         if self._spec_k:
             self._hist_set(idx, req.prompt)
@@ -2710,7 +3039,9 @@ class GenerationEngine:
             self.metrics.record_histogram("app_tpu_batch_wait_duration",
                                           t0 - req.enqueued_at, program="generate")
         slot.generated = 0
-        slot.remaining = req.max_new
+        # prefill-only requests deliver exactly the sampled first token
+        # and retire — the DECODE pool owns the rest of the budget
+        slot.remaining = 1 if req.kv_sink is not None else req.max_new
         self.total_requests += 1
         self._temps[idx] = req.temperature
         self._top_ks[idx] = req.top_k

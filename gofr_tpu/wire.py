@@ -161,6 +161,15 @@ class SocketWriter:
         """Blocking drain of any backlog left by nonblocking writes."""
         self.write([], block=True)
 
+    @property
+    def backlog_bytes(self) -> int:
+        """Bytes parked by nonblocking writes and not yet on the wire —
+        the flow-control signal windowed producers (the PD KV-ship
+        path) bound themselves against instead of letting the backlog
+        grow without limit on a stalled peer."""
+        with self._blk:
+            return len(self._backlog)
+
     def close(self) -> None:
         with self._blk:
             self._closed = True

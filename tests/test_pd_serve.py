@@ -1,0 +1,650 @@
+"""Disaggregated prefill/decode serving (ISSUE 12): dedicated pools
+with KV shipped over the wire fast path.
+
+What these tests pin, in order of altitude:
+
+  - protocol units: framing round trip, typed error mapping across the
+    wire (429 stays a shed with Retry-After, 504 stays deadline, 502
+    stays a transfer fault), hello refusal rules;
+  - the generator's two new admission flavors: ``kv_sink`` (prefill-
+    only — one delivered token, the slot's KV streamed out in
+    contiguous ranges that are BIT-IDENTICAL to the settled row,
+    including the final-chunk overlap rewrite on int8 caches) and
+    ``ingest`` (shipped-KV install — zero prefill FLOPs, token-exact
+    against the fused engine on contiguous AND paged decode engines);
+  - the full socket path: PDPrefill -> KVIngestServer over localhost,
+    token-exact vs fused across bucket/chunked prompt lengths;
+  - the transfer-boundary failure matrix (the acceptance satellite):
+    truncated frames, corrupted bytes, out-of-order ranges and
+    incomplete transfers each fail exactly ONE request with a typed
+    error — the pool row is never poisoned (the next request on the
+    same worker serves token-exact) and the ingest loop survives, on
+    both contiguous and paged decode engines;
+  - cross-boundary deadline + trace propagation: the shipped request's
+    deadline expires DECODE-side with a ``where=post-handoff`` wide
+    event, and the decode-side stream joins the prefill worker's W3C
+    trace id (what makes the tail sampler's deterministic verdict
+    cover the whole cross-process trace);
+  - resilience: decode-side HBM exhaustion sheds 429 + Retry-After
+    through the prefill worker; a killed decode peer sheds in-flight
+    relays typed 503 while the prefill worker keeps serving and
+    recovers on reconnect.
+"""
+
+import io
+import json
+import socket
+import struct
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.errors import DeadlineExceeded, TooManyRequests
+from gofr_tpu.glog import Logger, LogLevel
+from gofr_tpu.metrics import Manager, register_framework_metrics
+from gofr_tpu.models import LLAMA_CONFIGS, llama
+from gofr_tpu.observe import Observe, Timeline
+from gofr_tpu.pd import (DecodePeerUnavailable, KVIngestServer, KVTransferError,
+                         PDPrefill, parse_role)
+from gofr_tpu.pd import protocol as pdp
+from gofr_tpu.resilience import Deadline
+from gofr_tpu.tpu import GenerationEngine, hbm
+from gofr_tpu.tpu.kvcache import model_fingerprint
+from gofr_tpu.tpu.kvcache.quant import concat_blocks
+
+TINY = LLAMA_CONFIGS["tiny"]
+MAX_NEW = 10
+
+
+@pytest.fixture(autouse=True)
+def _clean_arbiter():
+    hbm.reset()
+    yield
+    hbm.reset()
+    import gc
+
+    gc.collect()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init(TINY, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def fingerprint(params):
+    return model_fingerprint(TINY, params, extra="pd")
+
+
+def _engine(params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_seq", 128)
+    kw.setdefault("prompt_buckets", (16, 32))
+    kw.setdefault("kv_dtype", jnp.int8)
+    return GenerationEngine(TINY, params, **kw)
+
+
+def _prompt(n, seed=7):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, TINY.vocab_size, n).tolist()
+
+
+@pytest.fixture(scope="module")
+def refs(params):
+    """Fused-engine reference streams for the exactness gates (one
+    engine, computed once for the module)."""
+    eng = _engine(params)
+    try:
+        return {n: eng.generate(_prompt(n), max_new_tokens=MAX_NEW).tokens()
+                for n in (10, 40, 100)}
+    finally:
+        eng.close()
+
+
+# -- protocol units -----------------------------------------------------------
+
+def test_protocol_frame_round_trip():
+    a, b = socket.socketpair()
+    try:
+        msgs = [pdp.pack_json(pdp.REQ, 7, {"prompt": [1, 2], "plen": 2}),
+                pdp.pack_kv(7, 16, b"\x01" * 40),
+                pdp.pack_tok(7, 123, -1.5),
+                pdp.pack_msg(pdp.CANCEL, 7)]
+        a.sendall(b"".join(msgs))
+        got = [pdp.read_msg(b) for _ in msgs]
+        assert [g[0] for g in got] == [pdp.REQ, pdp.KV, pdp.TOK, pdp.CANCEL]
+        assert all(g[1] == 7 for g in got)
+        assert json.loads(bytes(got[0][2]))["plen"] == 2
+        start, frame = pdp.unpack_kv(got[1][2])
+        assert start == 16 and frame == b"\x01" * 40
+        tok, lp = pdp.unpack_tok(got[2][2])
+        assert tok == 123 and abs(lp - (-1.5)) < 1e-6
+        a.close()
+        assert pdp.read_msg(b) is None  # EOF
+    finally:
+        b.close()
+
+
+def test_protocol_oversized_length_reads_as_eof():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack("<I", pdp.MAX_MSG + 1) + b"x" * 16)
+        assert pdp.read_msg(b) is None
+    finally:
+        a.close()
+        b.close()
+
+
+def test_typed_errors_survive_the_wire():
+    for err, cls in ((TooManyRequests("shed", retry_after=2.5),
+                      TooManyRequests),
+                     (DeadlineExceeded("late"), DeadlineExceeded),
+                     (pdp.KVTransferError("bad frame"), KVTransferError),
+                     (pdp.DecodePeerUnavailable("down", retry_after=3.0),
+                      DecodePeerUnavailable)):
+        back = pdp.error_from_wire(pdp.error_to_wire(err))
+        assert isinstance(back, cls), (err, back)
+        assert back.status_code == err.status_code
+    shed = pdp.error_from_wire(pdp.error_to_wire(
+        TooManyRequests("x", retry_after=2.5)))
+    assert shed.retry_after == 2.5 and "Retry-After" in shed.headers
+
+
+def test_hello_mismatch_rules(fingerprint):
+    from gofr_tpu.tpu.kvcache import KVLayout
+
+    layout = KVLayout(TINY.n_layers, TINY.n_kv_heads, TINY.head_dim, True,
+                      np.dtype(np.int8), 128)
+    mine = pdp.hello_payload(fingerprint, layout)
+    assert pdp.hello_mismatch(mine, dict(mine)) is None
+    assert "fingerprint" in pdp.hello_mismatch(
+        mine, {**mine, "fingerprint": "other"})
+    assert "kv_heads" in pdp.hello_mismatch(
+        mine, {**mine, "kv_heads": TINY.n_kv_heads + 1})
+    assert "version" in pdp.hello_mismatch(mine, {**mine, "version": 99})
+
+
+def test_parse_role_rejects_unknown():
+    assert parse_role(None) == "fused"
+    assert parse_role(" Decode ") == "decode"
+    with pytest.raises(ValueError):
+        parse_role("both")
+
+
+# -- generator: kv_sink (prefill-only) ---------------------------------------
+
+def test_kv_only_ships_row_identical_ranges(params):
+    """The shipped ranges are contiguous, cover the prompt, and are
+    bit-identical to the settled slot row — including the final-chunk
+    overlap, which on int8 caches is REWRITTEN by the final chunk's
+    recompute and must ship in its settled form."""
+    eng = _engine(params)
+    try:
+        prompt = _prompt(40)
+        shipped = []
+        s = eng.generate(prompt, max_new_tokens=MAX_NEW, logprobs=True,
+                         kv_sink=lambda kv, st, tot: shipped.append((kv, st)))
+        toks = list(s)
+        assert len(toks) == 1  # exactly the sampled first token
+        first, lp = toks[0]
+        assert isinstance(first, int) and isinstance(lp, float)
+        # contiguous cover of [0, L)
+        pos = 0
+        for kv, st in shipped:
+            assert st == pos
+            pos += kv.plen
+        assert pos == 40
+        assert len(shipped) >= 2  # chunked: mid ranges + settled tail
+        time.sleep(0.2)  # let the loop settle the row
+        whole = concat_blocks([kv for kv, _ in shipped])
+        row = eng._kv_row_get(eng.cache, 0, 40)
+        assert np.array_equal(whole.k, row.k)
+        assert np.array_equal(whole.v, row.v)
+        assert np.array_equal(whole.k_scale, row.k_scale)
+        # the slot retired: a second request admits into a free slot
+        assert eng.stats()["active"] == 0
+    finally:
+        eng.close()
+
+
+def test_kv_only_rejected_on_paged_and_with_ingest(params):
+    eng = _engine(params, paged_blocks=24, paged_block_size=16)
+    try:
+        from gofr_tpu.tpu import GenerationError
+
+        with pytest.raises(GenerationError):
+            eng.generate(_prompt(10), kv_sink=lambda *a: None)
+    finally:
+        eng.close()
+
+
+def test_kv_sink_failure_fails_request_not_engine(params):
+    """A sink that raises (peer died, window stalled) fails THAT
+    request through the cancel-retire path; the engine keeps serving
+    the next request token-exact — never loop recovery."""
+    eng = _engine(params)
+    try:
+        def bad_sink(kv, st, tot):
+            raise OSError("peer vanished")
+
+        s = eng.generate(_prompt(40), max_new_tokens=4, kv_sink=bad_sink)
+        with pytest.raises(Exception, match="kv ship failed"):
+            s.tokens()
+        # engine alive and exact afterwards
+        out = eng.generate(_prompt(40), max_new_tokens=MAX_NEW).tokens()
+        ref = _engine(params)
+        try:
+            want = ref.generate(_prompt(40), max_new_tokens=MAX_NEW).tokens()
+        finally:
+            ref.close()
+        assert out == want
+        assert eng.down is None
+    finally:
+        eng.close()
+
+
+# -- generator: ingest (decode-side install) ---------------------------------
+
+def _prefill_kv(params, prompt):
+    """Run a real prefill-only pass and return (HostKV, first, lp)."""
+    pre = _engine(params)
+    try:
+        shipped = []
+        s = pre.generate(prompt, max_new_tokens=MAX_NEW, logprobs=True,
+                         kv_sink=lambda kv, st, tot: shipped.append(kv))
+        first, lp = list(s)[0]
+        return concat_blocks(shipped), first, lp
+    finally:
+        pre.close()
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["contiguous", "paged"])
+def test_ingest_token_exact_vs_fused(params, refs, paged):
+    kw = {"paged_blocks": 24, "paged_block_size": 16} if paged else {}
+    dec = _engine(params, **kw)
+    try:
+        for n in (10, 40, 100):
+            kv, first, lp = _prefill_kv(params, _prompt(n))
+            out = dec.generate(_prompt(n), max_new_tokens=MAX_NEW,
+                               ingest=(kv, first, lp)).tokens()
+            assert out == refs[n], (n, out, refs[n])
+    finally:
+        dec.close()
+
+
+def test_ingest_validation_rejects_mismatched_payloads(params):
+    from gofr_tpu.tpu import GenerationError
+
+    dec = _engine(params)
+    try:
+        kv, first, lp = _prefill_kv(params, _prompt(20))
+        with pytest.raises(GenerationError, match="incomplete"):
+            dec.generate(_prompt(21), ingest=(kv, first, lp))
+        bad = kv._replace(k=kv.k[:1])  # wrong layer count
+        with pytest.raises(GenerationError, match="layout"):
+            dec.generate(_prompt(20), ingest=(bad, first, lp))
+        noscale = kv._replace(k_scale=None, v_scale=None)
+        with pytest.raises(GenerationError, match="scale"):
+            dec.generate(_prompt(20), ingest=(noscale, first, lp))
+    finally:
+        dec.close()
+
+
+def test_ingest_promotes_into_t0_pool_row(params):
+    """Ingested KV rides the normal prefix-store: a repeat of the same
+    prompt on the decode worker hits the LOCAL T0 pool (one row copy,
+    no second ship needed)."""
+    dec = _engine(params, prefix_cache_slots=2, prefix_store_min=16)
+    try:
+        prompt = _prompt(40)
+        kv, first, lp = _prefill_kv(params, prompt)
+        s1 = dec.generate(prompt, max_new_tokens=MAX_NEW,
+                          ingest=(kv, first, lp))
+        out1 = s1.tokens()
+        assert s1.cache_tier == "pd-ship" and s1.cache_tokens == 40
+        # repeat LOCALLY (fused-style): must hit t0
+        s2 = dec.generate(prompt, max_new_tokens=MAX_NEW)
+        out2 = s2.tokens()
+        assert out2 == out1
+        assert s2.cache_tier == "t0" and s2.cache_tokens > 0
+    finally:
+        dec.close()
+
+
+def test_ingest_deadline_expiry_is_post_handoff(params):
+    """A shipped request whose deadline dies on the decode worker
+    emits the wide event with where=post-handoff — the cross-process
+    debugging breadcrumb the ISSUE names."""
+    m = Manager()
+    register_framework_metrics(m)
+    buf = io.StringIO()
+    log = Logger(level=LogLevel.INFO, out=buf, err=buf, pretty=False)
+    obs = Observe(metrics=m, timeline=Timeline(capacity=512))
+    dec = _engine(params, metrics=m, observe=obs, logger=log)
+    try:
+        kv, first, lp = _prefill_kv(params, _prompt(20))
+        # blockade: both slots busy with long local streams, so the
+        # shipped request deterministically waits out its deadline in
+        # the queue (the transfer burned it) and expires DECODE-side
+        busy = [dec.generate(_prompt(20, seed=s), max_new_tokens=100)
+                for s in (1, 2)]
+        for b in busy:
+            next(iter(b))  # both admitted and streaming
+        s = dec.generate(_prompt(20), max_new_tokens=MAX_NEW,
+                         ingest=(kv, first, lp),
+                         deadline=Deadline.after(0.005))
+        with pytest.raises(DeadlineExceeded):
+            s.tokens()
+        for b in busy:
+            b.cancel()
+        time.sleep(0.3)  # _obs_end lands after the stream's error puts
+        wide = []
+        for line in buf.getvalue().splitlines():
+            try:
+                msg = json.loads(line).get("message")
+            except ValueError:
+                continue
+            if isinstance(msg, dict) and msg.get("event") == "request":
+                wide.append(msg)
+        expired = [w for w in wide if w.get("where")]
+        assert len(expired) == 1
+        assert expired[0]["outcome"] == "failed"
+        assert expired[0]["where"] == "post-handoff"
+    finally:
+        dec.close()
+
+
+def test_ingest_joins_the_shippers_trace(params):
+    """traceparent propagation: the decode-side stream adopts the
+    prefill worker's trace id, so both processes' spans join one
+    distributed trace and the tail sampler's deterministic trace-id
+    hash keeps/drops the whole handoff together."""
+    obs = Observe(timeline=Timeline(capacity=256))
+    dec = _engine(params, observe=obs)
+    try:
+        kv, first, lp = _prefill_kv(params, _prompt(10))
+        tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        s = dec.generate(_prompt(10), max_new_tokens=4,
+                         ingest=(kv, first, lp), traceparent=tp)
+        s.tokens()
+        assert s.trace_id == "ab" * 16
+        assert s.traceparent == tp
+    finally:
+        dec.close()
+
+
+def test_ingest_hbm_exhaustion_sheds_429(params):
+    """Decode-side memory pressure at the ingest stage lease degrades
+    the ONE request to a 429 + Retry-After (HBMExhausted IS
+    TooManyRequests) — the engine keeps serving."""
+    dec = _engine(params)
+    try:
+        kv, first, lp = _prefill_kv(params, _prompt(40))
+        stage = 4 * kv.k.nbytes  # padded upload is bigger than raw
+        hbm.set_budget(hbm.arbiter_stats()["in_use_bytes"] + stage // 8)
+        s = dec.generate(_prompt(40), max_new_tokens=4,
+                         ingest=(kv, first, lp))
+        with pytest.raises(TooManyRequests) as ei:
+            s.tokens()
+        assert ei.value.status_code == 429
+        assert ei.value.retry_after is not None
+        hbm.set_budget(None)
+        # alive and exact after the pressure clears
+        out = dec.generate(_prompt(40), max_new_tokens=4,
+                           ingest=(kv, first, lp)).tokens()
+        assert len(out) == 4 and dec.down is None
+    finally:
+        hbm.set_budget(None)
+        dec.close()
+
+
+# -- the socket path ----------------------------------------------------------
+
+@pytest.fixture()
+def pd_pair(params, fingerprint):
+    """A live (prefill worker, decode worker) pair over localhost."""
+    dec = _engine(params)
+    srv = KVIngestServer(dec, fingerprint, "127.0.0.1", 0)
+    pre = _engine(params)
+    pd = PDPrefill(pre, fingerprint, "127.0.0.1", srv.port, ship_block=16)
+    yield pd, pre, srv, dec
+    pd.close()
+    srv.close()
+    pre.close()
+    dec.close()
+
+
+def test_socket_end_to_end_token_exact(pd_pair, refs):
+    pd = pd_pair[0]
+    for n in (10, 40, 100):
+        out = pd.generate(_prompt(n), max_new_tokens=MAX_NEW).tokens()
+        assert out == refs[n], (n, out, refs[n])
+    assert pd.stats()["relayed"] == 3
+
+
+def test_relay_stream_supports_transport_sinks(pd_pair, refs):
+    """RelayStream is a PushStream: a transport's zero-handoff sink
+    sees every token (the gRPC/HTTP streamers work unchanged on a
+    prefill worker)."""
+    pd = pd_pair[0]
+    got, done = [], threading.Event()
+    rs = pd.generate(_prompt(10), max_new_tokens=MAX_NEW)
+    rs.set_sink(lambda item: (got.append(item), True)[1])
+    # terminal rides the queue: drain it to observe the end
+    for _ in rs:
+        pass
+    done.set()
+    assert got == refs[10]
+
+
+def test_peer_kill_sheds_typed_and_recovers(params, fingerprint, refs):
+    """The acceptance arm: kill the decode worker mid-run — in-flight
+    relays shed typed 503 + Retry-After, the prefill worker keeps
+    serving, and a restarted decode pool serves token-exact again."""
+    dec = _engine(params)
+    srv = KVIngestServer(dec, fingerprint, "127.0.0.1", 0)
+    pre = _engine(params)
+    pd = PDPrefill(pre, fingerprint, "127.0.0.1", srv.port, ship_block=16)
+    try:
+        assert pd.generate(_prompt(40), max_new_tokens=MAX_NEW).tokens() \
+            == refs[40]
+        rs = pd.generate(_prompt(40), max_new_tokens=64)
+        it = iter(rs)
+        next(it)          # streaming...
+        srv.close()
+        dec.close()       # decode worker dies mid-stream
+        with pytest.raises(DecodePeerUnavailable) as ei:
+            for _ in it:
+                pass
+        assert ei.value.status_code == 503
+        assert "Retry-After" in ei.value.headers
+        # the prefill worker's OWN engine is untouched
+        assert pre.down is None
+        local = pre.generate(_prompt(10), max_new_tokens=MAX_NEW,
+                             logprobs=True, kv_sink=lambda *a: None)
+        assert len(list(local)) == 1
+        # decode pool restarts; the coordinator reconnects and serves
+        dec2 = _engine(params)
+        srv2 = KVIngestServer(dec2, fingerprint, "127.0.0.1", 0)
+        try:
+            pd.peer = ("127.0.0.1", srv2.port)
+            pd._down_until = 0.0
+            out = pd.generate(_prompt(40), max_new_tokens=MAX_NEW).tokens()
+            assert out == refs[40]
+            assert pd.stats()["peer_losses"] == 1
+        finally:
+            srv2.close()
+            dec2.close()
+    finally:
+        pd.close()
+        srv.close()
+        pre.close()
+        dec.close()
+
+
+def test_decode_shed_relays_429_over_the_wire(params, fingerprint):
+    """Decode-side HBMExhausted crosses the boundary typed: the client
+    on the prefill worker sees 429 + Retry-After, and the next request
+    (pressure cleared) serves."""
+    dec = _engine(params)
+    srv = KVIngestServer(dec, fingerprint, "127.0.0.1", 0)
+    pre = _engine(params)
+    pd = PDPrefill(pre, fingerprint, "127.0.0.1", srv.port, ship_block=16)
+    try:
+        # first request warms the connection and both engines' programs
+        assert len(pd.generate(_prompt(40),
+                               max_new_tokens=4).tokens()) == 4
+        hbm.set_budget(hbm.arbiter_stats()["in_use_bytes"] + 1024)
+        rs = pd.generate(_prompt(40), max_new_tokens=4)
+        with pytest.raises(TooManyRequests) as ei:
+            rs.tokens()
+        assert ei.value.status_code == 429
+        hbm.set_budget(None)
+        assert len(pd.generate(_prompt(40),
+                               max_new_tokens=4).tokens()) == 4
+    finally:
+        hbm.set_budget(None)
+        pd.close()
+        srv.close()
+        pre.close()
+        dec.close()
+
+
+# -- transfer-boundary corruption (the acceptance satellite) ------------------
+
+class _RawClient:
+    """A hand-rolled protocol speaker for injecting malformed frames."""
+
+    def __init__(self, port: int, hello: dict):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+        self.sock.sendall(pdp.pack_json(pdp.HELLO, 0, hello))
+        mtype, _, _ = pdp.read_msg(self.sock)
+        assert mtype == pdp.HELLO_OK
+
+    def send(self, msg: bytes) -> None:
+        self.sock.sendall(msg)
+
+    def expect(self, want_type: int, req_id: int):
+        while True:
+            msg = pdp.read_msg(self.sock)
+            assert msg is not None, "connection died awaiting reply"
+            mtype, rid, payload = msg
+            if rid == req_id and mtype == want_type:
+                return payload
+            assert mtype in (pdp.TOK, pdp.END, pdp.ERR), mtype
+
+    def close(self):
+        self.sock.close()
+
+
+def _req_meta(prompt, deadline_s=None):
+    return {"prompt": list(map(int, prompt)), "plen": len(prompt),
+            "max_new": 4, "temperature": 0.0, "top_k": 0, "eos": None,
+            "adapter": 0, "slo_class": "latency", "deadline_s": deadline_s,
+            "traceparent": None}
+
+
+def _good_frames(params, prompt, block=16):
+    kv, first, lp = _prefill_kv(params, prompt)
+    from gofr_tpu.tpu.kvcache.quant import encode_block
+
+    frames = [(st, encode_block(kv.slice_tokens(st, min(st + block,
+                                                        kv.plen))))
+              for st in range(0, kv.plen, block)]
+    return frames, first, lp
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["contiguous", "paged"])
+@pytest.mark.parametrize("fault", ["truncated", "corrupt", "out_of_order",
+                                   "incomplete"])
+def test_corrupt_transfer_fails_one_request_typed(params, fingerprint,
+                                                  paged, fault, refs):
+    """Every malformed-transfer class fails exactly ONE request with a
+    typed 502 and never poisons the worker: the SAME connection then
+    serves a clean request token-exact."""
+    kw = {"paged_blocks": 24, "paged_block_size": 16} if paged else {}
+    dec = _engine(params, **kw)
+    srv = KVIngestServer(dec, fingerprint, "127.0.0.1", 0)
+    client = None
+    try:
+        from gofr_tpu.tpu.kvcache import KVLayout
+
+        layout = KVLayout(TINY.n_layers, TINY.n_kv_heads, TINY.head_dim,
+                          True, np.dtype(np.int8), 128)
+        client = _RawClient(srv.port, pdp.hello_payload(fingerprint, layout))
+        prompt = _prompt(40)
+        frames, first, lp = _good_frames(params, prompt)
+        client.send(pdp.pack_json(pdp.REQ, 1, _req_meta(prompt)))
+        if fault == "truncated":
+            st, frame = frames[0]
+            client.send(pdp.pack_kv(1, st, frame[:len(frame) // 2]))
+        elif fault == "corrupt":
+            st, frame = frames[0]
+            bad = bytearray(frame)
+            bad[len(bad) // 2] ^= 0xFF
+            client.send(pdp.pack_kv(1, st, bytes(bad)))
+        elif fault == "out_of_order":
+            st, frame = frames[1]
+            client.send(pdp.pack_kv(1, st, frame))
+        else:  # incomplete: EOF before all frames landed
+            st, frame = frames[0]
+            client.send(pdp.pack_kv(1, st, frame))
+            client.send(pdp.pack_json(pdp.KV_EOF, 1, {
+                "first_token": int(first), "first_lp": float(lp),
+                "plen": len(prompt)}))
+        err = json.loads(bytes(client.expect(pdp.ERR, 1)))
+        assert err["code"] == 502, err
+        assert srv.frame_rejects >= 1
+        # the worker is NOT poisoned: a clean request on the SAME
+        # connection serves token-exact
+        client.send(pdp.pack_json(pdp.REQ, 2, _req_meta(prompt)))
+        for st, frame in frames:
+            client.send(pdp.pack_kv(2, st, frame))
+        client.send(pdp.pack_json(pdp.KV_EOF, 2, {
+            "first_token": int(first), "first_lp": float(lp),
+            "plen": len(prompt)}))
+        toks = []  # tokens 2+ relay; the first is the shipper's to
+        # deliver (it sampled it) — the server skips it by contract
+        while True:
+            msg = pdp.read_msg(client.sock)
+            assert msg is not None
+            mtype, rid, payload = msg
+            if mtype == pdp.TOK and rid == 2:
+                toks.append(pdp.unpack_tok(payload)[0])
+            elif mtype == pdp.END and rid == 2:
+                break
+            elif mtype == pdp.ERR:
+                pytest.fail(f"clean request failed: {bytes(payload)}")
+        assert [int(first)] + toks == refs[40][:4]
+        assert dec.down is None
+    finally:
+        if client is not None:
+            client.close()
+        srv.close()
+        dec.close()
+
+
+def test_hello_refused_on_fingerprint_mismatch(params, fingerprint):
+    dec = _engine(params)
+    srv = KVIngestServer(dec, fingerprint, "127.0.0.1", 0)
+    try:
+        from gofr_tpu.tpu.kvcache import KVLayout
+
+        layout = KVLayout(TINY.n_layers, TINY.n_kv_heads, TINY.head_dim,
+                          True, np.dtype(np.int8), 128)
+        sock = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        sock.sendall(pdp.pack_json(pdp.HELLO, 0, pdp.hello_payload(
+            "someone-elses-model", layout)))
+        mtype, _, payload = pdp.read_msg(sock)
+        assert mtype == pdp.ERR
+        assert "fingerprint" in json.loads(bytes(payload))["message"]
+        assert pdp.read_msg(sock) is None  # server closed the conn
+        sock.close()
+        assert srv.refused_hellos == 1
+    finally:
+        srv.close()
+        dec.close()
