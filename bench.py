@@ -175,6 +175,18 @@ def init_backend(retries: int = 4, backoff_s: float = 20.0):
     cpu = "--cpu" in sys.argv[1:] or bool(os.environ.get("GOFR_BENCH_CPU"))
     if cpu:
         jax.config.update("jax_platforms", "cpu")
+        # fan the host platform out to 8 virtual devices BEFORE first
+        # backend use, so the structural run exercises the mesh arm
+        # (tp=2) the way tests/conftest.py does — a 1-device CPU child
+        # would otherwise silently skip every sharded code path
+        try:
+            jax.config.update("jax_num_cpu_devices", 8)
+        except AttributeError:  # older JAX: only the XLA flag works
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "--xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
     else:
         try:
             # persistent compile cache: each section child re-traces the
@@ -865,8 +877,19 @@ def engine_from_rows(cfg, params, rows: dict, defaults: dict | None = None):
                     c.get_or_default("TPU_SEQ_BUCKETS", "32").split(","))
     kv = jnp.int8 if c.get_or_default("TPU_KV_DTYPE", "int8") == "int8" \
         else None
+    mesh = None
+    spec = c.get("TPU_SHARDING")
+    if spec:
+        # the mesh arm IS a config row too: THE parser
+        # new_engine_from_config uses, weights re-placed onto the
+        # mesh exactly like the production wiring does
+        from gofr_tpu.parallel import shard_params
+        from gofr_tpu.tpu import parse_mesh
+
+        mesh = parse_mesh(spec)
+        params = shard_params(params, mesh)
     return GenerationEngine(
-        cfg, params,
+        cfg, params, mesh=mesh,
         slots=c.get_int("TPU_SLOTS", 48),
         max_seq=c.get_int("TPU_MAX_SEQ", 256),
         prompt_buckets=buckets,
@@ -911,7 +934,7 @@ def bench_arms(cfg, *, slots: int = 48, paged_slots: int = 128) -> dict:
                 {"TPU_SLOTS": "4", "TPU_MAX_SEQ": "1024",
                  "TPU_SEQ_BUCKETS": "128,256,512", "TPU_PREFIX_CACHE": "4",
                  "TPU_PREFIX_MIN": "256"})
-    order = (
+    order = [
         ("engine",
          {"TPU_SLOTS": str(slots), "TPU_MAX_SEQ": "256",
           "TPU_SEQ_BUCKETS": "32"},
@@ -929,7 +952,23 @@ def bench_arms(cfg, *, slots: int = 48, paged_slots: int = 128) -> dict:
           "TPU_SEQ_BUCKETS": "32",
           "TPU_PAGED_BLOCKS": str(paged_slots + 15)},
          lambda e: bench_engine(cfg, new_tokens=new_tokens, engine=e)),
-    )
+    ]
+    # the MESH arm: tensor-parallel serving as one more config row
+    # (TPU_SHARDING=tp=2, the rest of the slice on dp), gated alongside
+    # the other first-class modes in this one process under the arbiter
+    # — on CPU structural runs init_backend fanned the host out to 8
+    # virtual devices (jax_num_cpu_devices), so the sharded paths run
+    # hermetically. Skipped (and not required) only when the device
+    # count cannot factor a tp=2 mesh.
+    n_dev = jax.device_count()
+    if n_dev >= 2 and n_dev % 2 == 0:
+        mesh_rows = {"TPU_SLOTS": str(min(8, slots)), "TPU_MAX_SEQ": "256",
+                     "TPU_SEQ_BUCKETS": "32",
+                     "TPU_SHARDING": f"tp=2,dp={n_dev // 2}"}
+        order.append(("mesh", mesh_rows,
+                      lambda e: bench_engine(cfg, new_tokens=new_tokens,
+                                             engine=e)))
+    order = tuple(order)
     arms = {}
     for name, rows, drive in order:
         t0 = time.perf_counter()

@@ -440,6 +440,16 @@ def register_framework_metrics(m: Manager) -> None:
                   "requests degraded to 429/RESOURCE_EXHAUSTED because "
                   "an HBM lease could not be covered after reclaim, by "
                   "requesting subsystem")
+    # per-shard arbitration (multi-chip tensor-parallel serving,
+    # docs/advanced-guide/multichip-serving.md): mesh engines settle
+    # one lease entry per device, so in-use/headroom break out per chip
+    m.new_gauge("app_tpu_hbm_device_in_use_bytes",
+                "leased device bytes per mesh device (device label; "
+                "series exist only when sharded leases are live)")
+    m.new_gauge("app_tpu_hbm_device_budget_bytes",
+                "the arbiter's PER-DEVICE budget (0 = per-device "
+                "arbitration off; TPU_HBM_DEVICE_BUDGET_MB or device "
+                "limit minus headroom)")
 
     # overload-safety family (gofr_tpu/resilience: deadlines, admission
     # control, brownout — see docs/advanced-guide/resilience.md)

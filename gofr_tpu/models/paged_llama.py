@@ -181,7 +181,7 @@ def _reference_attention(q, k_pool, v_pool, k_new, v_new, table, lengths,
 
 def paged_verify_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                       cache: PagedKVCache, table: jnp.ndarray,
-                      rope_tables=None, adapter=None
+                      rope_tables=None, adapter=None, flash: bool = True
                       ) -> tuple[jnp.ndarray, PagedKVCache]:
     """Speculative-decoding verify pass over the paged pool — the exact
     contract of llama.verify_step (logits [B, W, V]; lengths returned
@@ -193,7 +193,9 @@ def paged_verify_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     exactly once through the same scalar-prefetch kernel as decode, and
     the W x W in-window part folds in exactly — off-TPU the auto gate
     falls back to window_attention_appended over a dense gather of the
-    table.
+    table. ``flash=False`` forces that dense-gather reference: mesh
+    engines need it because a pallas_call is opaque to the GSPMD
+    partitioner (same contract as paged_decode_step's flag).
 
     CAPACITY CONTRACT (same as verify_step): callers must only honor
     acceptance for slots with lengths + W <= capacity; rows past
@@ -215,6 +217,12 @@ def paged_verify_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
         layer_w, k_layer, v_layer, ks_layer, vs_layer = xs
 
         def attend(q, k_new, v_new):
+            if not flash:
+                from ..ops.paged_attention import paged_window_reference
+
+                return paged_window_reference(
+                    q, k_layer, v_layer, k_new, v_new, table, lengths,
+                    ks_layer, vs_layer)
             return paged_window_auto(q, k_layer, v_layer, k_new, v_new,
                                      table, lengths, ks_layer, vs_layer)
 

@@ -242,15 +242,26 @@ def paged_window_auto(q, k_pool, v_pool, k_new, v_new, table, lengths,
                       k_scale=None, v_scale=None, *,
                       interpret: bool = False) -> jnp.ndarray:
     """Window kernel when backend+shapes allow, dense-gather reference
-    otherwise (window_attention_appended over gather_blocks views)."""
-    from .attention import window_attention_appended
-
+    (paged_window_reference) otherwise."""
     b, w, h, d = q.shape
     probe = jax.ShapeDtypeStruct((b, 1, h * w, d), q.dtype)
     if interpret or _kernel_ok(probe, k_pool):
         return paged_window_attention(q, k_pool, v_pool, k_new, v_new,
                                       table, lengths, k_scale, v_scale,
                                       interpret=interpret)
+    return paged_window_reference(q, k_pool, v_pool, k_new, v_new,
+                                  table, lengths, k_scale, v_scale)
+
+
+def paged_window_reference(q, k_pool, v_pool, k_new, v_new, table, lengths,
+                           k_scale=None, v_scale=None) -> jnp.ndarray:
+    """Dense-gather reference for the window path: the table's blocks
+    gathered into contiguous views, then window_attention_appended.
+    paged_window_auto's off-kernel fallback, and the path mesh engines
+    FORCE (``flash=False`` in paged_llama) — a pallas_call is opaque
+    to the GSPMD partitioner."""
+    from .attention import window_attention_appended
+
     ks = gather_blocks(k_scale, table) if k_scale is not None else None
     vs = gather_blocks(v_scale, table) if v_scale is not None else None
     return window_attention_appended(q, gather_blocks(k_pool, table),

@@ -11,10 +11,11 @@ from .distributed import (is_coordinator, is_initialized, maybe_initialize,
                           process_count, process_index)
 from .mesh import (AXIS_DP, AXIS_EP, AXIS_FSDP, AXIS_PP, AXIS_SP, AXIS_TP,
                    DATA_AXES, MESH_AXES, MeshPlan, auto_plan, make_mesh,
-                   single_device_mesh)
+                   remesh, single_device_mesh)
 from .pipeline import make_pp_loss_fn
 from .sharding import (activation_constraint, activation_spec, batch_spec,
-                       fit_spec, kv_cache_specs, param_specs, replicated,
+                       fit_spec, kv_cache_specs, kv_head_shards,
+                       paged_cache_specs, param_specs, replicated,
                        shard_params, shardings_for, spec_for)
 from .train import (TrainState, abstract_train_state, default_optimizer,
                     init_train_state, load_balance_loss, make_train_step,
@@ -26,11 +27,11 @@ __all__ = [
     "process_count", "process_index",
     "AXIS_DP", "AXIS_EP", "AXIS_FSDP", "AXIS_PP", "AXIS_SP", "AXIS_TP",
     "DATA_AXES", "MESH_AXES",
-    "MeshPlan", "auto_plan", "make_mesh", "single_device_mesh",
+    "MeshPlan", "auto_plan", "make_mesh", "remesh", "single_device_mesh",
     "make_pp_loss_fn",
     "activation_constraint", "activation_spec", "batch_spec", "fit_spec",
-    "kv_cache_specs", "param_specs", "replicated", "shard_params",
-    "shardings_for", "spec_for",
+    "kv_cache_specs", "kv_head_shards", "paged_cache_specs", "param_specs",
+    "replicated", "shard_params", "shardings_for", "spec_for",
     "TrainState", "abstract_train_state", "default_optimizer",
     "init_train_state", "load_balance_loss", "make_train_step",
     "next_token_loss", "restore_train_state", "save_train_state",

@@ -128,3 +128,41 @@ def single_device_mesh() -> Mesh:
     """A 1×1×1×1 mesh over the first device — lets every sharded code path
     run unchanged on one chip (specs all resolve to no-op shardings)."""
     return make_mesh(MeshPlan(), devices=jax.devices()[:1])
+
+
+def remesh(mesh: Mesh, devices) -> Mesh:
+    """Re-place a mesh onto ``devices`` after mid-serving device loss —
+    the warm-recovery half of multi-chip serving (the engine re-places
+    params/cache onto the result, re-settles its HBM leases, and
+    rewarms from the offload tiers instead of dying).
+
+    Same axis names; when the live count covers the original plan the
+    mesh rebuilds identically (the common simulated-loss case, and a
+    real loss where a hot spare joined). When devices are GONE, axes
+    shrink until the plan fits — data-parallel width first (dp, then
+    fsdp/ep/sp/pp), tensor parallelism LAST: tp carries the per-layer
+    collectives AND decides whether the weights fit per chip at all,
+    so it is the one axis a degraded mesh fights to keep. Each
+    shrink halves an even axis or drops an odd one to 1 (mesh axes
+    must exactly factor the device count). Raises when no devices
+    remain."""
+    devices = list(devices)
+    if not devices:
+        raise ValueError("remesh: no live devices to re-place onto")
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    n = len(devices)
+
+    def covered() -> int:
+        return math.prod(shape.values())
+
+    for ax in (AXIS_DP, AXIS_FSDP, AXIS_EP, AXIS_SP, AXIS_PP, AXIS_TP):
+        while covered() > n and shape.get(ax, 1) > 1:
+            size = shape[ax]
+            shape[ax] = size // 2 if size % 2 == 0 else 1
+    if covered() > n:  # all axes at 1 yet still over: impossible
+        raise ValueError(f"remesh: cannot fit {dict(shape)} onto "
+                         f"{n} device(s)")
+    import numpy as np
+    arr = np.array(devices[:covered()]).reshape(
+        tuple(shape[ax] for ax in mesh.axis_names))
+    return Mesh(arr, mesh.axis_names)

@@ -201,5 +201,40 @@ def kv_cache_specs(mesh: Mesh, cache) -> Any:
     )
 
 
+def paged_cache_specs(mesh: Mesh, cache) -> Any:
+    """Shardings for a models.paged_llama.PagedKVCache: [L, N, T, KV,
+    hd] pools shard KV-heads over tp ONLY — the block axis stays
+    whole on every device because the host-owned block table (ids
+    into that axis) is global dispatch data, and block scatter/gather
+    index it with traced values (fine on an unsharded axis, a
+    full-rematerialization hazard on a sharded one). lengths and the
+    table are replicated: paged serving on a mesh is a
+    tensor-parallel configuration; data axes fit to nothing."""
+    kv = P(None, None, None, AXIS_TP, None)
+    sc = P(None, None, None, AXIS_TP)
+
+    def fit(spec, leaf):
+        return NamedSharding(mesh, fit_spec(spec, leaf.shape, mesh))
+
+    quant = getattr(cache, "k_scale", None) is not None
+    return type(cache)(
+        k=fit(kv, cache.k),
+        v=fit(kv, cache.v),
+        lengths=NamedSharding(mesh, P()),
+        k_scale=fit(sc, cache.k_scale) if quant else None,
+        v_scale=fit(sc, cache.v_scale) if quant else None,
+    )
+
+
+def kv_head_shards(mesh: Mesh, n_kv_heads: int) -> int:
+    """How many tp shards the KV-head axis actually splits into on
+    ``mesh`` — mirrors fit_spec's divisibility rule (a tp that does
+    not divide the head count replicates instead). This is the shard
+    count the per-shard offload codec frames and the T2 namespace
+    key by (docs/advanced-guide/multichip-serving.md)."""
+    tp = mesh.shape.get(AXIS_TP, 1)
+    return tp if tp > 1 and n_kv_heads % tp == 0 else 1
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())

@@ -70,11 +70,19 @@ def wire_role(engine, role: str, cfg, *, logger=None, metrics=None):
             # same startup-loud contract as the prefill role: a sharded
             # decode worker would handshake fine and then 500 every
             # KV_EOF at _validate_ingest — fail the deploy, not the
-            # requests
-            raise ValueError("TPU_SERVING_ROLE=decode requires a "
-                             "single-device engine (sharded KV install "
-                             "does not partition; unset TPU_SHARDING "
-                             "on the decode pool)")
+            # requests. Names the exact config rows in conflict: mesh
+            # SERVING itself is supported (TPU_SHARDING alone is fine,
+            # paged included) — it is the ROLE pairing that is refused
+            # until ingest learns to install shard-split rows (the
+            # role x engine-kind matrix in docs/advanced-guide/
+            # disaggregated-serving.md).
+            raise ValueError(
+                "TPU_SERVING_ROLE=decode cannot run with "
+                f"TPU_SHARDING={cfg.get('TPU_SHARDING')!r}: shipped-KV "
+                "ingest installs dense rows and is not yet shard-aware "
+                "(mesh decode stays refused until it is). Unset "
+                "TPU_SHARDING on the decode pool, or drop "
+                "TPU_SERVING_ROLE to serve this mesh fused")
         host, port = _parse_addr(
             cfg.get_or_default("TPU_PD_LISTEN", DEFAULT_LISTEN),
             "TPU_PD_LISTEN")
@@ -88,9 +96,15 @@ def wire_role(engine, role: str, cfg, *, logger=None, metrics=None):
         return engine.pd_ingest
     if role == ROLE_PREFILL:
         if gen.mesh is not None:
-            raise ValueError("TPU_SERVING_ROLE=prefill requires a "
-                             "single-device engine (KV row snapshots "
-                             "don't gather sharded caches)")
+            raise ValueError(
+                "TPU_SERVING_ROLE=prefill cannot run with "
+                f"TPU_SHARDING={cfg.get('TPU_SHARDING')!r}: the KV-ship "
+                "wire format is dense single-device rows, and a mesh "
+                "row would ship per-shard frames no decode pool "
+                "ingests yet (see the role x engine-kind matrix in "
+                "docs/advanced-guide/disaggregated-serving.md). Unset "
+                "TPU_SHARDING on the prefill pool, or drop "
+                "TPU_SERVING_ROLE to serve this mesh fused")
         if getattr(gen, "_paged", False):
             raise ValueError("TPU_SERVING_ROLE=prefill requires a "
                              "contiguous engine (set TPU_PAGED_BLOCKS=0 "

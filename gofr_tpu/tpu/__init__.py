@@ -90,7 +90,11 @@ Config keys (reference config style, pkg/gofr/config/config.go:3):
   TPU_KVCACHE_HOST_MB host-DRAM offload tier budget in MiB (default 0
                       = off): LRU-evicted pool rows spill to host
                       numpy and restore via device_put on hit —
-                      cache capacity beyond HBM, survives device loss
+                      cache capacity beyond HBM, survives device loss.
+                      On mesh engines rows spill/restore PER SHARD
+                      (each tp shard's head range reads off its own
+                      device; promotion lands the assembled row with
+                      one sharded write)
   TPU_KVCACHE_REDIS   "true" shares quantized int8 KV blocks through
                       the framework Redis client (REDIS_HOST/PORT) so
                       replicas warm each other (default off)
@@ -109,10 +113,13 @@ Config keys (reference config style, pkg/gofr/config/config.go:3):
                       share fixed-size blocks via a block table, so HBM
                       sizes to expected LIVE tokens and decode batch
                       scales past what [slots, max_seq] rows fit
-                      (models/paged_llama.py; single-device; long
-                      prompts chunk via a dense scratch row; composes
-                      with TPU_SPEC_DECODE, and with TPU_PREFIX_CACHE
-                      the prefix cache becomes zero-copy block sharing)
+                      (models/paged_llama.py; long prompts chunk via a
+                      dense scratch row; composes with TPU_SPEC_DECODE,
+                      and with TPU_PREFIX_CACHE the prefix cache
+                      becomes zero-copy block sharing). Composes with
+                      TPU_SHARDING: the pool shards KV-heads over tp
+                      and attention runs the dense-gather reference
+                      (the Pallas kernel is single-device)
   TPU_PAGED_BLOCK     block size in tokens (default 128)
   TPU_LORA_ADAPTERS   multi-LoRA serving: adapter slots (default 0 =
                       off; slot 0 is the base no-op). Per-request
@@ -131,6 +138,14 @@ Config keys (reference config style, pkg/gofr/config/config.go:3):
   TPU_HBM_HEADROOM    fraction of the device limit the resolved budget
                       leaves free for XLA workspace the accounting
                       registry can't see (default 0.1)
+  TPU_HBM_DEVICE_BUDGET_MB  PER-DEVICE arbiter budget in MiB for mesh
+                      serving (docs/advanced-guide/
+                      multichip-serving.md): sharded buffers settle
+                      one lease per device, each checked against this
+                      bound, and a hot shard's deficit reclaims only
+                      that device's leases. Unset = resolved per
+                      device on accelerator backends; inert for
+                      single-device engines
   TPU_MAX_QUEUE_DEPTH admission control (resilience.AdmissionGate):
                       shed with 429/RESOURCE_EXHAUSTED once this many
                       requests wait in a queue (default 0 = off)
@@ -186,7 +201,7 @@ __all__ = [
     "save_npz", "save_orbax",
     "DEFAULT_BATCH_BUCKETS", "DEFAULT_SEQ_BUCKETS", "Program", "TPUEngine",
     "GenerationEngine", "GenerationError", "GenStream",
-    "new_engine_from_config",
+    "new_engine_from_config", "parse_mesh",
 ]
 
 
@@ -209,8 +224,10 @@ def _csv_ints(val: str | None, default: tuple[int, ...]) -> tuple[int, ...]:
     return tuple(int(x) for x in val.split(",") if x.strip())
 
 
-def _parse_mesh(spec: str | None):
-    """"tp=8" / "tp=4,dp=2" -> Mesh over the named parallel axes."""
+def parse_mesh(spec: str | None):
+    """"tp=8" / "tp=4,dp=2" -> Mesh over the named parallel axes (the
+    TPU_SHARDING row syntax). Public: tools/benches that accept the
+    same rows must parse them identically to the production wiring."""
     if not spec:
         return None
     from ..parallel import make_mesh
@@ -227,7 +244,7 @@ def new_engine_from_config(cfg, logger=None, metrics=None,
     from ..models import BERT_CONFIGS, LLAMA_CONFIGS, VIT_CONFIGS
 
     name = (cfg.get("TPU_MODEL") or "tiny").strip()
-    mesh = _parse_mesh(cfg.get("TPU_SHARDING"))
+    mesh = parse_mesh(cfg.get("TPU_SHARDING"))
     max_delay = cfg.get_float("TPU_MAX_BATCH_DELAY", 0.004)
     batch_buckets = _csv_ints(cfg.get("TPU_BATCH_BUCKETS"), DEFAULT_BATCH_BUCKETS)
     seq_buckets = _csv_ints(cfg.get("TPU_SEQ_BUCKETS"), DEFAULT_SEQ_BUCKETS)
@@ -236,9 +253,12 @@ def new_engine_from_config(cfg, logger=None, metrics=None,
     from . import hbm
 
     # the HBM arbiter budget (one per process — subsystems of every
-    # engine built after this lease from it)
+    # engine built after this lease from it; mesh engines additionally
+    # settle PER-DEVICE leases checked against the per-device budget)
     hbm.configure(budget_mb=cfg.get_int("TPU_HBM_BUDGET_MB", 0) or None,
-                  headroom=cfg.get_float("TPU_HBM_HEADROOM", 0.1))
+                  headroom=cfg.get_float("TPU_HBM_HEADROOM", 0.1),
+                  device_budget_mb=cfg.get_int("TPU_HBM_DEVICE_BUDGET_MB",
+                                               0) or None)
 
     tracer = getattr(observe, "tracer", None)
     batch_share = cfg.get_float("TPU_SLO_BATCH_SHARE", 0.0)
@@ -309,11 +329,12 @@ def new_engine_from_config(cfg, logger=None, metrics=None,
         prompt_b = tuple(b for b in seq_buckets if b < max_seq) or (max_seq // 2,)
         kv_opts = None
         if cfg.get_int("TPU_PREFIX_CACHE", 0) > 0 \
-                and cfg.get_int("TPU_PAGED_BLOCKS", 0) == 0 \
-                and mesh is None:
-            # paged engines keep their zero-copy SharedPrefixIndex and
-            # mesh engines run T0-only — don't open a Redis connection
-            # the engine would immediately discard
+                and cfg.get_int("TPU_PAGED_BLOCKS", 0) == 0:
+            # paged engines keep their zero-copy SharedPrefixIndex —
+            # don't open a Redis connection the engine would
+            # immediately discard. Mesh engines DO take the offload
+            # tiers: T1/T2 spill/restore sharded rows per shard
+            # (docs/advanced-guide/multichip-serving.md)
             from .kvcache import options_from_config
 
             kv_opts = options_from_config(cfg, logger=logger,

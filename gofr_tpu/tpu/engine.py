@@ -442,6 +442,12 @@ class TPUEngine:
                 k: arb[k] for k in ("budget_bytes", "in_use_bytes",
                                     "headroom_bytes", "reclaims",
                                     "sheds", "oom_retries")}
+            # per-shard break-out (mesh engines settle one lease entry
+            # per device): in-use + headroom per chip, so a balancer
+            # can see ONE hot shard before it becomes a shed storm
+            for k in ("device_budget_bytes", "devices"):
+                if k in arb:
+                    details["hbm_arbiter"][k] = arb[k]
         if self.generator is not None:
             details["generator"] = self.generator.stats()
         if self.serving_role != "fused":

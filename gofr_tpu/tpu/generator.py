@@ -27,7 +27,6 @@ stream; one background thread owns the device loop.
 
 from __future__ import annotations
 
-import dataclasses
 import functools
 import itertools
 import os
@@ -50,7 +49,7 @@ from ..resilience import (SLO_LATENCY, SLO_THROUGHPUT, DecodePipelinePolicy,
 from ..wire import PushStream
 from . import hbm
 from .batcher import pad_bucket
-from .kvcache import HostKV, clamp_restore_len
+from .kvcache import HostKV, ShardedHostKV, clamp_restore_len, dense_hostkv
 
 _REQ_IDS = itertools.count(1)
 
@@ -158,6 +157,29 @@ def _write_row_from_host(pool, k, v, ks, vs, row):
 
     def wr(dst, src):
         return lax.dynamic_update_slice_in_dim(dst, src, row, axis=1)
+
+    quant = pool.k_scale is not None
+    return pool._replace(
+        k=wr(pool.k, k), v=wr(pool.v, v),
+        k_scale=wr(pool.k_scale, ks) if quant else None,
+        v_scale=wr(pool.v_scale, vs) if quant else None)
+
+
+def _write_row_from_host_masked(pool, k, v, ks, vs, row):
+    """GSPMD-friendly _write_row_from_host for SHARDED pools (mesh
+    engines' T1/T2 promotion): the dynamic_update_slice form puts a
+    traced start on the batch axis — the axis the pool shards over the
+    data mesh axes — and GSPMD's only lowering for that replicates the
+    whole pool (the _copy_row hazard). Select the destination row with
+    a one-hot mask and blend instead: ``src`` [L, 1, Smax, ...] arrives
+    replicated and broadcasts over the batch axis, every op partitions
+    cleanly under any batch/tp sharding. Reads the full pool once; that
+    extra HBM stream is the price of mesh support, paid only on a
+    promotion (not per token)."""
+    def wr(dst, src):
+        sel = (jnp.arange(dst.shape[1]) == row)
+        sel = sel.reshape((1, -1) + (1,) * (dst.ndim - 2))
+        return jnp.where(sel, src.astype(dst.dtype), dst)
 
     quant = pool.k_scale is not None
     return pool._replace(
@@ -485,16 +507,17 @@ class GenerationEngine:
         # T-token blocks via a host-owned block table instead of owning
         # [max_seq] rows — HBM sized to expected LIVE tokens, so decode
         # batch scales past what contiguous rows fit (the road past
-        # batch 96 on 8B/v5e; models/paged_llama.py). v1 scope:
-        # single-device, prompts within the bucket lattice, no prefix
-        # pool / spec decode (each needs paged-aware row copies or
-        # window writes — composable later).
+        # batch 96 on 8B/v5e; models/paged_llama.py). On a MESH the
+        # pool shards KV-heads over tp (parallel.paged_cache_specs —
+        # the block axis stays whole so the host-owned table remains
+        # global dispatch data) and attention runs the dense-gather
+        # reference instead of the Pallas kernel (a pallas_call is
+        # opaque to the GSPMD partitioner) — mesh-aware paged serving
+        # is a tensor-parallel configuration, token-exact vs the
+        # contiguous mesh path (docs/advanced-guide/
+        # multichip-serving.md).
         self._paged = paged_blocks > 0
         if self._paged:
-            if mesh is not None:
-                raise ValueError("paged_blocks requires a single-device "
-                                 "engine (the kernel's block-table "
-                                 "prefetch does not partition)")
             self._block_t = int(paged_block_size)
             self._mb = -(-self.max_seq // self._block_t)
             min_blocks = 2 + (self.prompt_buckets[-1] // self._block_t)
@@ -571,21 +594,96 @@ class GenerationEngine:
         # instance so close() releases exactly our bytes. The serving
         # cache is PRI_SERVING: never auto-reclaimed, but the paged
         # variant attaches the cold-prefix-block release so storms
-        # can still drain logical pool pressure.
+        # can still drain logical pool pressure. MESH engines compute
+        # their shardings FIRST (from eval_shape structs) so every
+        # buffer is BORN sharded and leased PER SHARD
+        # (hbm.alloc_sharded): the arbiter settles one lease entry per
+        # device, per-device budgets check each shard, and device-loss
+        # re-placement re-settles the same keys instead of
+        # double-counting.
+        self._rep_sh = None   # mesh: replicated sharding (set below)
+        self._pool_sh = None  # mesh: prefix-pool sharding (set below)
+        self._scratch_sh = None
+        self._dev_labels: tuple = ()
+        self._kv_shards = 1   # tp shards of the KV-head axis
+        self._replacements = 0  # warm mesh re-placements survived
         if self._paged:
             from ..models.paged_llama import init_paged_cache
 
-            self.cache = hbm.alloc(
-                "engine", lambda: init_paged_cache(cfg, slots, paged_blocks,
-                                                   self._block_t,
-                                                   dtype=kv_dtype),
-                owner=self, tag="cache", priority=hbm.PRI_SERVING,
-                reclaim=self._hbm_paged_reclaim)
+            def _init_cache():
+                c = init_paged_cache(cfg, slots, paged_blocks,
+                                     self._block_t, dtype=kv_dtype)
+                if self._cache_sh is not None:
+                    c = jax.device_put(c, self._cache_sh)
+                return c
+
+            cache_reclaim = self._hbm_paged_reclaim
         else:
+            def _init_cache():
+                c = llama.init_cache(cfg, slots, self.max_seq,
+                                     dtype=kv_dtype)
+                if self._cache_sh is not None:
+                    c = jax.device_put(c, self._cache_sh)
+                return c
+
+            cache_reclaim = None
+        self._seed = int(seed)  # recovery reseeds the chained key
+        self._recoveries = 0
+        if mesh is not None:
+            # ICI-sharded serving (SURVEY §2 last row): KV heads over
+            # tp, slots over the data axes (paged pools: KV heads over
+            # tp only — the block axis stays whole for the global
+            # table). Params carry their own shardings (placed by the
+            # config wiring); out_shardings pin the cache layout so
+            # donation aliases buffers across steps and XLA never
+            # resharding-copies the cache. Collectives are emitted by
+            # XLA from the specs — nothing here names a device.
+            from ..parallel import (kv_cache_specs, kv_head_shards,
+                                    paged_cache_specs, replicated)
+
+            self._dev_labels = tuple(str(d.id) for d in mesh.devices.flat)
+            self._kv_shards = kv_head_shards(mesh, cfg.n_kv_heads)
+            tp = mesh.shape.get("tp", 1)
+            data = mesh.devices.size // max(tp * mesh.shape.get("sp", 1)
+                                            * mesh.shape.get("pp", 1), 1)
+            if tp > 1 and cfg.n_kv_heads % tp and data > 1 \
+                    and logger is not None:
+                # VERIFIED numerics hazard (tools/multichip_bench.py
+                # bring-up, CPU GSPMD): a tp that splits a KV head
+                # (n_kv_heads % tp != 0) combined with dp/fsdp > 1
+                # produced logits off by O(1) — not reduction noise —
+                # while the same tp with data axes = 1, and any
+                # head-aligned tp, stayed exact. Until root-caused in
+                # the partitioner, pick tp dividing n_kv_heads on
+                # multi-axis meshes (docs/advanced-guide/
+                # multichip-serving.md "known limits").
+                logger.warn({
+                    "event": "tp splits a KV head on a multi-axis mesh",
+                    "tp": int(tp), "n_kv_heads": int(cfg.n_kv_heads),
+                    "detail": "known wrong-logits hazard; prefer tp "
+                              "dividing n_kv_heads"})
+            self._rep_sh = replicated(mesh)
+            struct = jax.eval_shape(_init_cache)  # _cache_sh still None
+            self._cache_sh = (paged_cache_specs(mesh, struct) if self._paged
+                              else kv_cache_specs(mesh, struct))
+            # commit the seed key to the replicated sharding NOW: the
+            # chained key outputs are rep-committed, and a first
+            # dispatch with an UNCOMMITTED key would occupy a different
+            # jit cache entry than every later one — warming one
+            # signature and serving the other re-lowers the program
+            # mid-serving under the device lock. (GL202 suppressed: a
+            # 16-byte PRNG key sits below accounting granularity — the
+            # arbiter leases buffers, not scalars.)
+            self._key = jax.device_put(jax.random.PRNGKey(seed), self._rep_sh)  # noqa: GL202, E501
+            self.cache = hbm.alloc_sharded(
+                "engine", _init_cache, owner=self, tag="cache",
+                priority=hbm.PRI_SERVING, reclaim=cache_reclaim,
+                devices=self._dev_labels)
+        else:
+            self._key = jax.random.PRNGKey(seed)
             self.cache = hbm.alloc(
-                "engine", lambda: llama.init_cache(cfg, slots, self.max_seq,
-                                                   dtype=kv_dtype),
-                owner=self, tag="cache", priority=hbm.PRI_SERVING)
+                "engine", _init_cache, owner=self, tag="cache",
+                priority=hbm.PRI_SERVING, reclaim=cache_reclaim)
         self._slots = [_Slot() for _ in range(slots)]
         self._last_tokens = np.zeros((slots,), np.int32)
         self._active = np.zeros((slots,), bool)
@@ -606,11 +704,6 @@ class GenerationEngine:
         # (cache/key/carry chain from the previous block's outputs)
         self._pack = None
         self._pack_dirty = True
-        self._seed = int(seed)  # recovery reseeds the chained key
-        self._recoveries = 0
-        self._key = jax.random.PRNGKey(seed)
-        self._rep_sh = None   # mesh: replicated sharding (set below)
-        self._pool_sh = None  # mesh: prefix-pool sharding (set below)
         # device mirrors of host-owned dispatch arrays (see _dev)
         self._mirror: dict[str, Any] = {}
         self._dirty: set[str] = set()
@@ -625,14 +718,16 @@ class GenerationEngine:
         # with one HBM row copy (T0) or a host->device upload + row
         # copy (T1/T2 promotion); the remainder (always >= 1 token, so
         # the first sample recomputes) prefills from the match point.
-        # On mesh engines the pool shards like the serving cache and
-        # the row copies run mask-and-reduce (_copy_row_masked) instead
-        # of traced-index dynamic slices, which GSPMD could only lower
-        # by replicating the cache; the jits are built after the mesh
-        # block below, where the shardings exist. The OFFLOAD tiers are
-        # single-device only: their promote path is a traced-row
-        # dynamic_update_slice with the same GSPMD problem, so a mesh
-        # engine keeps the radix-indexed T0 and logs the downgrade.
+        # On mesh engines the pool shards like the serving cache, the
+        # row copies run mask-and-reduce (_copy_row_masked) instead of
+        # traced-index dynamic slices (which GSPMD could only lower by
+        # replicating the cache), and the OFFLOAD tiers run PER-SHARD:
+        # T1 spills read each tp shard's head range straight off its
+        # own device shard (ShardedHostKV — no cross-device assembly
+        # on the spill path), T2 frames each shard through the
+        # unchanged int8 block codec under a fingerprint carrying the
+        # mesh shape, and promotion lands the assembled dense row via
+        # _write_row_from_host_masked (the same one-hot blend trick).
         # (Paged engines built their zero-copy SharedPrefixIndex above
         # instead — no side pool, entries reference pool blocks.)
         self._pool = None
@@ -649,33 +744,62 @@ class GenerationEngine:
                                       KVLayout, model_fingerprint)
 
                 opts = kvcache or KVCacheOptions()
-                if mesh is not None and (opts.host_mb > 0
-                                         or opts.redis is not None):
+                if (mesh is not None and jax.process_count() > 1
+                        and (opts.host_mb > 0 or opts.redis is not None)):
+                    # Multi-PROCESS meshes: _kv_row_get snapshots only
+                    # the process-LOCAL shards (addressable_shards),
+                    # so a T1/T2 row would silently hold a fraction of
+                    # the KV heads and every restore would degrade to
+                    # a shape-drift miss. Keep the T0 radix index;
+                    # disable the offload tiers loudly until the
+                    # snapshot is process-aware.
+                    import dataclasses
+
                     if logger is not None:
-                        logger.warn({"event": "kvcache offload tiers "
-                                     "disabled on mesh engine (T0 radix "
-                                     "index stays on)"})
+                        logger.warn({
+                            "event": "kvcache offload tiers disabled on "
+                            "multi-process mesh (per-shard snapshots are "
+                            "process-local; T0 radix index stays on)"})
                     if opts.redis is not None:
                         try:  # don't leak the discarded connection
                             opts.redis.close()
                         except Exception:
                             pass
                     opts = dataclasses.replace(opts, host_mb=0, redis=None)
+
+                def _init_pool():
+                    p = llama.init_cache(cfg, prefix_cache_slots,
+                                         self.max_seq, dtype=kv_dtype)
+                    if self._pool_sh is not None:
+                        p = jax.device_put(p, self._pool_sh)
+                    return p
+
                 # PRI_CACHE with the shrink callback: under budget
                 # pressure from ANY subsystem the arbiter spills this
                 # pool's entries to the host tier and reallocates it
                 # smaller (_hbm_pool_reclaim) — T0 shrinks so e.g. a
-                # paged engine's lease in the same process proceeds
-                self._pool = hbm.alloc(
-                    "kvcache-t0",
-                    lambda: llama.init_cache(cfg, prefix_cache_slots,
-                                             self.max_seq,
-                                             dtype=kv_dtype),
-                    owner=self, tag="pool", priority=hbm.PRI_CACHE,
-                    reclaim=self._hbm_pool_reclaim)
+                # paged engine's lease in the same process proceeds.
+                # Mesh pools settle per-shard lease keys; pool shards
+                # like the serving cache (batch rows over the data
+                # axes when they divide, KV heads over tp).
+                if mesh is not None:
+                    from ..parallel import kv_cache_specs
+
+                    self._pool_sh = kv_cache_specs(
+                        mesh, jax.eval_shape(_init_pool))
+                    self._pool = hbm.alloc_sharded(
+                        "kvcache-t0", _init_pool, owner=self, tag="pool",
+                        priority=hbm.PRI_CACHE,
+                        reclaim=self._hbm_pool_reclaim,
+                        devices=self._dev_labels)
+                else:
+                    self._pool = hbm.alloc(
+                        "kvcache-t0", _init_pool,
+                        owner=self, tag="pool", priority=hbm.PRI_CACHE,
+                        reclaim=self._hbm_pool_reclaim)
                 layout = KVLayout(cfg.n_layers, cfg.n_kv_heads,
                                   cfg.head_dim, self._pool.quantized,
-                                  np.dtype(self._pool.k.dtype),
+                                  np.dtype(str(self._pool.k.dtype)),
                                   self.max_seq)
                 self._kvc = CacheManager(
                     prefix_cache_slots, layout, block=opts.block,
@@ -683,8 +807,10 @@ class GenerationEngine:
                     redis_ttl_s=opts.redis_ttl_s,
                     epoch_refresh_s=opts.epoch_refresh_s,
                     fingerprint=model_fingerprint(
-                        cfg, params, extra=str(layout.np_dtype)),
-                    metrics=metrics, logger=logger)
+                        cfg, params,
+                        extra=str(layout.np_dtype) + self._mesh_extra()),
+                    metrics=metrics, logger=logger,
+                    shards=self._kv_shards)
                 self._store_min = int(prefix_store_min
                                       or self.prompt_buckets[-1])
         if (self._kvc is None and kvcache is not None
@@ -743,72 +869,108 @@ class GenerationEngine:
 
         self._chunk_mid = functools.partial(self._chunk_fn, sample=False)
         self._chunk_final = functools.partial(self._chunk_fn, sample=True)
-        if mesh is not None:
-            # ICI-sharded serving (SURVEY §2 last row): KV heads over tp,
-            # slots over the data axes. Params carry their own shardings
-            # (placed by the config wiring); out_shardings pin the cache
-            # layout so donation aliases buffers across steps and XLA never
-            # resharding-copies the cache. Collectives are emitted by XLA
-            # from the specs — nothing here names a device.
-            from ..parallel import kv_cache_specs, replicated
+        if self._paged and (self.max_seq - 1 > self._chunk
+                            or self._prefix_idx is not None):
+            # Long-prompt admission AND prefix-hit resume both run the
+            # chunk lattice against a dense single-slot SCRATCH row
+            # (identical programs to the contiguous engine's, B=1),
+            # then one dispatch lands the row in the pool
+            # (paged_llama.write_row_to_blocks). The scratch costs one
+            # slot-row of HBM (~67 MB at 8B/1024).
+            self._alloc_scratch()
+        self._build_jits()
+        self._thread = threading.Thread(target=self._loop, name="gofr-tpu-gen",
+                                        daemon=True)
+        self._thread.start()
 
-            cache_sh = kv_cache_specs(mesh, self.cache)
-            self._cache_sh = cache_sh
-            # re-placement consumes the unsharded buffers; account's
-            # set semantics replace the figure instead of adding
-            self.cache = hbm.account(
-                "engine", jax.device_put(self.cache, cache_sh),
-                owner=self, tag="cache")
-            rep = replicated(mesh)
-            self._rep_sh = rep
-            # commit the seed key to the replicated sharding NOW: the
-            # chained key outputs are rep-committed, and a first
-            # dispatch with an UNCOMMITTED key would occupy a different
-            # jit cache entry than every later one — warming one
-            # signature and serving the other re-lowers the program
-            # mid-serving under the device lock. (GL202 suppressed: a
-            # 16-byte PRNG key sits below accounting granularity — the
-            # arbiter leases buffers, not scalars.)
-            self._key = jax.device_put(self._key, rep)  # noqa: GL202
-            # outputs: (token, logprob, next_key, cache) for prefill/
-            # final-chunk, (tokens, logprobs, emitted, slot-state carry,
-            # next_key, cache) for the fused step — the PRNG key chains
-            # through every sampling program (split in-trace, no host
-            # round-trip per block), and the carry chains the per-slot
-            # decode state the pipeline's next dispatch consumes
-            self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(0,),
+    def _alloc_scratch(self) -> None:
+        """Allocate the dense single-slot scratch row (paged chunk
+        lattice / prefix restore / PD ingest staging). Mesh engines
+        shard it like a one-row serving cache (KV heads over tp; the
+        batch axis is 1, so data axes fit to nothing) and settle it
+        per shard."""
+        def _init_scratch():
+            s = llama.init_cache(self.cfg, 1, self.max_seq,
+                                 dtype=self._kv_dtype)
+            if self._scratch_sh is not None:
+                s = jax.device_put(s, self._scratch_sh)
+            return s
+
+        if self.mesh is not None:
+            from ..parallel import kv_cache_specs
+
+            self._scratch_sh = kv_cache_specs(
+                self.mesh, jax.eval_shape(_init_scratch))
+            self._scratch = hbm.alloc_sharded(
+                "engine", _init_scratch, owner=self, tag="scratch",
+                priority=hbm.PRI_SCRATCH, devices=self._dev_labels)
+        else:
+            self._scratch = hbm.alloc(
+                "engine", _init_scratch, owner=self, tag="scratch",
+                priority=hbm.PRI_SCRATCH)
+
+    def _build_jits(self) -> None:
+        """Build (or REBUILD) every compiled program. Factored out of
+        __init__ because warm device-loss re-placement compiles the
+        whole surface again: out_shardings pin donation aliasing, and
+        a sharding names its mesh, so programs built against a dead
+        mesh can never serve the replacement.
+
+        outputs: (token, logprob, next_key, cache) for prefill/
+        final-chunk, (tokens, logprobs, emitted, slot-state carry,
+        next_key, cache) for the fused step — the PRNG key chains
+        through every sampling program (split in-trace, no host
+        round-trip per block), and the carry chains the per-slot
+        decode state the pipeline's next dispatch consumes."""
+        mesh = self.mesh
+        if mesh is not None:
+            rep = self._rep_sh
+            cache_sh = self._cache_sh
+            prefill_fn = (self._paged_prefill_fn if self._paged
+                          else self._prefill_fn)
+            step_fn = self._paged_step_fn if self._paged else self._step_fn
+            self._prefill_jit = jax.jit(prefill_fn, donate_argnums=(0,),
                                         out_shardings=(rep, rep, rep,
                                                        cache_sh))
-            self._step_jit = jax.jit(self._step_fn, donate_argnums=(0,),
+            self._step_jit = jax.jit(step_fn, donate_argnums=(0,),
                                      out_shardings=(rep, rep, rep,
                                                     (rep, rep, rep), rep,
                                                     cache_sh))
-            self._chunk_mid_jit = jax.jit(self._chunk_mid, donate_argnums=(0,),
-                                          out_shardings=cache_sh)
-            self._chunk_final_jit = jax.jit(self._chunk_final,
-                                            donate_argnums=(0,),
-                                            out_shardings=(rep, rep, rep,
-                                                           cache_sh))
-            if self._kvc is not None:
-                # pool shards like the serving cache (batch rows over the
-                # data axes when they divide, KV heads over tp); pinning
-                # out_shardings keeps donation aliasing across copies
-                pool_sh = kv_cache_specs(mesh, self._pool)
-                self._pool_sh = pool_sh
-                self._pool = hbm.account(
-                    "kvcache-t0", jax.device_put(self._pool, pool_sh),
-                    owner=self, tag="pool")
-                self._pool_load_jit = jax.jit(_copy_row_masked,
-                                              donate_argnums=(0,),
-                                              out_shardings=cache_sh)
-                self._pool_store_jit = jax.jit(_copy_row_masked,
-                                               donate_argnums=(0,),
-                                               out_shardings=pool_sh)
             if self._spec_k:
-                self._verify_jit = jax.jit(self._verify_fn,
-                                           donate_argnums=(0,),
+                verify_fn = (self._paged_verify_fn if self._paged
+                             else self._verify_fn)
+                self._verify_jit = jax.jit(verify_fn, donate_argnums=(0,),
                                            out_shardings=(rep, rep, rep,
                                                           cache_sh))
+            if self._paged:
+                if hasattr(self, "_scratch"):
+                    from ..models.paged_llama import (read_blocks_to_row,
+                                                      write_row_to_blocks)
+
+                    sc = self._scratch_sh
+                    self._chunk_mid_jit = jax.jit(self._chunk_mid,
+                                                  donate_argnums=(0,),
+                                                  out_shardings=sc)
+                    self._chunk_final_jit = jax.jit(self._chunk_final,
+                                                    donate_argnums=(0,),
+                                                    out_shardings=(rep, rep,
+                                                                   rep, sc))
+                    self._row_to_blocks_jit = jax.jit(write_row_to_blocks,
+                                                      donate_argnums=(0,),
+                                                      out_shardings=cache_sh)
+                    self._blocks_to_row_jit = jax.jit(read_blocks_to_row,
+                                                      donate_argnums=(0,),
+                                                      out_shardings=sc)
+            else:
+                self._chunk_mid_jit = jax.jit(self._chunk_mid,
+                                              donate_argnums=(0,),
+                                              out_shardings=cache_sh)
+                self._chunk_final_jit = jax.jit(self._chunk_final,
+                                                donate_argnums=(0,),
+                                                out_shardings=(rep, rep, rep,
+                                                               cache_sh))
+                if self._kvc is not None:
+                    self._build_pool_jits()
         elif self._paged:
             self._prefill_jit = jax.jit(self._paged_prefill_fn,
                                         donate_argnums=(0,))
@@ -816,21 +978,10 @@ class GenerationEngine:
             if self._spec_k:
                 self._verify_jit = jax.jit(self._paged_verify_fn,
                                            donate_argnums=(0,))
-            if (self.max_seq - 1 > self._chunk
-                    or self._prefix_idx is not None):
-                # Long-prompt admission AND prefix-hit resume both run
-                # the chunk lattice against a dense single-slot SCRATCH
-                # row (identical programs to the contiguous engine's,
-                # B=1), then one dispatch lands the row in the pool
-                # (paged_llama.write_row_to_blocks). The scratch costs
-                # one slot-row of HBM (~67 MB at 8B/1024).
+            if hasattr(self, "_scratch"):
                 from ..models.paged_llama import (read_blocks_to_row,
                                                   write_row_to_blocks)
 
-                self._scratch = hbm.alloc(
-                    "engine", lambda: llama.init_cache(cfg, 1, self.max_seq,
-                                                       dtype=kv_dtype),
-                    owner=self, tag="scratch", priority=hbm.PRI_SCRATCH)
                 self._chunk_mid_jit = jax.jit(self._chunk_mid,
                                               donate_argnums=(0,))
                 self._chunk_final_jit = jax.jit(self._chunk_final,
@@ -854,9 +1005,126 @@ class GenerationEngine:
             if self._spec_k:
                 self._verify_jit = jax.jit(self._verify_fn,
                                            donate_argnums=(0,))
-        self._thread = threading.Thread(target=self._loop, name="gofr-tpu-gen",
-                                        daemon=True)
-        self._thread.start()
+
+    def _build_pool_jits(self) -> None:
+        """Mesh prefix-pool programs — split out because the arbiter's
+        pool SHRINK reallocates the pool at a new row count, whose
+        fitted sharding can differ (a batch axis the data axes no
+        longer divide replicates), so the shrink path rebuilds these
+        three against the new _pool_sh. The row copies run
+        mask-and-reduce; T1/T2 promotion lands the assembled dense
+        row via the one-hot blend (_write_row_from_host_masked) —
+        both GSPMD-clean under any batch/tp sharding."""
+        self._pool_load_jit = jax.jit(_copy_row_masked,
+                                      donate_argnums=(0,),
+                                      out_shardings=self._cache_sh)
+        self._pool_store_jit = jax.jit(_copy_row_masked,
+                                       donate_argnums=(0,),
+                                       out_shardings=self._pool_sh)
+        if self._kvc.wants_offload or self._kvc.shares:
+            self._host_write_jit = jax.jit(_write_row_from_host_masked,
+                                           donate_argnums=(0,),
+                                           out_shardings=self._pool_sh)
+
+    def _mesh_extra(self) -> str:
+        """Fingerprint suffix carrying the KV shard layout: the T2
+        tier frames blocks PER SHARD, so replicas sharded differently
+        must occupy disjoint namespaces — a tp=4 frame must never
+        half-decode on a tp=2 reader."""
+        return f":tp{self._kv_shards}" if self._kv_shards > 1 else ""
+
+    @staticmethod
+    def _device_alive(dev) -> bool:
+        """Can this device still take work? A tiny placed transfer is
+        the probe — a lost mesh device fails it, a healthy one costs
+        microseconds (recovery path only, never per token)."""
+        try:
+            jax.block_until_ready(
+                jax.device_put(jnp.zeros((1,), jnp.int32), dev))
+            return True
+        except Exception:
+            return False
+
+    def _replace_mesh(self) -> None:
+        """Warm device-loss re-placement: after a mesh engine's loop
+        failure, rebuild the mesh over the devices still alive (the
+        same shape when all answer — the chaos-simulated case and a
+        hot-spare rejoin — or a shrunk plan, dp-first/tp-last, when
+        chips are gone), re-place params, recompute every sharding
+        from the surviving buffer SHAPES, and rebuild the compiled
+        surface. The recovery code that runs next re-settles the same
+        hbm lease keys per shard (account's group SET semantics — no
+        double count even across a shape change) and rewarms T0 from
+        the T1/T2 tiers exactly like single-device recovery, so
+        serving resumes token-exact instead of the process dying with
+        the device. Runs under the device lock on the loop thread.
+
+        LIMIT: the params re-place below reads the OLD placement. A
+        device that answers the probe again (transient loss, the
+        chaos-simulated case) or whose param shards are replicated
+        elsewhere recovers warm; a chip that is physically gone while
+        holding the only copy of a tp param shard makes that
+        device_put raise, and the outer recovery marks the engine
+        down — restart-and-reload is the path for that case until
+        params can re-place from a host/checkpoint copy
+        (docs/advanced-guide/multichip-serving.md, known limits)."""
+        from ..parallel import (kv_cache_specs, kv_head_shards,
+                                paged_cache_specs, remesh, replicated,
+                                shardings_for)
+
+        live = [d for d in self.mesh.devices.flat if self._device_alive(d)]
+        lost = self.mesh.devices.size - len(live)
+        new_mesh = remesh(self.mesh, live)
+        self.mesh = new_mesh
+        self._dev_labels = tuple(str(d.id) for d in new_mesh.devices.flat)
+        self._rep_sh = replicated(new_mesh)
+        # params re-place (a no-op data move when the mesh is
+        # unchanged); the LoRA stacks ride along and re-settle their
+        # lease via account's SET semantics right below. (GL202
+        # suppressed: params are placed and owned by the config
+        # wiring, not the engine — the engine accounts only the
+        # subtree it allocated, exactly like construction does.)
+        self.params = jax.device_put(  # noqa: GL202 — see note above
+            self.params, shardings_for(self.params, new_mesh))
+        if self._n_adapters:
+            stacks = {k: v for k, v in self.params["layers"].items()
+                      if k.startswith("lora_")}
+            if stacks:
+                hbm.account("lora", stacks, owner=self)
+        # shardings recompute from the dead buffers' SHAPES (the aval
+        # outlives the donated storage), so the reallocs that follow
+        # land placed on the new mesh
+        self._cache_sh = (paged_cache_specs(new_mesh, self.cache)
+                          if self._paged
+                          else kv_cache_specs(new_mesh, self.cache))
+        if self._pool is not None:
+            self._pool_sh = kv_cache_specs(new_mesh, self._pool)
+        if hasattr(self, "_scratch"):
+            self._scratch_sh = kv_cache_specs(new_mesh, self._scratch)
+        new_shards = kv_head_shards(new_mesh, self.cfg.n_kv_heads)
+        if self._kvc is not None and new_shards != self._kv_shards:
+            # the shard layout changed (degraded tp): T1 survives
+            # (payloads assemble dense at promotion), T2 re-namespaces
+            from .kvcache import model_fingerprint
+
+            self._kv_shards = new_shards
+            self._kvc.rekey(
+                model_fingerprint(self.cfg, self.params,
+                                  extra=str(self._kvc.layout.np_dtype)
+                                  + self._mesh_extra()),
+                new_shards)
+        else:
+            self._kv_shards = new_shards
+        self._build_jits()
+        self._replacements += 1
+        if self.logger is not None:
+            self.logger.warn({
+                "event": "mesh re-placed after device failure",
+                "lost_devices": lost,
+                "devices": int(new_mesh.devices.size),
+                "axes": {k: int(v) for k, v in
+                         zip(new_mesh.axis_names, new_mesh.devices.shape)
+                         if v > 1}})
 
     # top-k truncation width: per-request k is traced (no recompiles);
     # ranks past k are masked within this fixed top set
@@ -1068,10 +1336,12 @@ class GenerationEngine:
         from ..models import paged_llama
 
         key, sub = jax.random.split(key)  # chained: see _fused_decode_scan
+        # flash prefill only off-mesh (pallas is opaque to GSPMD) —
+        # same contract as the contiguous _prefill_fn
         logits, k, v, _ = llama.prefill_kv(
             params, self.cfg, tokens, jnp.asarray([length]),
             rope_max=self.max_seq, rope_tables=self.rope_tables,
-            flash=True, adapter=adapter,
+            flash=self.mesh is None, adapter=adapter,
             logit_pos=jnp.asarray([length - 1]))
         cache = paged_llama.write_prompt_blocks(cache, k, v, blocks, length)
         cache = cache._replace(lengths=cache.lengths.at[slot].set(length))
@@ -1088,7 +1358,8 @@ class GenerationEngine:
 
         logits, stepped = paged_llama.paged_verify_step(
             params, self.cfg, window, cache, table,
-            rope_tables=self.rope_tables, adapter=adapter)
+            rope_tables=self.rope_tables, adapter=adapter,
+            flash=self.mesh is None)
         return self._verify_epilogue(logits, window, active, stepped)
 
     def _paged_step_fn(self, cache, params, pack, carry, key):
@@ -1105,7 +1376,8 @@ class GenerationEngine:
         def step_model(tokens, cache):
             return paged_llama.paged_decode_step(
                 params, self.cfg, tokens, cache, table,
-                rope_tables=self.rope_tables, adapter=adapter)
+                rope_tables=self.rope_tables, adapter=adapter,
+                flash=self.mesh is None)
 
         return self._fused_decode_scan(cache, pack, carry, key, step_model)
 
@@ -1370,6 +1642,15 @@ class GenerationEngine:
                 "pipeline": self._pipeline_stats(),
             },
         }
+        if self.mesh is not None:
+            out["mesh"] = {
+                "devices": int(self.mesh.devices.size),
+                "axes": {k: int(v) for k, v in
+                         zip(self.mesh.axis_names, self.mesh.devices.shape)
+                         if v > 1},
+                "kv_shards": self._kv_shards,
+                "replacements": self._replacements,
+            }
         if self.gate is not None:
             out["admission"] = self.gate.stats()
         if self._kvc is not None:
@@ -1526,8 +1807,10 @@ class GenerationEngine:
             if self._host_write_jit is not None:
                 # warm the T1/T2 promote program with an IDENTITY
                 # rewrite of pool row 0 (a zero-filled dummy would
-                # corrupt a live entry's stored KV)
-                kv = self._kv_row_get(self._pool, 0, self.max_seq)
+                # corrupt a live entry's stored KV); mesh snapshots
+                # assemble dense first, like the promote path
+                kv = dense_hostkv(self._kv_row_get(self._pool, 0,
+                                                   self.max_seq))
                 quant = self._pool.quantized
                 self._pool = jax.block_until_ready(self._host_write_jit(
                     self._pool, jnp.asarray(kv.k[:, None]),
@@ -2312,19 +2595,60 @@ class GenerationEngine:
         return mt
 
     def _kv_row_get(self, store, row: int, plen: int,
-                    start: int = 0) -> HostKV:
+                    start: int = 0) -> "HostKV | ShardedHostKV":
         """Fetch positions ``[start, plen)`` of one pool/cache row to
         host numpy — the spill half of T1 offload, the read half of
         T2 write-through, and (``start > 0``) the incremental KV-ship
-        reads of a prefill worker. Single-device only (on a mesh this
-        would gather the sharded row; offload tiers are gated off
-        there)."""
+        reads of a prefill worker. On a MESH the snapshot is
+        PER-SHARD: each tp shard's head range reads straight off its
+        own device shard (no cross-device gather on the spill path) —
+        a ShardedHostKV whose parts the offload tiers store and frame
+        verbatim; the restore side assembles the canonical dense row
+        (dense_hostkv) before the placed write."""
         quant = store.k_scale is not None
-        return HostKV(
-            np.asarray(store.k[:, row, start:plen]),
-            np.asarray(store.v[:, row, start:plen]),
-            np.asarray(store.k_scale[:, row, start:plen]) if quant else None,
-            np.asarray(store.v_scale[:, row, start:plen]) if quant else None)
+        if self.mesh is None:
+            return HostKV(
+                np.asarray(store.k[:, row, start:plen]),
+                np.asarray(store.v[:, row, start:plen]),
+                np.asarray(store.k_scale[:, row, start:plen])
+                if quant else None,
+                np.asarray(store.v_scale[:, row, start:plen])
+                if quant else None)
+        k_p = self._row_shard_parts(store.k, row, start, plen)
+        v_p = self._row_shard_parts(store.v, row, start, plen)
+        ks_p = (self._row_shard_parts(store.k_scale, row, start, plen)
+                if quant else None)
+        vs_p = (self._row_shard_parts(store.v_scale, row, start, plen)
+                if quant else None)
+        parts = tuple(HostKV(k_p[i], v_p[i],
+                             ks_p[i] if quant else None,
+                             vs_p[i] if quant else None)
+                      for i in range(len(k_p)))
+        return parts[0] if len(parts) == 1 else ShardedHostKV(parts)
+
+    @staticmethod
+    def _row_shard_parts(arr, row: int, start: int, stop: int) -> list:
+        """One batch row's positions ``[start, stop)`` read per tp
+        shard of a [L, B, Smax, KV(, hd)] cache leaf: walk the leaf's
+        addressable shards, keep the shard covering ``row`` for each
+        distinct KV-head offset (replicated axes repeat the same
+        heads — first wins), and return the pieces in head order.
+        Each read is a single-device ``device_get`` of that shard's
+        slab — the mesh never assembles the row to spill it."""
+        parts: dict[int, np.ndarray] = {}
+        B = arr.shape[1]
+        for sh in arr.addressable_shards:
+            idx = sh.index
+            bsl = idx[1]
+            b0 = bsl.start or 0
+            b1 = B if bsl.stop is None else bsl.stop
+            if not (b0 <= row < b1):
+                continue
+            h0 = idx[3].start or 0
+            if h0 in parts:
+                continue
+            parts[h0] = np.asarray(sh.data)[:, row - b0, start:stop]
+        return [parts[h] for h in sorted(parts)]
 
     def _offload_victim(self, victim) -> None:
         """Spill a T0-evicted entry's pool row to the host tier. MUST
@@ -2341,8 +2665,11 @@ class GenerationEngine:
         one compiled row write) and register it under the entry's full
         key — the next hit on this prefix is a T0 row copy. Returns the
         row, or None when the payload cannot serve this engine (shape/
-        quantization drift: treat as a miss, never an error)."""
-        kv = mt.hostkv
+        quantization drift: treat as a miss, never an error). Sharded
+        snapshots assemble to the canonical dense row first — which is
+        what lets T1 entries survive even a mesh-SHAPE change across
+        device-loss re-placement."""
+        kv = dense_hostkv(mt.hostkv) if mt.hostkv is not None else None
         quant = self._pool.quantized
         if (kv is None or kv.plen > self.max_seq or len(mt.key) < kv.plen
                 or (quant and kv.k_scale is None)
@@ -2547,10 +2874,7 @@ class GenerationEngine:
         from ..models.paged_llama import (read_blocks_to_row,
                                           write_row_to_blocks)
 
-        self._scratch = hbm.alloc(
-            "engine", lambda: llama.init_cache(self.cfg, 1, self.max_seq,
-                                               dtype=self._kv_dtype),
-            owner=self, tag="scratch", priority=hbm.PRI_SCRATCH)
+        self._alloc_scratch()
         self._row_to_blocks_jit = jax.jit(write_row_to_blocks,
                                           donate_argnums=(0,))
         self._blocks_to_row_jit = jax.jit(read_blocks_to_row,
@@ -2695,10 +3019,12 @@ class GenerationEngine:
         exactly like post-recovery rewarming — the cache gets slower,
         the process survives. Runs under the device lock (reentrant:
         the serving loop may trigger its own shrink via the admission
-        checkpoint); a mesh engine skips (its pool is sharded and the
-        offload spill path is gated off there). Returns bytes freed."""
-        if self.mesh is not None:
-            return 0
+        checkpoint). Mesh pools shrink the same way — spills are
+        per-shard snapshots, the smaller pool re-places onto a FITTED
+        sharding (fewer rows may stop dividing the data axes) and the
+        pool programs rebuild against it. Returns bytes freed
+        (global; the arbiter's per-device pass scales by this lease's
+        shard fraction)."""
         with self._device_lock:
             kvc = getattr(self, "_kvc", None)
             pool = getattr(self, "_pool", None)
@@ -2723,11 +3049,31 @@ class GenerationEngine:
             self._pool = None
             del pool
             try:
-                self._pool = hbm.account(
-                    "kvcache-t0", llama.init_cache(self.cfg, new_slots,
-                                                   self.max_seq,
-                                                   dtype=self._kv_dtype),
-                    owner=self, tag="pool")
+                if self.mesh is not None:
+                    from ..parallel import kv_cache_specs
+
+                    # FITTED fresh: the shrunk row count may stop
+                    # dividing the data axes (replicate instead), and
+                    # the pool programs must rebuild against whatever
+                    # the new placement actually is
+                    self._pool_sh = kv_cache_specs(
+                        self.mesh, jax.eval_shape(
+                            lambda: llama.init_cache(
+                                self.cfg, new_slots, self.max_seq,
+                                dtype=self._kv_dtype)))
+
+                def _smaller_pool():
+                    p = llama.init_cache(self.cfg, new_slots,
+                                         self.max_seq,
+                                         dtype=self._kv_dtype)
+                    if self._pool_sh is not None:
+                        p = jax.device_put(p, self._pool_sh)
+                    return p
+
+                self._pool = hbm.account("kvcache-t0", _smaller_pool(),
+                                         owner=self, tag="pool")
+                if self.mesh is not None:
+                    self._build_pool_jits()
             except BaseException:
                 # even the SMALLER pool failed to allocate (we are, by
                 # definition, under memory pressure here). A None pool
@@ -3305,6 +3651,14 @@ class GenerationEngine:
                             self._retire(idx, slot)
                 try:
                     with self._device_lock:
+                        if self.mesh is not None:
+                            # warm device-loss re-placement: rebuild
+                            # the mesh over live devices, re-place
+                            # params, recompute shardings, rebuild the
+                            # compiled surface — the reallocs below
+                            # then land placed on the NEW mesh and
+                            # re-settle the same per-shard lease keys
+                            self._replace_mesh()
                         # the PRNG key chains THROUGH dispatches now: an
                         # async failure leaves self._key bound to the
                         # failed computation's error-state output, and
@@ -3329,16 +3683,26 @@ class GenerationEngine:
                                                           self._pool_sh)
                                 return jax.block_until_ready(pool)
 
-                            # re-lease + re-account (set semantics):
-                            # the donated old pool died with the failed
-                            # dispatch, and the arbiter's reclaim-then-
-                            # retry covers a recovery that lands while
-                            # HBM is contended
-                            self._pool = hbm.alloc(
-                                "kvcache-t0", _realloc_pool,
-                                owner=self, tag="pool",
-                                priority=hbm.PRI_CACHE,
-                                reclaim=self._hbm_pool_reclaim)
+                            # re-lease + re-account (set semantics over
+                            # the lease group — mesh pools re-settle
+                            # the same per-shard keys, never double-
+                            # counting): the donated old pool died with
+                            # the failed dispatch, and the arbiter's
+                            # reclaim-then-retry covers a recovery that
+                            # lands while HBM is contended
+                            if self.mesh is not None:
+                                self._pool = hbm.alloc_sharded(
+                                    "kvcache-t0", _realloc_pool,
+                                    owner=self, tag="pool",
+                                    priority=hbm.PRI_CACHE,
+                                    reclaim=self._hbm_pool_reclaim,
+                                    devices=self._dev_labels)
+                            else:
+                                self._pool = hbm.alloc(
+                                    "kvcache-t0", _realloc_pool,
+                                    owner=self, tag="pool",
+                                    priority=hbm.PRI_CACHE,
+                                    reclaim=self._hbm_pool_reclaim)
                         if self._paged:
                             from ..models.paged_llama import init_paged_cache
 
@@ -3354,14 +3718,27 @@ class GenerationEngine:
                                 # too — a failed chunk dispatch leaves it
                                 # consumed, bricking every later
                                 # long-prompt admission
-                                self._scratch = hbm.alloc(
-                                    "engine",
-                                    lambda: jax.block_until_ready(
-                                        llama.init_cache(
-                                            self.cfg, 1, self.max_seq,
-                                            dtype=self._kv_dtype)),
-                                    owner=self, tag="scratch",
-                                    priority=hbm.PRI_SCRATCH)
+
+                                def _realloc_scratch():
+                                    s = llama.init_cache(
+                                        self.cfg, 1, self.max_seq,
+                                        dtype=self._kv_dtype)
+                                    if self._scratch_sh is not None:
+                                        s = jax.device_put(
+                                            s, self._scratch_sh)
+                                    return jax.block_until_ready(s)
+
+                                if self.mesh is not None:
+                                    self._scratch = hbm.alloc_sharded(
+                                        "engine", _realloc_scratch,
+                                        owner=self, tag="scratch",
+                                        priority=hbm.PRI_SCRATCH,
+                                        devices=self._dev_labels)
+                                else:
+                                    self._scratch = hbm.alloc(
+                                        "engine", _realloc_scratch,
+                                        owner=self, tag="scratch",
+                                        priority=hbm.PRI_SCRATCH)
                         else:
                             def _realloc_cache():
                                 return llama.init_cache(self.cfg,
@@ -3378,10 +3755,17 @@ class GenerationEngine:
                                                        self._cache_sh)
                             return jax.block_until_ready(cache)
 
-                        self.cache = hbm.alloc(
-                            "engine", _realloc_placed, owner=self,
-                            tag="cache", priority=hbm.PRI_SERVING,
-                            reclaim=cache_reclaim)
+                        if self.mesh is not None:
+                            self.cache = hbm.alloc_sharded(
+                                "engine", _realloc_placed, owner=self,
+                                tag="cache", priority=hbm.PRI_SERVING,
+                                reclaim=cache_reclaim,
+                                devices=self._dev_labels)
+                        else:
+                            self.cache = hbm.alloc(
+                                "engine", _realloc_placed, owner=self,
+                                tag="cache", priority=hbm.PRI_SERVING,
+                                reclaim=cache_reclaim)
                     if self.logger is not None:
                         self.logger.warn({"event": "generation cache "
                                           "reallocated after device failure"})

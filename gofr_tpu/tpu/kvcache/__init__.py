@@ -32,14 +32,16 @@ from typing import Any
 from .hbm import HBMTier
 from .host import HostTier
 from .manager import CacheManager, Match, clamp_restore_len
-from .quant import HostKV, KVLayout, decode_block, encode_block
+from .quant import (HostKV, KVLayout, ShardedHostKV, decode_block,
+                    dense_hostkv, encode_block)
 from .radix import Entry, RadixIndex, chain_hashes
 from .redis_tier import RedisTier
 
 __all__ = [
     "CacheManager", "Match", "clamp_restore_len",
     "HBMTier", "HostTier", "RedisTier",
-    "HostKV", "KVLayout", "encode_block", "decode_block",
+    "HostKV", "KVLayout", "ShardedHostKV", "dense_hostkv",
+    "encode_block", "decode_block",
     "Entry", "RadixIndex", "chain_hashes",
     "KVCacheOptions", "options_from_config", "model_fingerprint",
 ]

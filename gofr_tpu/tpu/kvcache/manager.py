@@ -66,16 +66,24 @@ class CacheManager:
     def __init__(self, slots: int, layout: KVLayout, *, block: int = 16,
                  host_bytes: int = 0, redis=None, redis_ttl_s: float = 300.0,
                  epoch_refresh_s: float = 5.0, fingerprint: str = "",
-                 metrics=None, logger=None):
+                 metrics=None, logger=None, shards: int = 1):
         self.block = max(1, int(block))
         self.layout = layout
+        # tensor-parallel shard count (mesh engines): T1 stores the
+        # engine's per-shard snapshots verbatim; T2 frames each shard
+        # through the block codec under shard-suffixed keys (the
+        # fingerprint carries the mesh shape so differently-sharded
+        # replicas never exchange frames)
+        self.shards = max(1, int(shards))
         self.t0 = HBMTier(slots, self.block)
         self.host = HostTier(host_bytes, self.block) if host_bytes > 0 \
             else None
         self.redis = RedisTier(redis, fingerprint, layout, self.block,
                                ttl_s=redis_ttl_s,
                                epoch_refresh_s=epoch_refresh_s,
-                               logger=logger) if redis is not None else None
+                               logger=logger,
+                               shards=self.shards) if redis is not None \
+            else None
         self.metrics = metrics
         self.logger = logger
         # bumped on any mutation that can change a match verdict — the
@@ -241,6 +249,16 @@ class CacheManager:
         n = self.t0.clear()
         self._gauges()
         return n
+
+    def rekey(self, fingerprint: str, shards: int) -> None:
+        """Mesh re-placement changed the shard layout (device-loss
+        recovery onto a smaller tp): T1 survives as-is (its payloads
+        assemble to the canonical dense row at promotion), but T2's
+        per-shard frames must re-namespace — see RedisTier.rekey."""
+        self.version += 1
+        self.shards = max(1, int(shards))
+        if self.redis is not None:
+            self.redis.rekey(fingerprint, self.shards)
 
     def invalidate_adapter(self, adapter: int) -> dict:
         """LoRA hot-swap: stored KV was computed through the OLD wk/wv

@@ -65,6 +65,59 @@ class HostKV(NamedTuple):
             self.v_scale[:, start:stop] if self.v_scale is not None else None)
 
 
+class ShardedHostKV(NamedTuple):
+    """A mesh engine's host snapshot of one cache row: one
+    :class:`HostKV` per tensor-parallel shard, ordered by KV-head
+    offset (part i holds heads [i*KV/n, (i+1)*KV/n)). The spill half
+    reads each part straight off its device shard (no cross-device
+    assembly on the spill path); the T2 tier frames each part through
+    the UNCHANGED int8 block codec with the per-shard head count —
+    which is why its namespace keys carry the mesh shape (a tp=4
+    replica's frames must never decode on a tp=2 one). ``assemble()``
+    is the restore-side canonicalization: promotion pads a DENSE row
+    and lands it with one sharded write, so T1 snapshots survive even
+    a mesh-SHAPE change across device-loss re-placement."""
+
+    parts: tuple  # of HostKV, kv-head order
+
+    @property
+    def shards(self) -> int:
+        return len(self.parts)
+
+    @property
+    def plen(self) -> int:
+        return self.parts[0].plen if self.parts else 0
+
+    @property
+    def nbytes(self) -> int:
+        return sum(p.nbytes for p in self.parts)
+
+    def slice_tokens(self, start: int, stop: int) -> "ShardedHostKV":
+        return ShardedHostKV(tuple(p.slice_tokens(start, stop)
+                                   for p in self.parts))
+
+    def assemble(self) -> HostKV:
+        """Concatenate the shards back into one cache-native dense
+        HostKV (KV-head axis) — the canonical layout every device
+        write path consumes."""
+        if len(self.parts) == 1:
+            return self.parts[0]
+        k = np.concatenate([p.k for p in self.parts], axis=2)
+        v = np.concatenate([p.v for p in self.parts], axis=2)
+        if self.parts[0].k_scale is not None:
+            ks = np.concatenate([p.k_scale for p in self.parts], axis=2)
+            vs = np.concatenate([p.v_scale for p in self.parts], axis=2)
+        else:
+            ks = vs = None
+        return HostKV(k, v, ks, vs)
+
+
+def dense_hostkv(kv: "HostKV | ShardedHostKV") -> HostKV:
+    """Canonical dense view of either host-snapshot flavor — what the
+    promote/ingest write paths (and shape validation) consume."""
+    return kv.assemble() if isinstance(kv, ShardedHostKV) else kv
+
+
 def _quantize(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """Per-vector max-abs int8: scale [..., KV] over the head dim."""
     x32 = np.asarray(x, np.float32)

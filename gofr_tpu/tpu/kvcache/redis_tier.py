@@ -45,7 +45,8 @@ import time
 
 import numpy as np
 
-from .quant import HostKV, KVLayout, concat_blocks, decode_block, encode_block
+from .quant import (HostKV, KVLayout, ShardedHostKV, concat_blocks,
+                    decode_block, encode_block)
 from .radix import chain_hashes
 
 NAMESPACE = "gofr:kv"
@@ -64,10 +65,26 @@ class RedisTier:
     def __init__(self, client, fingerprint: str, layout: KVLayout,
                  block: int = 16, ttl_s: float = 300.0,
                  epoch_refresh_s: float = 5.0, logger=None,
-                 namespace: str = NAMESPACE):
+                 namespace: str = NAMESPACE, shards: int = 1):
         self.client = client
         self.fingerprint = fingerprint
         self.layout = layout
+        # tensor-parallel shard count (mesh engines): each stored block
+        # becomes ``shards`` frames — the UNCHANGED int8 codec applied
+        # per shard with the per-shard head count, keyed ...:s{i}. The
+        # caller's fingerprint carries the mesh shape, so replicas
+        # sharded differently occupy disjoint namespaces (a 2-shard
+        # frame must never half-decode on a 4-shard reader).
+        self.shards = max(1, int(shards))
+        if self.shards > 1:
+            if layout.kv_heads % self.shards:
+                raise ValueError(
+                    f"kv_heads={layout.kv_heads} not divisible by "
+                    f"shards={self.shards}")
+            self._shard_layout = layout._replace(
+                kv_heads=layout.kv_heads // self.shards)
+        else:
+            self._shard_layout = layout
         self.block = int(block)
         self.ttl_s = float(ttl_s)
         self.epoch_refresh_s = float(epoch_refresh_s)
@@ -90,8 +107,10 @@ class RedisTier:
     def _epoch_key(self, adapter: int) -> str:
         return f"{self.ns}:{self.fingerprint}:ep:{adapter}"
 
-    def _block_key(self, adapter: int, epoch: int, h: bytes) -> str:
-        return f"{self.ns}:{self.fingerprint}:{adapter}:{epoch}:{h.hex()}"
+    def _block_key(self, adapter: int, epoch: int, h: bytes,
+                   shard: int = 0) -> str:
+        key = f"{self.ns}:{self.fingerprint}:{adapter}:{epoch}:{h.hex()}"
+        return f"{key}:s{shard}" if self.shards > 1 else key
 
     def _epoch(self, adapter: int) -> int:
         if adapter in self._pending_bumps:
@@ -139,34 +158,53 @@ class RedisTier:
 
     # -- tier API ------------------------------------------------------------
     def match(self, prompt: np.ndarray, adapter: int = 0
-              ) -> tuple[int, HostKV | None]:
+              ) -> "tuple[int, HostKV | ShardedHostKV | None]":
         """(matched_tokens, kv) — the longest run of consecutive valid
-        shared blocks from position 0; (0, None) on miss or error."""
+        shared blocks from position 0; (0, None) on miss or error. On
+        sharded tiers a block counts only when EVERY shard's frame
+        decodes (a half-present block would restore half a row's
+        heads), and the hit returns a :class:`ShardedHostKV`."""
         nb = len(prompt) // self.block
         if nb == 0 or not self.available:
             return 0, None
+        S = self.shards
         try:
             ep = self._epoch(adapter)
             hashes = list(chain_hashes(prompt, self.block, adapter))
-            keys = [self._block_key(adapter, ep, h) for h in hashes]
+            keys = [self._block_key(adapter, ep, h, s)
+                    for h in hashes for s in range(S)]
             raw = self.client.mget(*keys)
             self._ok()
         except Exception as e:  # noqa: BLE001 — fail-open by contract
             self._fail("match", e)
             return 0, None
-        blocks: list[HostKV] = []
-        for data in raw:
-            kv = decode_block(data, self.layout) if data is not None else None
-            if kv is None or kv.plen != self.block:
-                if data is not None:
+        per_shard: list[list[HostKV]] = [[] for _ in range(S)]
+        n_ok = 0
+        for i in range(len(hashes)):
+            row = raw[i * S:(i + 1) * S]
+            kvs = [decode_block(d, self._shard_layout)
+                   if d is not None else None for d in row]
+            if any(kv is None or kv.plen != self.block for kv in kvs):
+                # an integrity reject is a PRESENT frame that failed
+                # decode (or carries the wrong plen); a merely-absent
+                # shard is routine TTL/eviction churn — counting it
+                # would fire corruption alerts on normal cache misses
+                if any((kv is None and d is not None)
+                       or (kv is not None and kv.plen != self.block)
+                       for d, kv in zip(row, kvs)):
                     self.checksum_rejects += 1
                 break
-            blocks.append(kv)
-            self.bytes_got += len(data)
-        if not blocks:
+            for s, kv in enumerate(kvs):
+                per_shard[s].append(kv)
+            self.bytes_got += sum(len(d) for d in row)
+            n_ok += 1
+        if not n_ok:
             return 0, None
-        self.blocks_got += len(blocks)
-        return len(blocks) * self.block, concat_blocks(blocks)
+        self.blocks_got += n_ok
+        if S == 1:
+            return n_ok * self.block, concat_blocks(per_shard[0])
+        return n_ok * self.block, ShardedHostKV(
+            tuple(concat_blocks(bl) for bl in per_shard))
 
     def pending_put_len(self, key: np.ndarray, adapter: int = 0) -> int:
         """Token positions a put() for ``key`` would actually read: up
@@ -191,10 +229,21 @@ class RedisTier:
                 last = i + 1
         return last * self.block
 
-    def put(self, key: np.ndarray, adapter: int, kv: HostKV) -> int:
+    def put(self, key: np.ndarray, adapter: int,
+            kv: "HostKV | ShardedHostKV") -> int:
         """Write-through the FULL blocks of a newly stored prefix; the
         trailing partial block stays replica-local (it has no chain
-        hash). Returns blocks written. One pipeline, one round trip."""
+        hash). Returns blocks written. One pipeline, one round trip.
+        Sharded tiers take a :class:`ShardedHostKV` (one frame per
+        shard per block); a block enters the write-once dedup set only
+        when EVERY shard's SET succeeded — a half-written block must
+        stay retryable or readers would forever decode half a row."""
+        S = self.shards
+        if S > 1:
+            if not isinstance(kv, ShardedHostKV) or kv.shards != S:
+                return 0  # shape drift (e.g. post-re-placement): skip
+        elif isinstance(kv, ShardedHostKV):
+            kv = kv.assemble()
         nb = min(len(key), kv.plen) // self.block
         if nb == 0 or not self.available:
             return 0
@@ -209,11 +258,16 @@ class RedisTier:
                 seen = (adapter, ep, h)
                 if seen in self._written:
                     continue
-                frame = encode_block(
-                    kv.slice_tokens(i * self.block, (i + 1) * self.block))
-                pipe.command("SET", self._block_key(adapter, ep, h), frame,
-                             "PX", int(self.ttl_s * 1000))
-                wrote.append((seen, len(frame)))
+                sl = kv.slice_tokens(i * self.block, (i + 1) * self.block)
+                parts = sl.parts if S > 1 else (sl,)
+                sizes = []
+                for s, part in enumerate(parts):
+                    frame = encode_block(part)
+                    pipe.command("SET",
+                                 self._block_key(adapter, ep, h, s),
+                                 frame, "PX", int(self.ttl_s * 1000))
+                    sizes.append(len(frame))
+                wrote.append((seen, sizes))
             if not wrote:
                 return 0
             replies = pipe.execute()
@@ -227,14 +281,21 @@ class RedisTier:
         # pending_put_len would report the block shared forever while
         # no replica can ever read it
         ok = 0
-        for (seen, nbytes), reply in zip(wrote, replies):
-            if reply == "OK":
+        r = 0
+        for seen, sizes in wrote:
+            block_replies = replies[r:r + len(sizes)]
+            r += len(sizes)
+            good = True
+            for reply in block_replies:
+                if reply != "OK":
+                    good = False
+                    self._fail("put-reply",
+                               reply if isinstance(reply, Exception)
+                               else RuntimeError(repr(reply)))
+            if good:
                 self._written.add(seen)
-                self.bytes_put += nbytes
+                self.bytes_put += sum(sizes)
                 ok += 1
-            else:
-                self._fail("put-reply", reply if isinstance(reply, Exception)
-                           else RuntimeError(repr(reply)))
         self.blocks_put += ok
         return ok
 
@@ -257,8 +318,27 @@ class RedisTier:
             self._epochs.pop(adapter, None)
         self._written = {w for w in self._written if w[0] != adapter}
 
+    def rekey(self, fingerprint: str, shards: int) -> None:
+        """Re-namespace the tier after a mesh re-placement changed the
+        shard layout (device-loss recovery onto a smaller tp): new
+        fingerprint (it carries the mesh shape), new per-shard head
+        count, and the write-once dedup set dropped — frames written
+        under the old shape live in a namespace this replica no longer
+        reads, and TTL out."""
+        shards = max(1, int(shards))
+        if shards > 1 and self.layout.kv_heads % shards:
+            shards = 1
+        self.fingerprint = fingerprint
+        self.shards = shards
+        self._shard_layout = (self.layout._replace(
+            kv_heads=self.layout.kv_heads // shards) if shards > 1
+            else self.layout)
+        self._written.clear()
+        self._epochs.clear()
+
     def stats(self) -> dict:
         return {"blocks_put": self.blocks_put, "blocks_got": self.blocks_got,
+                "shards": self.shards,
                 "bytes_put": self.bytes_put, "bytes_got": self.bytes_got,
                 "errors": self.errors,
                 "checksum_rejects": self.checksum_rejects,

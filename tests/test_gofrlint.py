@@ -435,6 +435,22 @@ def test_gl202_local_flow_through_account_is_clean(tmp_path):
     assert analyze(dst) == []
 
 
+def test_gl202_alloc_sharded_is_an_accounting_form(tmp_path):
+    # the per-shard arbiter form mesh engines use: an allocation thunk
+    # nested in a QUALIFIED hbm.alloc_sharded(...) call is accounted —
+    # sharded persist points need no noqa. A bare .alloc_sharded() on
+    # some other object stays unblessed (same rule as alloc/lease).
+    src = ("import jax.numpy as jnp\n\n\n"
+           "class E:\n"
+           "    def __init__(self, hbm):\n"
+           "        self.cache = hbm.alloc_sharded(\n"
+           "            'engine', lambda: jnp.zeros((4, 8)),\n"
+           "            owner=self, devices=('0', '1'))\n"
+           "        self.raw = jnp.zeros((4, 8))  # EXPECTED unblessed\n")
+    dst = scaffold(tmp_path, "mod.py", src)
+    assert [c for _, c in analyze(dst)] == ["GL202"]
+
+
 def test_gl202_dispatch_operand_not_persisted(tmp_path):
     # warmup shape: an allocated dummy fed to a dispatch whose OUTPUT
     # is persisted — the allocation is consumed, not persisted

@@ -607,6 +607,85 @@ def test_configure_budget_mb_and_health_surface():
         eng.close()
 
 
+def test_configure_explicit_global_still_resolves_per_device(monkeypatch):
+    # TPU_HBM_BUDGET_MB predates the per-device budget: setting it
+    # alone must NOT leave per-device arbitration off on accelerator
+    # backends (the early-return regression), and resolution must read
+    # LOCAL devices — under the distributed runtime jax.devices() is
+    # the pod list while this process only owns its own chips' HBM.
+    class _Dev:
+        platform = "tpu"
+
+        @staticmethod
+        def memory_stats():
+            return {"bytes_limit": 100 << 20}
+
+    monkeypatch.setattr(jax, "local_devices", lambda: [_Dev(), _Dev()])
+    got = hbm.configure(budget_mb=64, headroom=0.1)
+    assert got == hbm.budget() == 64 << 20  # explicit global wins
+    assert hbm.device_budget() == int((100 << 20) * 0.9)
+    hbm.reset()
+    # and the mirror: explicit per-device alone resolves the global
+    # from per_dev * local device count
+    hbm.configure(device_budget_mb=32, headroom=0.1)
+    assert hbm.device_budget() == 32 << 20
+    assert hbm.budget() == int((100 << 20) * 0.9) * 2
+
+
+def test_per_device_lease_failure_names_the_device():
+    # no global budget at all: only the per-device bound can fail, and
+    # the 429 must carry the device and ITS figures (check()'s
+    # "sub@devN" convention), not budget=None/global in-use
+    hbm.set_device_budget(8 << 20)
+    o = object()
+    hbm.lease("engine", 6 << 20, owner=o, tag="cache", device="3")
+    with pytest.raises(hbm.HBMExhausted) as ei:
+        hbm.lease("engine", 4 << 20, owner=o, tag="scratch", device="3")
+    msg = str(ei.value)
+    assert "@dev3" in msg
+    # the DEVICE's budget and in-use, not the (unset) global ones —
+    # with budget=None the old path rendered no figures at all
+    assert "budget 8 MiB" in msg and "in use 6 MiB" in msg
+
+
+def test_device_gauge_zeroes_when_device_entries_vanish():
+    # a series that just STOPS updating reads as phantom in-use on a
+    # dead/idle chip forever — release must push an explicit 0 per
+    # device (the subsystem gauge's zero-on-release contract)
+    m = Manager()
+    register_framework_metrics(m)
+    hbm.set_metrics(m)
+    try:
+        o = object()
+        hbm.lease("engine", 10, owner=o, tag="c", device="0")
+        hbm.lease("engine", 20, owner=o, tag="c", device="1")
+        text = m.render_prometheus()
+        assert 'app_tpu_hbm_device_in_use_bytes{device="1"} 20' in text
+        hbm.release("engine", owner=o)
+        text = m.render_prometheus()
+        assert 'app_tpu_hbm_device_in_use_bytes{device="0"} 0' in text
+        assert 'app_tpu_hbm_device_in_use_bytes{device="1"} 0' in text
+    finally:
+        hbm.set_metrics(None)
+
+
+def test_device_budget_bounds_deviceless_group():
+    # device-less entries are ONE implicit group (a single-device
+    # process's default chip): on a multi-chip host the auto budget is
+    # per_dev * n_local, so without this check a non-mesh engine could
+    # overcommit its one chip n_local-fold before anything bound it
+    hbm.set_device_budget(8 << 20)
+    o = object()
+    hbm.lease("engine", 6 << 20, owner=o, tag="cache")
+    with pytest.raises(hbm.HBMExhausted) as ei:
+        hbm.lease("engine", 4 << 20, owner=o, tag="scratch")
+    msg = str(ei.value)
+    assert "@dev" not in msg  # device-less failure names the plain sub
+    assert "budget 8 MiB" in msg and "in use 6 MiB" in msg
+    # and a device-keyed lease is NOT charged against the "" group
+    hbm.lease("engine", 7 << 20, owner=o, tag="shard", device="2")
+
+
 def test_arbiter_stats_lease_table_shape():
     o = object()
     hbm.lease("engine", 10, owner=o, tag="cache",

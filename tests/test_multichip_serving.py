@@ -1,0 +1,293 @@
+"""Multi-chip tensor-parallel serving: per-shard HBM leases, mesh-aware
+paged attention, sharded T1/T2 offload, warm device-loss re-placement.
+
+Runs on the virtual 8-device CPU mesh (tests/conftest.py). Exactness is
+asserted against the single-device engine — the GSPMD specs, the masked
+row copies, and the per-shard spill/restore machinery can never silently
+change tokens. tiny's n_kv_heads=2 keeps tp=2 in the head-aligned
+regime (the tp-splits-a-KV-head hazard is documented in
+docs/advanced-guide/multichip-serving.md and warned at construction).
+"""
+
+import gc
+
+import jax
+import numpy as np
+import pytest
+
+from gofr_tpu import chaos
+from gofr_tpu.config import MapConfig
+from gofr_tpu.models import LLAMA_CONFIGS, llama
+from gofr_tpu.parallel import kv_head_shards, make_mesh, remesh, shard_params
+from gofr_tpu.tpu import GenerationEngine, GenerationError, TPUEngine, hbm
+from gofr_tpu.tpu.kvcache import (HostKV, KVCacheOptions, KVLayout,
+                                  RedisTier, ShardedHostKV, dense_hostkv)
+
+TINY = LLAMA_CONFIGS["tiny"]
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama.init(TINY, jax.random.PRNGKey(1))
+
+
+def _reference(params, prompts, n):
+    eng = GenerationEngine(TINY, params, slots=4, max_seq=64,
+                           prompt_buckets=(8, 16))
+    try:
+        return [eng.generate(p, max_new_tokens=n).tokens() for p in prompts]
+    finally:
+        eng.close()
+
+
+# -- remesh (warm re-placement planning) --------------------------------------
+
+def test_remesh_same_devices_keeps_plan():
+    mesh = make_mesh(tp=2, dp=4)
+    m2 = remesh(mesh, list(mesh.devices.flat))
+    assert dict(zip(m2.axis_names, m2.devices.shape)) == \
+        dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def test_remesh_shrinks_dp_first_keeps_tp():
+    mesh = make_mesh(tp=2, dp=4)
+    m2 = remesh(mesh, list(mesh.devices.flat)[:4])
+    shape = dict(zip(m2.axis_names, m2.devices.shape))
+    # tp carries the per-layer collectives AND decides whether the
+    # weights fit per chip: dp pays for the loss, tp survives
+    assert shape["tp"] == 2 and shape["dp"] == 2
+    m3 = remesh(mesh, list(mesh.devices.flat)[:1])
+    assert dict(zip(m3.axis_names, m3.devices.shape))["tp"] == 1
+    with pytest.raises(ValueError):
+        remesh(mesh, [])
+
+
+# -- ShardedHostKV ------------------------------------------------------------
+
+def _host_kv(plen, kv_heads, seed=0):
+    rng = np.random.default_rng(seed)
+    return HostKV(
+        rng.integers(-127, 127, (2, plen, kv_heads, 8)).astype(np.int8),
+        rng.integers(-127, 127, (2, plen, kv_heads, 8)).astype(np.int8),
+        rng.random((2, plen, kv_heads)).astype(np.float32),
+        rng.random((2, plen, kv_heads)).astype(np.float32))
+
+
+def test_sharded_hostkv_assemble_and_slice():
+    dense = _host_kv(32, 4)
+    parts = tuple(
+        HostKV(dense.k[:, :, lo:lo + 2], dense.v[:, :, lo:lo + 2],
+               dense.k_scale[:, :, lo:lo + 2], dense.v_scale[:, :, lo:lo + 2])
+        for lo in (0, 2))
+    sh = ShardedHostKV(parts)
+    assert sh.shards == 2 and sh.plen == 32
+    assert sh.nbytes == dense.nbytes
+    back = sh.assemble()
+    np.testing.assert_array_equal(back.k, dense.k)
+    np.testing.assert_array_equal(back.v_scale, dense.v_scale)
+    sl = sh.slice_tokens(8, 24)
+    np.testing.assert_array_equal(sl.assemble().k, dense.k[:, 8:24])
+    # dense passthrough
+    assert dense_hostkv(dense) is dense
+    assert dense_hostkv(sh).k.shape == dense.k.shape
+
+
+# -- per-shard Redis frames ---------------------------------------------------
+
+@pytest.fixture()
+def redis_tier_pair():
+    from gofr_tpu.datasource.redisclient import RedisClient
+    from gofr_tpu.testutil.redisfake import FakeRedisServer
+
+    srv = FakeRedisServer()
+    clients = []
+
+    def make(fingerprint, shards):
+        layout = KVLayout(2, 4, 8, True, np.dtype(np.int8), 128)
+        c = RedisClient(srv.host, srv.port)
+        clients.append(c)
+        return RedisTier(c, fingerprint, layout, block=16, ttl_s=60,
+                         shards=shards)
+
+    yield make
+    for c in clients:
+        c.close()
+    srv.close()
+
+
+def test_redis_tier_sharded_frames_roundtrip(redis_tier_pair):
+    tier = redis_tier_pair("fp:tp2", 2)
+    key = np.arange(0, 32, dtype=np.int32)
+    dense = _host_kv(32, 4)
+    sharded = ShardedHostKV(tuple(
+        HostKV(dense.k[:, :, lo:lo + 2], dense.v[:, :, lo:lo + 2],
+               dense.k_scale[:, :, lo:lo + 2],
+               dense.v_scale[:, :, lo:lo + 2]) for lo in (0, 2)))
+    assert tier.put(key, 0, sharded) == 2  # two full blocks
+    m, kv = tier.match(key, 0)
+    assert m == 32 and isinstance(kv, ShardedHostKV) and kv.shards == 2
+    np.testing.assert_array_equal(kv.assemble().k, dense.k)
+    # a differently-sharded replica lives in a different namespace
+    # (the fingerprint carries the mesh shape) and must miss
+    other = redis_tier_pair("fp:tp1", 1)
+    assert other.match(key, 0) == (0, None)
+    # a sharded put of the WRONG shard count is skipped, not garbled
+    assert tier.put(np.arange(50, 82, dtype=np.int32), 0, dense) == 0
+    # an ABSENT shard frame (TTL/eviction churn) is a plain miss,
+    # never an integrity reject — checksum_rejects is a corruption
+    # signal and must not fire on routine cache misses
+    from gofr_tpu.tpu.kvcache.radix import chain_hashes
+
+    rejects = tier.checksum_rejects
+    h0 = next(iter(chain_hashes(key, 16, 0)))
+    tier.client.delete(tier._block_key(0, tier._epoch(0), h0, 1))
+    assert tier.match(key, 0) == (0, None)
+    assert tier.checksum_rejects == rejects
+
+
+# -- per-device budgets + reclaim --------------------------------------------
+
+def test_per_device_budget_reclaims_only_hot_shard():
+    hbm.reset()
+    freed = {"a": 0, "b": 0}
+
+    def reclaim_a(need):
+        freed["a"] += 1
+        hbm.release("suba", owner=None, tag="x")
+        return 600
+
+    def reclaim_b(need):
+        freed["b"] += 1
+        return 600
+
+    try:
+        hbm.set_device_budget(1000)
+        hbm.lease("suba", 600, tag="x", device="0", reclaim=reclaim_a)
+        hbm.lease("subb", 600, tag="y", device="1", reclaim=reclaim_b)
+        # device 0 is the hot shard: covering this lease must ask ONLY
+        # device 0's reclaimers — device 1 keeps its cache
+        hbm.lease("subc", 700, tag="z", device="0")
+        assert freed == {"a": 1, "b": 0}
+        assert hbm.device_bytes()["0"] == 700
+        assert hbm.device_bytes()["1"] == 600
+        # an uncoverable per-device lease sheds typed
+        with pytest.raises(hbm.HBMExhausted):
+            hbm.lease("subd", 900, tag="w", device="1")
+    finally:
+        hbm.reset()
+
+
+def test_account_sharded_splits_per_device_and_resettles(tiny_params):
+    hbm.reset()
+    try:
+        mesh = make_mesh(tp=2, dp=4)
+        sharded = shard_params({"layers": tiny_params["layers"]}, mesh)
+        owner = object()
+        hbm.account("t", sharded, owner=owner, tag="p")
+        total = hbm.tree_nbytes(sharded)
+        per_dev = hbm.device_bytes()
+        # the amortized split preserves the LOGICAL total exactly
+        assert sum(per_dev.values()) == total
+        assert len([d for d in per_dev if d]) == 8
+        # re-account (recovery/re-placement): same keys replaced, no
+        # double count — even from a device-split to a dense account
+        hbm.account("t", sharded, owner=owner, tag="p")
+        assert sum(hbm.device_bytes().values()) == total
+        hbm.release(owner=owner)
+        assert hbm.live_bytes() == {}
+    finally:
+        hbm.reset()
+
+
+# -- mesh-aware paged serving -------------------------------------------------
+
+def test_mesh_paged_token_exact_vs_single_device(tiny_params):
+    prompts = [[5, 17, 42, 7], [3, 1, 4, 1, 5, 9, 2, 6]]
+    want = _reference(tiny_params, prompts, 10)
+    mesh = make_mesh(tp=2, dp=4)
+    eng = GenerationEngine(TINY, shard_params(tiny_params, mesh), slots=4,
+                           max_seq=64, prompt_buckets=(8, 16), mesh=mesh,
+                           paged_blocks=25, paged_block_size=8)
+    try:
+        got = [eng.generate(p, max_new_tokens=10).tokens() for p in prompts]
+        assert got == want
+        st = eng.stats()
+        assert st["mesh"]["kv_shards"] == kv_head_shards(mesh,
+                                                         TINY.n_kv_heads)
+        assert st["paged"]["blocks"] == 24
+        # the pool settled per-shard lease entries
+        devs = {r["device"] for r in hbm.arbiter_stats()["leases"]
+                if r["subsystem"] == "engine" and "device" in r}
+        assert len(devs) == 8
+    finally:
+        eng.close()
+
+
+# -- sharded offload + warm device-loss recovery ------------------------------
+
+def test_mesh_offload_spill_restore_and_device_loss_recover_warm(
+        tiny_params):
+    """The tentpole acceptance path in one serving session: a mesh
+    engine with a 1-row T0 pool + T1 host tier (1) restores a spilled
+    prefix from T1 token-exact, then (2) survives a seeded mid-serving
+    DeviceLost — the mesh re-places, the SAME lease keys re-settle (no
+    double count), and the repeat prompt still serves WARM from the
+    host tier with identical tokens."""
+    mesh = make_mesh(tp=2, dp=2, fsdp=2)
+    eng = GenerationEngine(TINY, shard_params(tiny_params, mesh), slots=4,
+                           max_seq=64, prompt_buckets=(8, 16), mesh=mesh,
+                           prefix_cache_slots=1, prefix_store_min=8,
+                           kvcache=KVCacheOptions(host_mb=64))
+    try:
+        pA = list(range(1, 17))
+        pB = list(range(20, 36))
+        ref = eng.generate(pA + [1, 2], max_new_tokens=6).tokens()
+        eng.generate(pB + [3, 4], max_new_tokens=6).tokens()  # evict A -> T1
+        s1 = eng.generate(pA + [1, 2], max_new_tokens=6)
+        assert s1.tokens() == ref
+        assert s1.cache_tier == "t1"  # per-shard spill, assembled restore
+        gc.collect()  # the PR-10 lesson: cyclic engine garbage from
+        # NEIGHBOR tests must not drift the lease baseline mid-assert
+        in_use_before = hbm.arbiter_stats()["in_use_bytes"]
+
+        sched = chaos.ChaosSchedule(seed=7).on(
+            chaos.GENERATOR_STEP, error=chaos.DeviceLost, every=1, limit=1)
+        with chaos.scope(sched):
+            with pytest.raises(GenerationError):
+                eng.generate([9, 8, 7, 6], max_new_tokens=4).tokens()
+
+        s2 = eng.generate(pA + [1, 2], max_new_tokens=6)
+        assert s2.tokens() == ref          # post-recovery token-exact
+        assert s2.cache_tier == "t1"       # rewarmed WARM, not a prefill
+        st = eng.stats()
+        assert st["mesh"]["replacements"] == 1
+        assert eng.down is None
+        # leases RE-SETTLED, never double-counted: the same keys hold
+        # the same bytes after re-placement + realloc
+        assert hbm.arbiter_stats()["in_use_bytes"] == in_use_before
+    finally:
+        eng.close()
+
+
+# -- role refusals name the config rows --------------------------------------
+
+def test_wire_role_mesh_refusals_name_config_rows(tiny_params):
+    from gofr_tpu.pd import wire_role
+
+    mesh = make_mesh(tp=2, dp=4)
+    eng = TPUEngine(mesh=mesh)
+    eng.generator = GenerationEngine(TINY, shard_params(tiny_params, mesh),
+                                     slots=2, max_seq=32,
+                                     prompt_buckets=(8,), mesh=mesh)
+    cfg = MapConfig({"TPU_SHARDING": "tp=2,dp=4",
+                     "TPU_PD_PEER": "127.0.0.1:9"})
+    try:
+        for role in ("decode", "prefill"):
+            with pytest.raises(ValueError) as ei:
+                wire_role(eng, role, cfg)
+            msg = str(ei.value)
+            assert "TPU_SHARDING='tp=2,dp=4'" in msg
+            assert f"TPU_SERVING_ROLE={role}" in msg
+            assert "matrix" in msg or "fused" in msg
+    finally:
+        eng.close()

@@ -69,12 +69,14 @@ _ALLOC_ANY = {"device_put"}
 _ALLOC_SUBSTR = ("init_cache", "init_paged_cache", "init_lora")
 # the declared accounting API (gofr_tpu/tpu/hbm.py): account() records
 # post-hoc; alloc()/lease() are the arbiter's budgeted forms (lease +
-# reclaim-then-retry + account) — those two match only as QUALIFIED
-# hbm.alloc/hbm.lease (see _is_account_call): "alloc" is far too
+# reclaim-then-retry + account), and alloc_sharded() is the PER-SHARD
+# variant mesh engines use (per-device lease split + per-shard
+# account) — all three match only as QUALIFIED hbm.alloc/hbm.lease/
+# hbm.alloc_sharded (see _is_account_call): "alloc" is far too
 # generic a method name to bless bare (the paged engine's block
 # allocator is literally self._alloc.alloc)
 _ACCOUNT_FNS = {"account"}
-_ARBITER_FNS = {"alloc", "lease"}
+_ARBITER_FNS = {"alloc", "lease", "alloc_sharded"}
 
 
 def _is_account_call(func) -> bool:
