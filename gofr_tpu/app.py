@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Any, Callable, Iterable
 
 from .config import Config, EnvConfig
@@ -93,6 +94,20 @@ class App:
         # load balancers stop routing before the engine stops serving
         self._draining = False
         self._drain_retry_after: float | None = None
+
+        # Gateway serving role (gofr_tpu/gateway,
+        # docs/advanced-guide/gateway.md): TPU_SERVING_ROLE=gateway
+        # turns this App into the prefix-affinity front door over
+        # TPU_GATEWAY_REPLICAS — routes registered here so user routes
+        # may still be added beside them; a misconfigured replica list
+        # fails construction loudly (a silently engine-less,
+        # route-less "gateway" would be a misdeployed front door).
+        self._gateway = None
+        role = (self.config.get("TPU_SERVING_ROLE") or "").strip().lower()
+        if role == "gateway":
+            from .gateway import install_gateway
+
+            self._gateway = install_gateway(self)
 
         # Middleware chain in reference order (http/router.go:19-24):
         # Tracer -> Logging(+recovery) -> CORS -> Metrics [-> auth];
@@ -297,6 +312,11 @@ class App:
         if self.subscription_manager.subscriptions:
             self.subscription_manager.start()
 
+        if self._gateway is not None:
+            # health polling belongs to a RUNNING gateway: a merely
+            # constructed App must not spawn background replica I/O
+            self._gateway.table.start()
+
         self._running.set()
         if block:
             try:
@@ -325,17 +345,42 @@ class App:
             self.logger.info({"event": "drain started: readiness down",
                               "grace_s": grace_s})
             self.subscription_manager.stop()
+            # grace_s bounds the WHOLE drain, not each phase: an
+            # operator sizing a terminationGracePeriod against it must
+            # not be SIGKILLed because sequential waits stacked up
+            t_end = time.monotonic() + grace_s
             tpu = getattr(self.container, "tpu", None)
             gen = getattr(tpu, "generator", None)
             if gen is not None:
                 drained = gen.drain(grace_s)
                 self.logger.info({"event": "generation engine drained",
                                   "clean": drained})
+            # in-flight HTTP requests — streaming responses included —
+            # finish on their handler threads WITH the listeners still
+            # up (the drain gate above already rejects new ones): the
+            # second half of zero-loss rolling drain. The engine drain
+            # above covers generation streams; this covers every other
+            # handler — a gateway's replica relays run inside their
+            # handler thread, so they drain here too.
+            reg = self.container.observe.requests
+            while time.monotonic() < t_end and len(reg):
+                time.sleep(0.02)
+            self.logger.info({"event": "http in-flight drained",
+                              "remaining": len(reg)})
+            if self._gateway is not None:
+                self.logger.info({
+                    "event": "gateway drained",
+                    "clean": not any(r.inflight for r in
+                                     self._gateway.table.replicas)})
         for srv in (self._http_server, self._metrics_server):
             if srv is not None:
                 srv.stop()
         if self._grpc_server is not None:
             self._grpc_server.stop()
+        if self._gateway is not None:
+            # stop the health poller; replica clients close with the
+            # container's registered services below
+            self._gateway.close()
         self.subscription_manager.stop()
         provider = getattr(self, "_jwks_provider", None)
         if provider is not None:

@@ -30,6 +30,15 @@ Seams (grep for ``chaos.fire``):
   GENERATOR_STEP      tpu/generator._loop, before a decode tick — a raised
                       ``DeviceLost`` exercises the full loop-recovery path
                       (cache reallocation, waiter fail-fast)
+  GATEWAY_PICK        gateway/router.pick, before each replica-pick
+                      decision — injected latency widens the
+                      pick/drain race deterministically; an injected
+                      error fails THAT pick (typed 503 to the client,
+                      never a gateway crash)
+  GATEWAY_RELAY       gateway/relay, before EACH forward attempt —
+                      an injected error is treated as that attempt's
+                      transport loss, driving the pre-first-token
+                      failover path on attempt N exactly (``every=N``)
   GRPC_STREAM         grpcx/server._handle_stream, before dispatch —
                       transport-level latency/errors per RPC
   HBM_ALLOC           tpu/hbm lease points (lease/alloc/check) — an
@@ -60,8 +69,8 @@ import threading
 import time
 
 __all__ = [
-    "BATCHER_DISPATCH", "GENERATOR_CHUNK", "GENERATOR_PREFILL",
-    "GENERATOR_STEP",
+    "BATCHER_DISPATCH", "GATEWAY_PICK", "GATEWAY_RELAY",
+    "GENERATOR_CHUNK", "GENERATOR_PREFILL", "GENERATOR_STEP",
     "GRPC_STREAM", "HBM_ALLOC", "HTTP_REQUEST", "SERVICE_REQUEST", "SEAMS",
     "ChaosSchedule", "DeviceLost", "ResourceExhausted", "Rule",
     "active", "fire", "install", "scope", "slow_h2_preface", "slow_loris",
@@ -69,6 +78,8 @@ __all__ = [
 ]
 
 BATCHER_DISPATCH = "batcher.dispatch"
+GATEWAY_PICK = "gateway.pick"
+GATEWAY_RELAY = "gateway.relay"
 GENERATOR_CHUNK = "generator.chunk"
 GENERATOR_PREFILL = "generator.prefill"
 GENERATOR_STEP = "generator.step"
@@ -77,9 +88,9 @@ HBM_ALLOC = "hbm.alloc"
 HTTP_REQUEST = "http.request"
 SERVICE_REQUEST = "service.request"
 
-SEAMS = (BATCHER_DISPATCH, GENERATOR_CHUNK, GENERATOR_PREFILL,
-         GENERATOR_STEP, GRPC_STREAM, HBM_ALLOC, HTTP_REQUEST,
-         SERVICE_REQUEST)
+SEAMS = (BATCHER_DISPATCH, GATEWAY_PICK, GATEWAY_RELAY, GENERATOR_CHUNK,
+         GENERATOR_PREFILL, GENERATOR_STEP, GRPC_STREAM, HBM_ALLOC,
+         HTTP_REQUEST, SERVICE_REQUEST)
 
 
 class DeviceLost(RuntimeError):

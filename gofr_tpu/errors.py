@@ -21,6 +21,17 @@ def format_retry_after(seconds: float) -> str:
     return str(max(1, math.ceil(seconds)))
 
 
+def parse_retry_after(value: str | None) -> float | None:
+    """The one Retry-After reader (retry decorator, gateway relay and
+    replica table): delta-seconds to a non-negative float; ``None``
+    for absent, garbage, or the HTTP-date form (rare — callers fall
+    back to their own backoff/jitter)."""
+    try:
+        return max(0.0, float(value)) if value else None
+    except (TypeError, ValueError):
+        return None
+
+
 class GofrError(Exception):
     """Base class for all framework errors."""
 
@@ -90,16 +101,27 @@ class TooManyRequests(HTTPError):
     over its configured bound, so the request fails FAST instead of
     joining a line that would blow its own latency budget. Carries the
     gate's wait estimate as ``Retry-After`` (the responder emits
-    ``headers``; the gRPC transport maps 429 -> RESOURCE_EXHAUSTED)."""
+    ``headers``; the gRPC transport maps 429 -> RESOURCE_EXHAUSTED).
+
+    ``reason`` types the PRESSURE KIND on the wire as an
+    ``X-Shed-Reason`` header (``hbm`` for arbiter memory sheds; absent
+    means queue pressure) — a cross-process peer (the prefix-affinity
+    gateway) balances a memory-shedding replica differently from a
+    queue-deep one, and the header is the contract that distinction
+    survives the hop on (parsing error-message prose would not)."""
 
     status_code = 429
 
-    def __init__(self, message: str = "", retry_after: float | None = None):
+    def __init__(self, message: str = "", retry_after: float | None = None,
+                 reason: str | None = None):
         super().__init__(message or "too many requests")
         self.retry_after = retry_after
+        self.reason = reason
         self.headers: dict[str, str] = {}
         if retry_after is not None:
             self.headers["Retry-After"] = format_retry_after(retry_after)
+        if reason:
+            self.headers["X-Shed-Reason"] = reason
 
 
 class DeadlineExceeded(HTTPError):

@@ -1,0 +1,406 @@
+"""Prefix-affinity gateway: the gofr-native front door over N replicas.
+
+``TPU_SERVING_ROLE=gateway`` turns an App into the cluster's router
+(no engine, no jax compute — the replicas serve; this process makes
+them robust AS A UNIT):
+
+  - **replica table** (table.py): health polled through ``service/``
+    clients wrapped in the framework circuit breaker; typed sheds
+    (429 + ``X-Shed-Reason: hbm``) feed a decaying per-replica
+    memory-pressure score;
+  - **prefix-affinity routing** (router.py): consistent hash on the
+    request's first KV block chain hash (the same block hashing the
+    radix index and T2 fingerprint keys use), so multi-turn sessions
+    land where their T0/T1 cache is warm — spilling to least-pressure
+    on an unroutable or memory-held owner;
+  - **failover with a retry budget**: a replica lost BEFORE the first
+    token is retried transparently on another replica (nothing was
+    delivered — safe), bounded by a token-bucket budget so a dying
+    fleet can't amplify into a retry storm; loss AFTER the first
+    token terminates the stream with a typed 503 + Retry-After line
+    (the P/D relay contract, in ndjson);
+  - **zero-loss rolling drain**: the moment a replica's readiness
+    flips (its ``App.stop(grace_s)`` drain window), health polls and
+    inline drain-503s stop NEW routing there while in-flight relays
+    finish on the old process — a rolling restart of every replica
+    loses nothing.
+
+Chaos seams ``GATEWAY_PICK`` / ``GATEWAY_RELAY`` make pick starvation
+and attempt-N replica loss deterministically injectable
+(tests/test_gateway.py, tools/gateway_bench.py).
+
+Config (read by :func:`gateway_from_config`; full rows in
+docs/tpu/config-reference.md):
+
+  TPU_GATEWAY_REPLICAS           host:port,host:port,...   (required)
+  TPU_GATEWAY_PATH               forwarded route (default /generate)
+  TPU_GATEWAY_BLOCK              affinity block tokens (default 16 —
+                                 MUST match the replicas'
+                                 TPU_KVCACHE_BLOCK)
+  TPU_GATEWAY_LONG_PREFIX        cache-heavy threshold in tokens
+                                 (default 4x block)
+  TPU_GATEWAY_VNODES             ring virtual nodes/replica (64)
+  TPU_GATEWAY_RETRY_RATIO        failover tokens earned per request
+                                 (default 0.1 = retries <= 10% of
+                                 traffic in steady state)
+  TPU_GATEWAY_RETRY_BURST        failover token bucket cap (10)
+  TPU_GATEWAY_HEALTH_INTERVAL_S  health poll cadence (1.0)
+  TPU_GATEWAY_CONNECT_TIMEOUT_S  per-attempt connect budget (2.0)
+  TPU_GATEWAY_STREAM_TIMEOUT_S   mid-stream stall bound (120)
+  TPU_GATEWAY_BREAKER_THRESHOLD  health-client breaker threshold (3)
+  TPU_GATEWAY_BREAKER_INTERVAL_S breaker recovery probe interval (2.0)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from .. import chaos, tracing
+from ..errors import BadRequest, DeadlineExceeded, HTTPError, TooManyRequests
+from ..resilience import current_deadline
+from ..service.wrap import hop_context, set_header_default
+from .relay import (ReplicaResponse, TransportLoss, first_line, forward,
+                    relay_lines)
+from .router import (AffinityRouter, GatewayUnavailable, HashRing,
+                     RetryBudget)
+from .table import Replica, ReplicaTable
+
+__all__ = ["AffinityRouter", "Gateway", "GatewayUnavailable", "HashRing",
+           "Replica", "ReplicaTable", "RetryBudget", "ROLE_GATEWAY",
+           "gateway_from_config", "install_gateway", "parse_replicas"]
+
+ROLE_GATEWAY = "gateway"
+
+#: headers the gateway OWNS on the replica hop — hop-by-hop framing the
+#: relay rewrites itself, plus the context headers it re-derives from
+#: the ambient request (trace / SLO class / remaining deadline). Every
+#: OTHER client header passes through verbatim.
+_HOP_OWNED_HEADERS = frozenset({
+    "host", "connection", "content-length", "transfer-encoding",
+    "keep-alive", "te", "upgrade", "proxy-authorization",
+    "proxy-connection", "accept-encoding", "traceparent", "tracestate",
+    "x-request-timeout", "x-slo-class",
+})
+
+
+def parse_replicas(spec: str | None) -> list[str]:
+    """``TPU_GATEWAY_REPLICAS`` -> addresses. Accepts bare host:port
+    and http://host:port forms; a malformed entry fails startup loudly
+    (a front door with a typo'd replica list is a misdeployed
+    cluster, the failure class that must never serve silently)."""
+    out: list[str] = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if part.startswith("http://"):
+            part = part[len("http://"):].rstrip("/")
+        host, _, port = part.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"TPU_GATEWAY_REPLICAS entry {part!r}: "
+                             "expected host:port")
+        out.append(f"{host}:{int(port)}")
+    if not out:
+        raise ValueError("TPU_SERVING_ROLE=gateway requires "
+                         "TPU_GATEWAY_REPLICAS=host:port,...")
+    return out
+
+
+class Gateway:
+    """The router + failover engine behind the gateway App's routes."""
+
+    def __init__(self, table: ReplicaTable, *, path: str = "/generate",
+                 block: int = 16, long_prefix: int | None = None,
+                 vnodes: int = 64, retry_ratio: float = 0.1,
+                 retry_burst: float = 10.0,
+                 connect_timeout_s: float = 2.0,
+                 stream_timeout_s: float = 120.0,
+                 logger=None, metrics=None):
+        self.table = table
+        self.path = path
+        self.block = max(1, int(block))
+        self.router = AffinityRouter(table, block=self.block,
+                                     long_prefix=long_prefix,
+                                     vnodes=vnodes, metrics=metrics)
+        self.budget = RetryBudget(ratio=retry_ratio, burst=retry_burst)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.stream_timeout_s = float(stream_timeout_s)
+        self.logger = logger
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self.outcomes = {"ok": 0, "shed": 0, "failed": 0, "midstream": 0}
+        self.failovers = {"transport": 0, "drain": 0, "shed": 0}
+
+    # -- bookkeeping ----------------------------------------------------------
+    def _outcome(self, kind: str) -> None:
+        with self._lock:
+            self.outcomes[kind] += 1
+        if self.metrics is not None:
+            try:
+                self.metrics.increment_counter(
+                    "app_tpu_gateway_requests_total", outcome=kind)
+            except Exception:
+                pass
+
+    def _failover(self, reason: str, replica: Replica) -> None:
+        with self._lock:
+            self.failovers[reason] += 1
+        if self.metrics is not None:
+            try:
+                self.metrics.increment_counter(
+                    "app_tpu_gateway_failovers_total", reason=reason)
+            except Exception:
+                pass
+        if self.logger is not None:
+            self.logger.info({"event": "gateway failover",
+                              "reason": reason,
+                              "replica": replica.address})
+
+    def _exhausted(self) -> None:
+        if self.metrics is not None:
+            try:
+                self.metrics.increment_counter(
+                    "app_tpu_gateway_retry_exhausted_total")
+            except Exception:
+                pass
+
+    # -- the forwarded-request context ---------------------------------------
+    def _affinity_key(self, body: bytes) -> tuple[bytes | None, int]:
+        try:
+            payload = json.loads(body)
+            tokens = payload["tokens"]
+            plen = len(tokens)
+            adapter = int(payload.get("adapter", 0) or 0)
+        except Exception as e:  # noqa: BLE001 — client error, typed 400
+            raise BadRequest("gateway: body must be JSON with a "
+                             "'tokens' array") from e
+        if plen < self.block:
+            return None, plen  # sub-block: affinity-less by design
+        from ..tpu.kvcache import first_block_hash
+
+        try:
+            return first_block_hash(tokens, self.block, adapter), plen
+        except Exception as e:  # noqa: BLE001 — non-numeric tokens
+            raise BadRequest("gateway: 'tokens' must be an array of "
+                             "integers") from e
+
+    def _forward_headers(self, client_headers: dict) -> tuple[dict, float]:
+        """The replica-hop headers + the tightened read timeout. Client
+        headers pass through (an authenticated cluster stays usable
+        behind the front door: Authorization / API keys / custom
+        headers reach the replica) EXCEPT the ones the gateway owns on
+        this hop — connection framing, and the context headers it
+        re-derives: W3C trace (the gateway's span continues the
+        client's trace, so cross-process traces join through BOTH
+        hops), SLO class, and the remaining deadline (the budget
+        covers the WHOLE request, not each hop)."""
+        hdrs = {k: v for k, v in client_headers.items()
+                if k.lower() not in _HOP_OWNED_HEADERS}
+        set_header_default(hdrs, "Content-Type", "application/json")
+        span = tracing.current_span()
+        if span is not None:
+            hdrs["traceparent"] = span.traceparent()
+        timeout = hop_context(hdrs, self.stream_timeout_s)
+        return hdrs, timeout
+
+    # -- the serving path -----------------------------------------------------
+    def handle_generate(self, ctx):
+        """The gateway's /generate: pick -> forward -> commit at first
+        token -> relay; pre-commit failures fail over under the retry
+        budget; post-commit failures terminate typed."""
+        body = ctx.request.body or b""
+        key, plen = self._affinity_key(body)
+        headers, read_timeout = self._forward_headers(ctx.request.headers)
+        self.budget.deposit()
+        tried: set[int] = set()
+        last_shed: ReplicaResponse | None = None
+        n = len(self.table)
+        while len(tried) < n:
+            try:
+                replica, label = self.router.pick(key, plen,
+                                                  exclude=tried)
+            except GatewayUnavailable:
+                break
+            except Exception as e:  # noqa: BLE001 — injected at the seam
+                # a GATEWAY_PICK chaos error fails THIS decision typed,
+                # never the gateway process
+                self._outcome("shed")
+                raise GatewayUnavailable(
+                    f"gateway pick failed: {e!r}",
+                    retry_after=self.table.retry_after_hint()) from e
+            tried.add(replica.idx)
+            try:
+                chaos.fire(chaos.GATEWAY_RELAY)
+                kind, payload = forward(
+                    replica, self.path, body, headers,
+                    connect_timeout_s=self.connect_timeout_s,
+                    read_timeout_s=read_timeout)
+                if kind == "stream":
+                    try:
+                        first = first_line(payload)
+                    except BaseException:
+                        payload.close()
+                        raise
+            except Exception as e:  # noqa: BLE001 — attempt loss
+                dl = current_deadline()
+                if dl is not None and dl.remaining() <= 0:
+                    # the CALLER's budget expired mid-attempt (the
+                    # relay's read timeout tightens to it): a 504 on
+                    # THIS request, never evidence against the replica
+                    # — one impatient client must not mark a healthy
+                    # fleet down or drain the shared failover budget
+                    self._outcome("failed")
+                    raise DeadlineExceeded(
+                        "gateway: caller deadline expired during the "
+                        f"attempt on {replica.address}") from e
+                # TransportLoss or an injected GATEWAY_RELAY error:
+                # nothing delivered, the replica is suspect
+                replica.mark_down()
+                if len(tried) >= n or not self.budget.withdraw():
+                    self._exhausted()
+                    self._outcome("shed")
+                    raise GatewayUnavailable(
+                        f"replica {replica.address} lost before first "
+                        "token and the failover budget is spent",
+                        retry_after=self.table.retry_after_hint()) from e
+                self._failover("transport", replica)
+                continue
+            if kind == "stream":
+                # COMMIT: the first token is in hand — relay verbatim
+                replica.mark_up()
+                with replica._lock:
+                    replica.relayed += 1
+                self._outcome("ok")
+                ctx.stream(relay_lines(
+                    first, payload, replica,
+                    retry_after=replica.reconnect.retry_after(),
+                    on_loss=self._on_midstream_loss))
+                return None
+            r: ReplicaResponse = payload
+            if r.status == 429:
+                reason = r.header("X-Shed-Reason")
+                replica.note_shed(reason, r.retry_after())
+                last_shed = r
+                # a shed elsewhere may still serve — but a shedding
+                # FLEET must not be retried into a storm: budget-gated
+                if len(tried) < n:
+                    if self.budget.withdraw():
+                        self._failover("shed", replica)
+                        continue
+                    self._exhausted()
+                break
+            if r.status == 503:
+                # the drain_middleware readiness contract: re-pick,
+                # budget-FREE (a rolling deploy is an orderly event,
+                # not a failure storm)
+                replica.mark_drain(r.retry_after())
+                self._failover("drain", replica)
+                continue
+            # any other status: the gateway is transparent
+            self._outcome("failed")
+            err = HTTPError(r.message(), status_code=r.status)
+            err.headers = {k: v for k, v in r.headers.items()
+                           if k in ("retry-after", "x-shed-reason")}
+            raise err
+        if last_shed is not None:
+            # every failover avenue closed on a shed: relay it honestly
+            # (the replica's Retry-After + reason survive the hop)
+            self._outcome("shed")
+            raise TooManyRequests(
+                last_shed.message(),
+                retry_after=last_shed.retry_after() or 1.0,
+                reason=last_shed.header("X-Shed-Reason") or None)
+        self._outcome("shed")
+        raise GatewayUnavailable(
+            "no replica could serve (all down, draining, or tried)",
+            retry_after=self.table.retry_after_hint())
+
+    def _on_midstream_loss(self, replica: Replica, err) -> None:
+        replica.mark_down()
+        # NOT an _outcome: this request already counted "ok" at its
+        # commit point — requests_total stays one count per request
+        # ("by terminal outcome"); mid-relay terminations get their
+        # own counter (the stats dict keeps the key for /gateway/stats)
+        with self._lock:
+            self.outcomes["midstream"] += 1
+        if self.metrics is not None:
+            try:
+                self.metrics.increment_counter(
+                    "app_tpu_gateway_midstream_total")
+            except Exception:
+                pass
+        if self.logger is not None:
+            self.logger.warn({"event": "gateway replica lost mid-stream",
+                              "replica": replica.address,
+                              "error": repr(err)})
+
+    # -- surfaces -------------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            outcomes = dict(self.outcomes)
+            failovers = dict(self.failovers)
+        return {"path": self.path, "outcomes": outcomes,
+                "failovers": failovers, "budget": self.budget.stats(),
+                "router": self.router.stats(),
+                "table": self.table.stats()}
+
+    def close(self) -> None:
+        self.table.close()
+
+
+def gateway_from_config(cfg, *, logger=None, metrics=None,
+                        tracer=None) -> Gateway:
+    addresses = parse_replicas(cfg.get("TPU_GATEWAY_REPLICAS"))
+    table = ReplicaTable(
+        addresses, logger=logger, metrics=metrics, tracer=tracer,
+        poll_interval_s=cfg.get_float("TPU_GATEWAY_HEALTH_INTERVAL_S", 1.0),
+        breaker_threshold=cfg.get_int("TPU_GATEWAY_BREAKER_THRESHOLD", 3),
+        breaker_interval_s=cfg.get_float("TPU_GATEWAY_BREAKER_INTERVAL_S",
+                                         2.0),
+        health_timeout_s=cfg.get_float("TPU_GATEWAY_CONNECT_TIMEOUT_S",
+                                       2.0))
+    block = cfg.get_int("TPU_GATEWAY_BLOCK", 16)
+    long_prefix = cfg.get_int("TPU_GATEWAY_LONG_PREFIX", 0) or None
+    return Gateway(
+        table,
+        path=cfg.get_or_default("TPU_GATEWAY_PATH", "/generate"),
+        block=block, long_prefix=long_prefix,
+        vnodes=cfg.get_int("TPU_GATEWAY_VNODES", 64),
+        retry_ratio=cfg.get_float("TPU_GATEWAY_RETRY_RATIO", 0.1),
+        retry_burst=cfg.get_float("TPU_GATEWAY_RETRY_BURST", 10.0),
+        connect_timeout_s=cfg.get_float("TPU_GATEWAY_CONNECT_TIMEOUT_S",
+                                        2.0),
+        stream_timeout_s=cfg.get_float("TPU_GATEWAY_STREAM_TIMEOUT_S",
+                                       120.0),
+        logger=logger, metrics=metrics)
+
+
+def install_gateway(app) -> Gateway:
+    """Wire the gateway role into an App: build from config, register
+    the forwarded route + the stats page, register each replica's
+    health client in the container (the aggregated
+    ``/.well-known/health`` lists them like any other dependency),
+    and start the health poller when the app runs."""
+    gw = gateway_from_config(app.config, logger=app.logger,
+                             metrics=app.container.metrics,
+                             tracer=app.container.tracer)
+    for r in gw.table.replicas:
+        app.container.register_service(f"gateway-replica-{r.idx}",
+                                       r.client)
+
+    def generate(ctx):
+        return gw.handle_generate(ctx)
+
+    def stats(ctx):
+        return gw.stats()
+
+    app.post(gw.path, generate)
+    app.get("/gateway/stats", stats)
+    # the health poller starts in App.run (a constructed-but-never-run
+    # gateway App must not poll replicas in the background)
+    if app.logger is not None:
+        app.logger.info({
+            "event": "gateway role wired", "path": gw.path,
+            "replicas": [r.address for r in gw.table.replicas]})
+    return gw

@@ -493,6 +493,37 @@ def register_framework_metrics(m: Manager) -> None:
                   "decode-peer connection losses that shed in-flight "
                   "relayed streams (503 + Retry-After)")
 
+    # prefix-affinity gateway (gofr_tpu/gateway,
+    # docs/advanced-guide/gateway.md): the front door over N serving
+    # replicas — routing decisions, failover spend, and the replica
+    # table's aggregate view
+    m.new_counter("app_tpu_gateway_requests_total",
+                  "requests through the gateway, by terminal outcome "
+                  "(ok / shed / failed)")
+    m.new_counter("app_tpu_gateway_affinity_total",
+                  "routing decisions, by result (hit = routed to the "
+                  "prefix-affinity owner, spill = owner unroutable or "
+                  "pressure-biased away, short = prompt below one "
+                  "affinity block, balanced by pressure)")
+    m.new_counter("app_tpu_gateway_failovers_total",
+                  "pre-first-token retries on another replica, by "
+                  "reason (transport / drain / shed)")
+    m.new_counter("app_tpu_gateway_midstream_total",
+                  "committed (already-200) relays terminated by a "
+                  "mid-stream replica loss with the typed error line "
+                  "— these requests also counted ok at commit, so "
+                  "this is a loss-rate numerator, not an outcome")
+    m.new_counter("app_tpu_gateway_retry_exhausted_total",
+                  "requests answered a typed 503 because the failover "
+                  "retry budget was empty (storm brake) or every "
+                  "replica was tried")
+    m.new_gauge("app_tpu_gateway_replicas",
+                "replica table population, by state (ready / draining "
+                "/ down)")
+    m.new_gauge("app_tpu_gateway_pressure",
+                "per-replica memory-pressure score (decaying; fed by "
+                "429 X-Shed-Reason: hbm responses)")
+
     # tracing export health (tracing.ZipkinExporter): spans dropped
     # because the pending buffer hit its bound while the collector was
     # down/stalled — fail-open export must cost bounded memory, and

@@ -29,6 +29,7 @@ import time
 
 from ..errors import GofrError
 from ..resilience import current_deadline, current_slo_class
+from ..service.reconnect import ReconnectBackoff
 from ..tpu.kvcache.quant import concat_blocks, encode_block
 from ..wire import PushStream
 from . import protocol as p
@@ -181,8 +182,9 @@ class PDPrefill:
         self._conn_lock = threading.Lock()
         self._streams: dict[int, RelayStream] = {}
         self._streams_lock = threading.Lock()
-        self._down_until = 0.0
-        self._backoff = _BACKOFF_S
+        # one reconnect convention (service/reconnect.py): shared by
+        # the connect path here and the reader-thread loss path
+        self._reconnect = ReconnectBackoff(_BACKOFF_S, _BACKOFF_CAP_S)
         self._closed = False
         self.relayed = 0
         self.reconnects = 0
@@ -199,11 +201,11 @@ class PDPrefill:
             return conn
         if self._closed:
             raise p.DecodePeerUnavailable("pd prefill coordinator closed")
-        now = time.monotonic()
-        if now < self._down_until:
+        blocked = self._reconnect.blocked()
+        if blocked > 0:
             raise p.DecodePeerUnavailable(
                 f"decode peer {self.peer[0]}:{self.peer[1]} in reconnect "
-                "backoff", retry_after=self._down_until - now)
+                "backoff", retry_after=blocked)
         with self._conn_lock:
             conn = self._conn
             if conn is not None and not conn.closed:
@@ -236,18 +238,15 @@ class PDPrefill:
                 # surface it and back off long. Close what we opened:
                 # every failed attempt must cost zero fds.
                 self._close_handshake(conn, sock)
-                self._down_until = time.monotonic() + _BACKOFF_CAP_S
+                self._reconnect.hold()
                 raise
             except Exception as e:  # noqa: BLE001 — down peer = shed
                 self._close_handshake(conn, sock)
-                self._down_until = time.monotonic() + self._backoff
-                retry = self._backoff
-                self._backoff = min(self._backoff * 2, _BACKOFF_CAP_S)
+                retry = self._reconnect.failure()
                 raise p.DecodePeerUnavailable(
                     f"decode peer {self.peer[0]}:{self.peer[1]} "
                     f"unreachable: {e!r}", retry_after=retry) from e
-            self._backoff = _BACKOFF_S
-            self._down_until = 0.0
+            self._reconnect.success()
             self._conn = conn
             self.reconnects += 1
             threading.Thread(target=self._read_loop, args=(conn,),
@@ -306,7 +305,7 @@ class PDPrefill:
         with self._conn_lock:
             if self._conn is conn:
                 self._conn = None
-                self._down_until = time.monotonic() + self._backoff
+                self._reconnect.failure()
         conn.close()
         with self._streams_lock:
             orphans = list(self._streams.items())
@@ -317,7 +316,7 @@ class PDPrefill:
                 self.logger.warn({"event": "pd decode peer lost",
                                   "in_flight": len(orphans)})
         err = p.DecodePeerUnavailable(
-            "decode peer lost mid-stream", retry_after=self._backoff)
+            "decode peer lost mid-stream", retry_after=self._reconnect.retry_after())
         for _, rs in orphans:
             rs.failed = str(err)
             rs._done = True
@@ -398,7 +397,7 @@ class PDPrefill:
             self._cancel(req_id)
             raise p.DecodePeerUnavailable(
                 f"decode peer lost during submit: {e!r}",
-                retry_after=self._backoff) from e
+                retry_after=self._reconnect.retry_after()) from e
         except BaseException:
             self._cancel(req_id)
             raise
@@ -448,7 +447,7 @@ class PDPrefill:
             if isinstance(err, (EOFError, OSError)):
                 err = p.DecodePeerUnavailable(
                     "decode peer lost during kv ship",
-                    retry_after=self._backoff)
+                    retry_after=self._reconnect.retry_after())
             self._cancel(req_id)
             if not rs._done:
                 rs.failed = str(err)

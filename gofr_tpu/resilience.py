@@ -413,7 +413,8 @@ class AdmissionGate:
                           trace_id=trace_id)
         return TooManyRequests(
             f"{self.name or 'admission'}: device memory exhausted — "
-            f"shed ({slo_class})", retry_after=max(0.05, retry_after))
+            f"shed ({slo_class})", retry_after=max(0.05, retry_after),
+            reason="hbm")
 
     def cap_tokens(self, max_new_tokens: int,
                    slo_class: str = SLO_LATENCY) -> int:

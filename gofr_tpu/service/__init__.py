@@ -17,6 +17,7 @@ CircuitBreakerOption(...), BasicAuthOption(...), HealthOption(...))``.
 
 from .client import HTTPService, Response, new_http_service
 from .circuit_breaker import CircuitBreaker, CircuitBreakerOption, CircuitOpenError
+from .reconnect import ReconnectBackoff
 from .retry import Retry, RetryOption
 from .auth import APIKeyAuthOption, BasicAuthOption, OAuthOption
 from .health import DEFAULT_HEALTH_ENDPOINT, HealthOption
@@ -28,6 +29,7 @@ __all__ = [
     "CircuitBreaker",
     "CircuitBreakerOption",
     "CircuitOpenError",
+    "ReconnectBackoff",
     "Retry",
     "RetryOption",
     "BasicAuthOption",

@@ -23,6 +23,14 @@ CLOSED, OPEN = 0, 1
 __all__ = ["CircuitBreaker", "CircuitBreakerOption", "CircuitOpenError"]
 
 
+def _orderly_drain(resp) -> bool:
+    """A 503 carrying Retry-After is the drain contract's readiness
+    answer — a live peer asking for patience, not a dead one."""
+    return (getattr(resp, "status_code", 0) == 503
+            and hasattr(resp, "header")
+            and bool(resp.header("Retry-After")))
+
+
 class CircuitBreaker(ServiceWrapper):
     def __init__(self, inner, threshold: int = 5, interval: float = 10.0,
                  start_background_probe: bool = True):
@@ -93,9 +101,18 @@ class CircuitBreaker(ServiceWrapper):
         except Exception:
             self._record_failure()
             raise
-        if getattr(resp, "status_code", 0) >= 500:
+        status = getattr(resp, "status_code", 0)
+        if status >= 500 and not _orderly_drain(resp):
             self._record_failure()
         else:
+            # 2xx-4xx — or a 503 WITH Retry-After: the framework's
+            # drain answer (App.stop readiness flip, resilience.md).
+            # The peer is alive and told us when to come back; the
+            # breaker's job is failing fast against a DEAD peer, so an
+            # orderly drain longer than threshold x poll-interval must
+            # not reclassify it as down (the gateway's replica table
+            # polls through this breaker every second of a rolling
+            # restart)
             with self._lock:
                 if self._state == OPEN:
                     self._close_circuit()

@@ -20,7 +20,7 @@ from typing import Any, Mapping
 
 from .. import chaos
 from ..datasource import Health, STATUS_DOWN, STATUS_UP
-from .wrap import VerbSurface
+from .wrap import VerbSurface, hop_context
 
 
 class Response:
@@ -89,13 +89,18 @@ class HTTPService(VerbSurface):
             span = self.tracer.start_span(f"http-service {method} {path}")
             hdrs.setdefault("traceparent", span.traceparent())
 
+        # ambient request context crosses the hop (the gateway-forward
+        # contract, docs/advanced-guide/gateway.md): one convention,
+        # service/wrap.hop_context
+        timeout = hop_context(hdrs, self.timeout)
+
         start = time.perf_counter()
         status = 0
         try:
             chaos.fire(chaos.SERVICE_REQUEST)
             req = urllib.request.Request(url, data=data, method=method, headers=hdrs)
             try:
-                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
                     status = resp.status
                     out = Response(resp.status, resp.read(), dict(resp.headers))
             except urllib.error.HTTPError as e:

@@ -42,7 +42,7 @@ from __future__ import annotations
 import random
 import time
 
-from ..errors import CircuitOpenError
+from ..errors import CircuitOpenError, parse_retry_after
 from ..resilience import current_deadline
 from .wrap import ServiceWrapper
 
@@ -91,7 +91,13 @@ class Retry(ServiceWrapper):
     def _pause(self, delay: float) -> bool:
         """Sleep before the next attempt — unless it would outlive the
         caller's ambient deadline (then stop retrying: the caller will
-        time out before the retry could answer)."""
+        time out before the retry could answer). The deadline caps the
+        CUMULATIVE retry loop, not just this sleep: the ambient
+        ``Deadline`` is absolute, so each pass re-reads the shrinking
+        budget (attempt time included — the transport tightens its own
+        socket timeout to the same budget, client.py ``_do``), and the
+        loop can never outlive the caller by more than one bounded
+        attempt."""
         dl = current_deadline()
         if dl is not None and dl.remaining() <= delay:
             return False
@@ -102,10 +108,7 @@ class Retry(ServiceWrapper):
     @staticmethod
     def _retry_after(resp) -> float | None:
         val = resp.header("Retry-After") if hasattr(resp, "header") else ""
-        try:
-            return max(0.0, float(val)) if val else None
-        except ValueError:
-            return None  # HTTP-date form: rare, fall back to jitter
+        return parse_retry_after(val)
 
     def _do(self, method, path, params, body, headers):
         last_exc: BaseException | None = None

@@ -20,6 +20,30 @@ def set_header_default(headers: dict, key: str, value: str) -> None:
     headers[key] = value
 
 
+def hop_context(headers: dict, timeout: float | None = None) -> float | None:
+    """Apply the ambient request context to an outbound hop, in ONE
+    place for every cross-process client (HTTPService and the gateway
+    relay): the SLO class rides ``X-SLO-Class`` so per-class accounting
+    survives the hop (only a non-default class is worth a header byte —
+    absent means latency on both sides), and the remaining deadline
+    rides ``X-Request-Timeout`` so the peer's budget is the CALLER's
+    remaining budget, not a fresh one. Returns ``timeout`` tightened to
+    that same remaining budget — with the retry decorator's pause
+    check this is what keeps a retry loop from outliving the caller."""
+    from ..resilience import SLO_LATENCY, current_deadline, current_slo_class
+
+    slo = current_slo_class()
+    if slo != SLO_LATENCY:
+        set_header_default(headers, "X-SLO-Class", slo)
+    dl = current_deadline()
+    if dl is not None:
+        set_header_default(headers, "X-Request-Timeout",
+                           f"{max(dl.remaining(), 0.001):.6f}s")
+        if timeout is not None:
+            timeout = max(0.05, dl.budget(timeout))
+    return timeout
+
+
 class VerbSurface:
     """The 10-verb client surface, all flowing through one ``_do`` choke
     point. Shared by the innermost HTTPService and every decorator so the

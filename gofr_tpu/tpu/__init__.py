@@ -169,7 +169,12 @@ Config keys (reference config style, pkg/gofr/config/config.go:3):
                       "decode" (this worker listens for shipped KV,
                       owns the slot lattice and the token stream).
                       Each pool draws its own TPU_HBM_BUDGET_MB with
-                      its own reclaim policy
+                      its own reclaim policy. "gateway" is the APP
+                      mode that fronts N replicas with prefix-affinity
+                      routing + failover (gofr_tpu/gateway,
+                      docs/advanced-guide/gateway.md, TPU_GATEWAY_*
+                      rows in config-reference) — it holds no model,
+                      so setting it alongside TPU_MODEL fails startup
   TPU_PD_LISTEN       decode role: host:port the KV-ingest listener
                       binds (default 127.0.0.1:9400)
   TPU_PD_PEER         prefill role: the decode worker's TPU_PD_LISTEN
@@ -243,6 +248,17 @@ def new_engine_from_config(cfg, logger=None, metrics=None,
                            observe=None) -> TPUEngine:
     from ..models import BERT_CONFIGS, LLAMA_CONFIGS, VIT_CONFIGS
 
+    if (cfg.get("TPU_SERVING_ROLE") or "").strip().lower() == "gateway":
+        # the gateway role (gofr_tpu/gateway) is an APP mode, not an
+        # engine mode: it fronts replicas and holds no model. A config
+        # naming both is two deployments in one file — refuse BEFORE
+        # building anything rather than guess which one was meant.
+        raise ValueError(
+            "TPU_SERVING_ROLE=gateway builds no engine (the gateway "
+            "routes to TPU_GATEWAY_REPLICAS); unset "
+            f"TPU_MODEL={cfg.get('TPU_MODEL')!r} on the gateway "
+            "process, or drop the gateway role on this serving "
+            "replica (docs/advanced-guide/gateway.md)")
     name = (cfg.get("TPU_MODEL") or "tiny").strip()
     mesh = parse_mesh(cfg.get("TPU_SHARDING"))
     max_delay = cfg.get_float("TPU_MAX_BATCH_DELAY", 0.004)

@@ -139,7 +139,7 @@ class HBMExhausted(TooManyRequests):
         super().__init__(
             f"hbm arbiter: cannot cover {subsystem!r} lease of "
             f"{int(nbytes)} bytes after reclaim{detail}",
-            retry_after=retry_after)
+            retry_after=retry_after, reason="hbm")
         self.subsystem = subsystem
         self.nbytes = int(nbytes)
 

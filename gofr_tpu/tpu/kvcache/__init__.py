@@ -34,7 +34,7 @@ from .host import HostTier
 from .manager import CacheManager, Match, clamp_restore_len
 from .quant import (HostKV, KVLayout, ShardedHostKV, decode_block,
                     dense_hostkv, encode_block)
-from .radix import Entry, RadixIndex, chain_hashes
+from .radix import Entry, RadixIndex, chain_hashes, first_block_hash
 from .redis_tier import RedisTier
 
 __all__ = [
@@ -42,7 +42,7 @@ __all__ = [
     "HBMTier", "HostTier", "RedisTier",
     "HostKV", "KVLayout", "ShardedHostKV", "dense_hostkv",
     "encode_block", "decode_block",
-    "Entry", "RadixIndex", "chain_hashes",
+    "Entry", "RadixIndex", "chain_hashes", "first_block_hash",
     "KVCacheOptions", "options_from_config", "model_fingerprint",
 ]
 

@@ -56,6 +56,30 @@ def chain_hashes(tokens: np.ndarray, block: int, adapter: int = 0,
         yield h
 
 
+def first_block_hash(tokens, block: int = 16, adapter: int = 0) -> bytes:
+    """The chain hash of the FIRST full block of ``tokens`` — the
+    prefix-affinity routing key (gofr_tpu/gateway/): every multi-turn
+    continuation of a conversation shares its first ``block`` tokens,
+    so hashing exactly one block gives a key that is STABLE across
+    turns while still spreading distinct sessions. Same salt, same
+    chaining, same adapter separation as the radix index and the T2
+    fingerprint keys — the gateway's notion of "where this prefix is
+    warm" can never drift from the cache's notion of identity.
+
+    Prompts shorter than one block (no full block to chain-hash) fall
+    back to hashing the whole short prompt under the same salt: still
+    deterministic, still adapter-separated, just turn-UNSTABLE — the
+    router treats those as affinity-less and balances them by
+    pressure, which is the right call for prompts too short to be
+    worth cache affinity anyway."""
+    tokens = np.asarray(tokens, np.int32).reshape(-1)
+    for h in chain_hashes(tokens, block, adapter, limit=1):
+        return h
+    seed = hashlib.sha256(CHAIN_SALT + str(int(adapter)).encode()).digest()
+    return hashlib.sha256(
+        seed + np.ascontiguousarray(tokens).tobytes()).digest()
+
+
 def lcp(a: np.ndarray, b: np.ndarray) -> int:
     """Length of the longest common prefix of two int token arrays."""
     n = min(len(a), len(b))

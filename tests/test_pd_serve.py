@@ -472,7 +472,7 @@ def test_peer_kill_sheds_typed_and_recovers(params, fingerprint, refs):
         srv2 = KVIngestServer(dec2, fingerprint, "127.0.0.1", 0)
         try:
             pd.peer = ("127.0.0.1", srv2.port)
-            pd._down_until = 0.0
+            pd._reconnect.reset()
             out = pd.generate(_prompt(40), max_new_tokens=MAX_NEW).tokens()
             assert out == refs[40]
             assert pd.stats()["peer_losses"] == 1
