@@ -244,7 +244,15 @@ class App:
         def health(req: Request, w: ResponseWriter) -> None:
             payload = self.container.health()
             w.set_header("Content-Type", "application/json")
-            w.write(json.dumps({"data": payload}, default=str).encode())
+            # the "obs" sibling makes every health poll a fleet clock
+            # carrier (observe/clock.py): the send-side wall stamp is
+            # the NTP sample's t1==t2, and metrics_port tells the
+            # poller where this process's /debug surface lives
+            w.write(json.dumps(
+                {"data": payload,
+                 "obs": {"wall_s": time.time(),
+                         "metrics_port": self.metrics_port}},
+                default=str).encode())
 
         def alive(req: Request, w: ResponseWriter) -> None:
             w.set_header("Content-Type", "application/json")
@@ -294,6 +302,12 @@ class App:
         self._metrics_server = HTTPServer(self._metrics_router(), self.metrics_port, self.logger)
         self._metrics_server.start()
         self.metrics_port = self._metrics_server.port
+        # a decode worker's ingest listener advertises this process's
+        # debug surface in HELLO_OK, so prefill peers learn where to
+        # pull /debug/timeline + /debug/events for the fleet merge
+        pd_ingest = getattr(self.container.tpu, "pd_ingest", None)
+        if pd_ingest is not None:
+            pd_ingest.debug_port = self.metrics_port
 
         if self._http_registered:
             self._install_default_routes()

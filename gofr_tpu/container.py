@@ -39,12 +39,14 @@ class Container:
         # timeline (observe/): always on, shared by HTTP middleware and
         # the TPU datasource, rendered by the /debug pages on the
         # metrics server.
-        from .observe import Observe, timeline_from_config
+        from .observe import ClockRegistry, Observe, timeline_from_config
 
         self.observe = Observe(
             metrics=self.metrics, tracer=self.tracer,
             max_events=self.config.get_int("DEBUG_EVENT_BUFFER", 2048),
-            timeline=timeline_from_config(self.config))
+            timeline=timeline_from_config(self.config),
+            clock=ClockRegistry(
+                window=self.config.get_int("TPU_OBS_CLOCK_WINDOW", 64)))
 
         # Datasources — wired from config, graceful degradation throughout
         self.redis = None

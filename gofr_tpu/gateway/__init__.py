@@ -55,10 +55,11 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 
 from .. import chaos, tracing
 from ..errors import BadRequest, DeadlineExceeded, HTTPError, TooManyRequests
-from ..resilience import current_deadline
+from ..resilience import current_deadline, current_slo_class
 from ..service.wrap import hop_context, set_header_default
 from .relay import (ReplicaResponse, TransportLoss, first_line, forward,
                     relay_lines)
@@ -80,7 +81,7 @@ _HOP_OWNED_HEADERS = frozenset({
     "host", "connection", "content-length", "transfer-encoding",
     "keep-alive", "te", "upgrade", "proxy-authorization",
     "proxy-connection", "accept-encoding", "traceparent", "tracestate",
-    "x-request-timeout", "x-slo-class",
+    "x-request-timeout", "x-slo-class", "x-obs-hop",
 })
 
 
@@ -116,7 +117,7 @@ class Gateway:
                  retry_burst: float = 10.0,
                  connect_timeout_s: float = 2.0,
                  stream_timeout_s: float = 120.0,
-                 logger=None, metrics=None):
+                 logger=None, metrics=None, observe=None):
         self.table = table
         self.path = path
         self.block = max(1, int(block))
@@ -128,6 +129,7 @@ class Gateway:
         self.stream_timeout_s = float(stream_timeout_s)
         self.logger = logger
         self.metrics = metrics
+        self.observe = observe  # wide-event recorder + clock registry
         self._lock = threading.Lock()
         self.outcomes = {"ok": 0, "shed": 0, "failed": 0, "midstream": 0}
         self.failovers = {"transport": 0, "drain": 0, "shed": 0}
@@ -208,15 +210,88 @@ class Gateway:
     def handle_generate(self, ctx):
         """The gateway's /generate: pick -> forward -> commit at first
         token -> relay; pre-commit failures fail over under the retry
-        budget; post-commit failures terminate typed."""
+        budget; post-commit failures terminate typed.
+
+        Every terminal emits the gateway's own wide ``request`` event —
+        a request shed HERE never reached an engine, so without this
+        record it would vanish from every wide-event surface."""
+        st = {"t0": time.monotonic(), "submit_wall": time.time(),
+              "bd": {}, "replica": None, "route": None, "tried": 0,
+              "shed_reason": None}
+        try:
+            out = self._relay_attempts(ctx, st)
+        except TooManyRequests as e:
+            self._wide_request("shed", st, error=repr(e))
+            raise
+        except GatewayUnavailable as e:
+            self._wide_request("shed", st, error=repr(e))
+            raise
+        except BaseException as e:
+            self._wide_request("failed", st, error=repr(e))
+            raise
+        self._wide_request("ok", st)
+        return out
+
+    def _wide_request(self, outcome: str, st: dict,
+                      error: str | None = None) -> None:
+        """The gateway's terminal wide event: same skeleton as the
+        engine's (event/outcome/trace_id/slo_class lead), with the
+        routing story — picked replica, affinity label, failover spend
+        — and the gateway's critical-path segments (pick / connect /
+        ttfb). Telemetry only: never raises into the relay."""
+        try:
+            now = time.monotonic()
+            span = tracing.current_span()
+            trace_id = span.trace_id if span is not None else ""
+            wide: dict = {"event": "request", "outcome": outcome,
+                          "trace_id": trace_id,
+                          "slo_class": current_slo_class(),
+                          "gateway": True, "replica": st["replica"],
+                          "route": st["route"], "tried": st["tried"],
+                          "failovers": max(0, st["tried"] - 1),
+                          "duration_s": round(now - st["t0"], 6),
+                          "submit_wall_s": round(st["submit_wall"], 6)}
+            bd = {k: round(v, 6) for k, v in st["bd"].items()}
+            if bd:
+                wide["breakdown"] = bd
+            if st.get("shed_reason"):
+                wide["shed_reason"] = st["shed_reason"]
+            if error is not None:
+                wide["error"] = error
+            if self.metrics is not None and bd:
+                for i, (seg, v) in enumerate(sorted(bd.items())):
+                    try:
+                        self.metrics.record_histogram(
+                            "app_tpu_request_segment_duration", v,
+                            exemplar=((trace_id or None) if i == 0
+                                      else None),
+                            segment=seg[:-2], program="gateway")
+                    except Exception:
+                        pass
+            if self.observe is not None:
+                self.observe.recorder.record(
+                    "request", trace_id=trace_id,
+                    **{k: v for k, v in wide.items()
+                       if k not in ("event", "trace_id")})
+            if self.logger is not None:
+                self.logger.wide(wide)
+        except Exception:
+            pass  # telemetry must never take the relay down
+
+    def _relay_attempts(self, ctx, st: dict):
         body = ctx.request.body or b""
         key, plen = self._affinity_key(body)
         headers, read_timeout = self._forward_headers(ctx.request.headers)
+        # hop stamp: when THIS hop forwarded, on the gateway's wall
+        # clock — /debug/request places the gateway->replica gap with it
+        headers["X-Obs-Hop"] = repr(time.time())
+        bd = st["bd"]
         self.budget.deposit()
         tried: set[int] = set()
         last_shed: ReplicaResponse | None = None
         n = len(self.table)
         while len(tried) < n:
+            t_pick = time.monotonic()
             try:
                 replica, label = self.router.pick(key, plen,
                                                   exclude=tried)
@@ -229,19 +304,31 @@ class Gateway:
                 raise GatewayUnavailable(
                     f"gateway pick failed: {e!r}",
                     retry_after=self.table.retry_after_hint()) from e
+            finally:
+                bd["pick_s"] = bd.get("pick_s", 0.0) \
+                    + (time.monotonic() - t_pick)
             tried.add(replica.idx)
+            st["tried"] = len(tried)
+            st["replica"], st["route"] = replica.address, label
             try:
                 chaos.fire(chaos.GATEWAY_RELAY)
+                t_conn = time.monotonic()
                 kind, payload = forward(
                     replica, self.path, body, headers,
                     connect_timeout_s=self.connect_timeout_s,
                     read_timeout_s=read_timeout)
+                bd["connect_s"] = bd.get("connect_s", 0.0) \
+                    + (time.monotonic() - t_conn)
                 if kind == "stream":
+                    t_ttfb = time.monotonic()
                     try:
                         first = first_line(payload)
                     except BaseException:
                         payload.close()
                         raise
+                    finally:
+                        bd["ttfb_s"] = bd.get("ttfb_s", 0.0) \
+                            + (time.monotonic() - t_ttfb)
             except Exception as e:  # noqa: BLE001 — attempt loss
                 dl = current_deadline()
                 if dl is not None and dl.remaining() <= 0:
@@ -281,6 +368,7 @@ class Gateway:
             if r.status == 429:
                 reason = r.header("X-Shed-Reason")
                 replica.note_shed(reason, r.retry_after())
+                st["shed_reason"] = reason or "queue"
                 last_shed = r
                 # a shed elsewhere may still serve — but a shedding
                 # FLEET must not be retried into a storm: budget-gated
@@ -350,10 +438,11 @@ class Gateway:
 
 
 def gateway_from_config(cfg, *, logger=None, metrics=None,
-                        tracer=None) -> Gateway:
+                        tracer=None, observe=None) -> Gateway:
     addresses = parse_replicas(cfg.get("TPU_GATEWAY_REPLICAS"))
     table = ReplicaTable(
         addresses, logger=logger, metrics=metrics, tracer=tracer,
+        observe=observe,
         poll_interval_s=cfg.get_float("TPU_GATEWAY_HEALTH_INTERVAL_S", 1.0),
         breaker_threshold=cfg.get_int("TPU_GATEWAY_BREAKER_THRESHOLD", 3),
         breaker_interval_s=cfg.get_float("TPU_GATEWAY_BREAKER_INTERVAL_S",
@@ -373,7 +462,7 @@ def gateway_from_config(cfg, *, logger=None, metrics=None,
                                         2.0),
         stream_timeout_s=cfg.get_float("TPU_GATEWAY_STREAM_TIMEOUT_S",
                                        120.0),
-        logger=logger, metrics=metrics)
+        logger=logger, metrics=metrics, observe=observe)
 
 
 def install_gateway(app) -> Gateway:
@@ -384,7 +473,8 @@ def install_gateway(app) -> Gateway:
     and start the health poller when the app runs."""
     gw = gateway_from_config(app.config, logger=app.logger,
                              metrics=app.container.metrics,
-                             tracer=app.container.tracer)
+                             tracer=app.container.tracer,
+                             observe=app.container.observe)
     for r in gw.table.replicas:
         app.container.register_service(f"gateway-replica-{r.idx}",
                                        r.client)

@@ -203,7 +203,7 @@ class ReplicaTable:
     """
 
     def __init__(self, addresses: list[str], *, logger=None, metrics=None,
-                 tracer=None, poll_interval_s: float = 1.0,
+                 tracer=None, observe=None, poll_interval_s: float = 1.0,
                  breaker_threshold: int = 3,
                  breaker_interval_s: float = 2.0,
                  health_timeout_s: float = 2.0):
@@ -212,6 +212,7 @@ class ReplicaTable:
                              "(TPU_GATEWAY_REPLICAS=host:port,...)")
         self.logger = logger
         self.metrics = metrics
+        self.observe = observe  # clock registry host (fleet alignment)
         self.poll_interval_s = float(poll_interval_s)
         self.replicas: list[Replica] = []
         for i, addr in enumerate(addresses):
@@ -256,6 +257,7 @@ class ReplicaTable:
 
     def _poll_replica(self, r: Replica) -> None:
         was = r.state()
+        t0 = time.time()
         try:
             resp = r.client.get("/.well-known/health")
         except Exception:  # noqa: BLE001 — open breaker / transport loss
@@ -263,8 +265,10 @@ class ReplicaTable:
                 r.mark_down()
             self._log_transition(r, was)
             return
+        t3 = time.time()
         if resp.ok:
             r.mark_up()
+            self._note_replica_clock(r, t0, t3, resp)
         elif resp.status_code == 503:
             ra = parse_retry_after(resp.header("Retry-After"))
             r.mark_drain(ra)
@@ -272,6 +276,29 @@ class ReplicaTable:
             if r.state() != STATE_DOWN:
                 r.mark_down()
         self._log_transition(r, was)
+
+    def _note_replica_clock(self, r: Replica, t0: float, t3: float,
+                            resp) -> None:
+        """The health poll as a free NTP carrier: the replica's health
+        body stamps its send wall time (``obs.wall_s`` — t1 == t2, the
+        handler stamps once) and advertises its metrics/debug port, so
+        every poll refreshes the offset estimate and the peer's debug
+        URL without a single extra connection."""
+        clock = getattr(self.observe, "clock", None)
+        if clock is None:
+            return
+        try:
+            obs = (resp.json() or {}).get("obs") or {}
+            wall = obs.get("wall_s")
+            if wall is None:
+                return  # pre-clock replica: nothing to sample
+            mp = obs.get("metrics_port")
+            url = (f"http://{r.address.split(':')[0]}:{int(mp)}"
+                   if mp else None)
+            clock.observe(f"replica:{r.address}", t0, float(wall),
+                          float(wall), t3, debug_url=url)
+        except Exception:
+            pass  # telemetry must never fail the poller
 
     def _log_transition(self, r: Replica, was: str) -> None:
         now = r.state()

@@ -492,6 +492,15 @@ def register_framework_metrics(m: Manager) -> None:
     m.new_counter("app_tpu_pd_peer_losses_total",
                   "decode-peer connection losses that shed in-flight "
                   "relayed streams (503 + Retry-After)")
+    m.new_histogram("app_tpu_pd_ship_duration",
+                    "KV-ship wall time per relayed request in seconds: "
+                    "first block encode to the shipper's final windowed "
+                    "send returning (the wire segment of the critical "
+                    "path)", TPU_BUCKETS)
+    m.new_gauge("app_tpu_wire_backlog_bytes",
+                "bytes parked in a wire outbox behind a slow socket, by "
+                "role — the flow-control signal SocketWriter already "
+                "tracks, exported")
 
     # prefix-affinity gateway (gofr_tpu/gateway,
     # docs/advanced-guide/gateway.md): the front door over N serving
@@ -531,6 +540,15 @@ def register_framework_metrics(m: Manager) -> None:
     m.new_counter("app_tpu_spans_dropped_total",
                   "finished spans dropped by the bounded trace-export "
                   "buffer (collector down or stalled)")
+    # tail-sampler visibility (tracing.TailSampler): the keep/drop
+    # verdicts and linger sweeps that decide which traces survive
+    m.new_counter("app_tpu_trace_kept_total",
+                  "traces the tail sampler forwarded downstream, by "
+                  "keep reason (interesting / slow / sampled)")
+    m.new_counter("app_tpu_trace_dropped_total",
+                  "traces the tail sampler discarded after buffering")
+    m.new_counter("app_tpu_trace_sweeps_total",
+                  "linger sweeps that judged rootless buffered traces")
 
     # serving-path telemetry (gofr_tpu/observe: the inference flight
     # recorder's metric face)
@@ -560,6 +578,14 @@ def register_framework_metrics(m: Manager) -> None:
     m.new_gauge("app_tpu_pipeline_depth",
                 "fused decode blocks in flight on the device stream "
                 "after the last pipeline top-up")
+    m.new_histogram("app_tpu_request_segment_duration",
+                    "per-request critical-path segment time in seconds, "
+                    "by segment (queue_wait / prefill / handoff / "
+                    "decode on engines; pick / connect / ttfb on the "
+                    "gateway; kv_transfer on decode ingest) — the "
+                    "histogram face of the wide event's breakdown, "
+                    "exemplar-linked to the trace",
+                    TTFT_BUCKETS)
 
 
 def update_system_metrics(m: Manager) -> None:

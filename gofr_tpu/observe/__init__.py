@@ -10,6 +10,7 @@ HTTP middleware and the TPU engines.
 
 from __future__ import annotations
 
+from .clock import ClockRegistry, PeerClock
 from .profiler import collect_profile, render_collapsed, sample_once
 from .recorder import FlightRecorder
 from .registry import InflightRequest, RequestRegistry
@@ -17,8 +18,10 @@ from .timeline import Timeline, _enabled_from_env, timeline_from_config
 
 __all__ = [
     "Observe",
+    "ClockRegistry",
     "FlightRecorder",
     "InflightRequest",
+    "PeerClock",
     "RequestRegistry",
     "Timeline",
     "collect_profile",
@@ -36,11 +39,16 @@ class Observe:
     observability is not opt-in."""
 
     def __init__(self, metrics=None, tracer=None, max_events: int = 2048,
-                 timeline: "Timeline | None" = None):
+                 timeline: "Timeline | None" = None,
+                 clock: "ClockRegistry | None" = None):
         self.requests = RequestRegistry()
         self.recorder = FlightRecorder(capacity=max_events)
         self.metrics = metrics
         self.tracer = tracer
+        # fleet clock registry (clock.py): peer offset estimates fed by
+        # the pd handshake and the gateway health poll, read by the
+        # fleet timeline merge and /debug/request
+        self.clock = clock if clock is not None else ClockRegistry()
         # serving timeline (timeline.py): defaults honor the
         # TPU_TIMELINE / TPU_TIMELINE_EVENTS process environment so
         # engine-level constructions (tests, benches) behave like the

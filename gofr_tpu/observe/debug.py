@@ -11,6 +11,10 @@ right now?" without attaching a debugger:
                               ?n= ?event= ?request_id=)
   /debug/vars                 config + device topology + engine/batcher
                               state as JSON (expvar style)
+  /debug/timeline?fleet=1     clock-aligned merge of every reachable
+                              peer's timeline (observe/fleet.py)
+  /debug/request?trace_id=..  one request's cross-process wide-event
+                              story, peers queried live
   /debug/pprof/profile        wall-clock sampling profile, collapsed-stack
                               output (?seconds=N&hz=H, flamegraph-ready)
 
@@ -126,6 +130,11 @@ def install_debug_routes(router, app) -> None:
             '<li><a href="/debug/timeline?last_ms=2000">'
             "/debug/timeline</a> — serving timeline "
             "(Chrome-trace JSON; load in Perfetto)</li>"
+            '<li><a href="/debug/timeline?fleet=1">'
+            "/debug/timeline?fleet=1</a> — clock-aligned merge of "
+            "every reachable peer's timeline</li>"
+            '<li><a href="/debug/request">/debug/request?trace_id=...'
+            "</a> — one request's cross-process story</li>"
             '<li><a href="/debug/vars">/debug/vars</a>'
             " — config, topology, engine state</li>"
             '<li><a href="/debug/cache">/debug/cache</a>'
@@ -227,7 +236,67 @@ def install_debug_routes(router, app) -> None:
                 # trace instead of the 400 this branch exists for
                 return _json(w, {"error": "last_ms must be a "
                                           "non-negative finite number"}, 400)
+        if req.param("fleet"):
+            return _json(w, _fleet_trace(last_ms))
         _json(w, tl.chrome_trace(last_ms=last_ms))
+
+    def _fleet_timeout() -> float:
+        try:
+            return float(app.config.get("TPU_OBS_FLEET_TIMEOUT_S") or 2.0)
+        except (TypeError, ValueError):
+            return 2.0
+
+    def _fleet_trace(last_ms) -> dict:
+        """``?fleet=1``: pull every known peer's timeline + wide
+        events, re-base onto the local clock, merge. A down peer is a
+        typed degraded marker in the output, never a failure."""
+        from . import fleet as fleet_mod
+
+        timeout = _fleet_timeout()
+        q = f"?last_ms={last_ms}" if last_ms is not None else ""
+        peers = []
+        for t in fleet_mod.peer_targets(observe, app.config):
+            entry: dict = {"name": t["name"], "offset_s": t["offset_s"],
+                           "uncertainty_s": t["uncertainty_s"]}
+            url = t.get("debug_url")
+            if not url:
+                entry["error"] = "no debug url learned yet"
+            else:
+                try:
+                    entry["trace"] = fleet_mod.fetch_json(
+                        url, "/debug/timeline" + q, timeout_s=timeout)
+                    entry["wide"] = fleet_mod.fetch_json(
+                        url, "/debug/events?event=request&n=2048",
+                        timeout_s=timeout).get("events", [])
+                except Exception as e:  # noqa: BLE001 — degraded, typed
+                    entry.pop("trace", None)
+                    entry["error"] = repr(e)
+            peers.append(entry)
+        local_wide = observe.recorder.events(limit=2048, event="request")
+        return fleet_mod.merge_traces(
+            app.container.app_name,
+            observe.timeline.chrome_trace(last_ms=last_ms),
+            local_wide, peers)
+
+    def request_page(req, w) -> None:
+        """``/debug/request?trace_id=...``: one request's cross-process
+        story — the local wide-event buffer plus every reachable
+        peer's, with the clock estimates that relate their timestamps.
+        Partial on peer failure (typed ``degraded`` entries), never a
+        500."""
+        trace_id = req.param("trace_id")
+        if not trace_id:
+            return _json(w, {"error": "trace_id is required"}, 400)
+        from . import fleet as fleet_mod
+
+        peers = fleet_mod.peer_targets(observe, app.config)
+        payload = fleet_mod.assemble_request(
+            trace_id, app.container.app_name, observe.recorder, peers,
+            timeout_s=_fleet_timeout())
+        clock = getattr(observe, "clock", None)
+        if clock is not None:
+            payload["clock"] = clock.stats()
+        _json(w, payload)
 
     def vars_page(req, w) -> None:
         payload: dict = {
@@ -247,6 +316,20 @@ def install_debug_routes(router, app) -> None:
         tl = getattr(observe, "timeline", None)
         if tl is not None:
             payload["timeline"] = tl.stats()
+        # tail-sampler visibility: buffered/kept/dropped by reason +
+        # linger sweeps — only present when tracing exports through one
+        sampler = getattr(getattr(observe, "tracer", None), "exporter",
+                          None)
+        if sampler is not None and hasattr(sampler, "stats"):
+            try:
+                payload["trace_sampler"] = sampler.stats()
+            except Exception:
+                pass
+        clock = getattr(observe, "clock", None)
+        if clock is not None:
+            cs = clock.stats()
+            if cs:
+                payload["fleet_clock"] = cs
         # per-subsystem declared device bytes (hbm accounting — the
         # same figures the app_tpu_device_bytes gauges export). Module
         # looked up, not imported: an app with no TPU configured must
@@ -341,6 +424,7 @@ def install_debug_routes(router, app) -> None:
     router.add("GET", "/debug/requests", requests_page)
     router.add("GET", "/debug/events", events_page)
     router.add("GET", "/debug/timeline", timeline_page)
+    router.add("GET", "/debug/request", request_page)
     router.add("GET", "/debug/vars", vars_page)
     router.add("GET", "/debug/cache", cache_page)
     router.add("GET", "/debug/pprof/profile", profile_page)

@@ -42,6 +42,7 @@ import numpy as np
 from ..resilience import Deadline
 from ..tpu.kvcache import KVLayout
 from ..tpu.kvcache.quant import concat_blocks, decode_block
+from ..wire import observe_backlog
 from . import protocol as p
 
 
@@ -49,7 +50,8 @@ class _Assembly:
     """One request's frames between REQ and KV_EOF — host numpy only;
     nothing touches the engine until the last frame validated."""
 
-    __slots__ = ("meta", "deadline", "parts", "next_start", "t0")
+    __slots__ = ("meta", "deadline", "parts", "next_start", "t0",
+                 "recv_wall")
 
     def __init__(self, meta: dict):
         self.meta = meta
@@ -61,6 +63,9 @@ class _Assembly:
         self.parts: list = []
         self.next_start = 0
         self.t0 = time.monotonic()
+        # wall stamp of REQ receipt: echoed in END beside the peer's
+        # sent_wall so every relayed request is a clock sample
+        self.recv_wall = time.time()
 
 
 class KVIngestServer:
@@ -83,6 +88,10 @@ class KVIngestServer:
             generator.cfg.head_dim, cache.k_scale is not None,
             np.dtype(str(cache.k.dtype)), generator.max_seq)
         self._hello = p.hello_payload(fingerprint, self.layout)
+        # metrics/debug port of THIS process, advertised in HELLO_OK so
+        # prefill peers learn where the /debug surface lives (set by
+        # App.run once the metrics server binds; None when standalone)
+        self.debug_port: int | None = None
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, int(port)))
@@ -154,6 +163,7 @@ class KVIngestServer:
         streams: dict[int, object] = {}
         try:
             msg = p.read_msg(conn.sock)
+            t1 = time.time()  # HELLO receipt: the NTP sample's t1
             if msg is None or msg[0] != p.HELLO:
                 return
             import json
@@ -169,7 +179,12 @@ class KVIngestServer:
                     "code": 400, "message": f"hello refused: {reason}"}),
                     block=True)
                 return
-            conn.send(p.pack_json(p.HELLO_OK, 0, self._hello), block=True)
+            # clock piggyback: HELLO_OK carries this side's receive/send
+            # stamps (hello_mismatch checks only identity keys, so old
+            # peers ignore the extras) plus the debug-surface port
+            conn.send(p.pack_json(p.HELLO_OK, 0, dict(
+                self._hello, clock_t1=t1, clock_t2=time.time(),
+                debug_port=self.debug_port)), block=True)
             if self.logger is not None:
                 self.logger.info({"event": "pd ingest peer connected",
                                   "peer": str(addr)})
@@ -302,6 +317,15 @@ class KVIngestServer:
                 pass
             return
         self.ingests += 1
+        try:
+            # the wire+assembly segment of the critical path: REQ
+            # receipt to the engine accepting the installed rows. It
+            # PRECEDES the stream's submit stamp, so the wide event
+            # carries it beside the breakdown, not inside it.
+            stream.trace["kv_transfer_s"] = round(
+                time.monotonic() - asm.t0, 6)
+        except Exception:
+            pass  # telemetry must never fail the ingest
         if self.metrics is not None:
             try:
                 self.metrics.increment_counter("app_tpu_pd_requests_total",
@@ -310,12 +334,42 @@ class KVIngestServer:
                 pass
         streams[req_id] = stream
         threading.Thread(target=self._relay_stream,
-                         args=(conn, req_id, stream, streams),
+                         args=(conn, req_id, stream, streams, asm),
                          name=f"gofr-pd-stream-{req_id}",
                          daemon=True).start()
 
+    def _end_payload(self, sent: int, stream, asm) -> dict:
+        """The END frame doubles as the return leg of a per-request
+        clock sample (sent_wall echoed beside this side's REQ-receipt
+        and END-send stamps) and carries the decode worker's segment
+        view so the prefill side can tell the whole story."""
+        endp: dict = {"tokens": sent}
+        try:
+            endp["req_sent_wall"] = asm.meta.get("sent_wall")
+            endp["req_recv_wall"] = asm.recv_wall
+            endp["end_sent_wall"] = time.time()
+            tr = getattr(stream, "trace", None) or {}
+            bd: dict = {}
+            now = time.monotonic()
+            for seg, a, b in (("queue_wait", tr.get("submit"),
+                               tr.get("admit")),
+                              ("prefill", tr.get("admit"),
+                               tr.get("prefill_done")),
+                              ("handoff", tr.get("prefill_done"),
+                               tr.get("first_put")),
+                              ("decode", tr.get("first_put"), now)):
+                if a is not None and b is not None:
+                    bd[seg + "_s"] = round(max(0.0, b - a), 6)
+            if tr.get("kv_transfer_s") is not None:
+                bd["kv_transfer_s"] = tr["kv_transfer_s"]
+            if bd:
+                endp["breakdown"] = bd
+        except Exception:
+            pass  # a bare {"tokens": n} END is always valid
+        return endp
+
     def _relay_stream(self, conn: p.Conn, req_id: int, stream,
-                      streams: dict) -> None:
+                      streams: dict, asm: _Assembly | None = None) -> None:
         """Token relay for one ingested stream: tokens leave zero-
         handoff on the serving loop thread (PushStream sink -> Outbox,
         nonblocking); this waiter only observes the terminal outcome
@@ -333,6 +387,10 @@ class KVIngestServer:
             tok, lp = item if isinstance(item, tuple) else (item, None)
             conn.send(p.pack_tok(req_id, tok, lp))
             sent[0] += 1
+            if sent[0] % 32 == 0:
+                # sampled, not per-token: the gauge is a trend line
+                observe_backlog(self.metrics, conn.pending_bytes(),
+                                role="pd-decode")
             return True
 
         stream.set_sink(sink)
@@ -346,7 +404,10 @@ class KVIngestServer:
                 tok, lp = item if isinstance(item, tuple) else (item, None)
                 conn.send(p.pack_tok(req_id, tok, lp), block=True)
                 sent[0] += 1
-            conn.send(p.pack_json(p.END, req_id, {"tokens": sent[0]}),
+            conn.send(p.pack_json(p.END, req_id,
+                                  self._end_payload(sent[0], stream, asm)
+                                  if asm is not None
+                                  else {"tokens": sent[0]}),
                       block=True)
         except BaseException as e:  # noqa: BLE001 — relay the typed error
             try:

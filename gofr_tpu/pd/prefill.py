@@ -31,7 +31,7 @@ from ..errors import GofrError
 from ..resilience import current_deadline, current_slo_class
 from ..service.reconnect import ReconnectBackoff
 from ..tpu.kvcache.quant import concat_blocks, encode_block
-from ..wire import PushStream
+from ..wire import PushStream, observe_backlog
 from . import protocol as p
 
 _BACKOFF_S = 0.5
@@ -87,6 +87,7 @@ class _Shipper:
         self.buffered = 0
         self.sent = 0
         self.frames = 0
+        self.t_first: float | None = None  # first frame on the wire
         self.error: BaseException | None = None
 
     def _window_deadline(self) -> float:
@@ -95,11 +96,15 @@ class _Shipper:
         return 30.0
 
     def _emit(self, kv) -> None:
+        if self.t_first is None:
+            self.t_first = time.monotonic()
         frame = encode_block(kv)
         self.conn.send_windowed(p.pack_kv(self.req_id, self.sent, frame),
                                 deadline_s=self._window_deadline())
         self.sent += kv.plen
         self.frames += 1
+        observe_backlog(self.metrics, self.conn.pending_bytes(),
+                        role="pd-prefill")
         if self.metrics is not None:
             try:
                 self.metrics.increment_counter("app_tpu_pd_kv_frames_total",
@@ -145,6 +150,16 @@ class _Shipper:
         except BaseException as e:
             self.error = e
             raise
+        # the wire segment of the critical path: first frame enqueue to
+        # the final windowed send returning (histogram face of the
+        # timeline's ship window)
+        if self.metrics is not None and self.t_first is not None:
+            try:
+                self.metrics.record_histogram(
+                    "app_tpu_pd_ship_duration",
+                    time.monotonic() - self.t_first)
+            except Exception:
+                pass
 
 
 class PDPrefill:
@@ -186,9 +201,31 @@ class PDPrefill:
         # the connect path here and the reader-thread loss path
         self._reconnect = ReconnectBackoff(_BACKOFF_S, _BACKOFF_CAP_S)
         self._closed = False
+        self._peer_debug_url: str | None = None  # learned from HELLO_OK
         self.relayed = 0
         self.reconnects = 0
         self.peer_losses = 0
+
+    def _note_peer_clock(self, t0, t1, t2, t3, debug_port=None) -> None:
+        """Feed one NTP sample for the decode peer into the Observe
+        bundle's clock registry (observe/clock.py) — the handshake and
+        every REQ->END round trip are free carriers. No-op without an
+        Observe bundle; never raises into the serving path."""
+        clock = getattr(getattr(self.gen, "_observe", None), "clock", None)
+        if clock is None:
+            return
+        try:
+            name = f"pd:{self.peer[0]}:{self.peer[1]}"
+            if debug_port:
+                self._peer_debug_url = \
+                    f"http://{self.peer[0]}:{int(debug_port)}"
+            if t0 is None or t1 is None or t2 is None:
+                clock.note_peer(name, debug_url=self._peer_debug_url)
+            else:
+                clock.observe(name, float(t0), float(t1), float(t2),
+                              float(t3), debug_url=self._peer_debug_url)
+        except Exception:
+            pass  # telemetry must never take the serving path down
 
     # -- connection management ----------------------------------------------
     @property
@@ -221,8 +258,10 @@ class PDPrefill:
                 # generate() — and everyone behind _conn_lock — forever
                 sock.settimeout(self.connect_timeout_s)
                 conn = p.Conn(sock, window_bytes=self.window_bytes)
+                t0 = time.time()
                 conn.send(p.pack_json(p.HELLO, 0, self._hello), block=True)
                 msg = p.read_msg(sock)
+                t3 = time.time()
                 if msg is None:
                     raise EOFError("peer closed during hello")
                 mtype, _, payload = msg
@@ -232,6 +271,15 @@ class PDPrefill:
                 if mtype != p.HELLO_OK:
                     raise GofrError("unexpected hello reply")
                 sock.settimeout(None)
+                try:
+                    reply = json.loads(bytes(payload)) if payload else {}
+                except ValueError:
+                    reply = {}  # pre-clock peer: HELLO_OK alone is fine
+                # clock piggyback: the handshake IS an NTP exchange when
+                # the peer stamped its receive/send times into HELLO_OK
+                self._note_peer_clock(t0, reply.get("clock_t1"),
+                                      reply.get("clock_t2"), t3,
+                                      debug_port=reply.get("debug_port"))
             except GofrError:
                 # a REFUSED hello is a configuration error (wrong model/
                 # weights behind the address): no silent retry loop —
@@ -282,6 +330,24 @@ class PDPrefill:
                     rs.trace["first_put"] = time.monotonic()
                 rs._push((tok, lp) if rs.logprobs else tok)
             elif mtype == p.END:
+                t3 = time.time()
+                try:
+                    endp = json.loads(bytes(payload)) if payload else {}
+                except ValueError:
+                    endp = {}
+                # per-request clock sample: REQ carried sent_wall, END
+                # echoes it with the peer's receive/send stamps — the
+                # NTP hold-time term (t2-t1) subtracts the whole decode,
+                # so a busy pair converges one sample per request
+                if endp.get("req_recv_wall") is not None:
+                    self._note_peer_clock(
+                        endp.get("req_sent_wall"),
+                        endp.get("req_recv_wall"),
+                        endp.get("end_sent_wall"), t3)
+                if endp.get("breakdown"):
+                    # the decode worker's segment view of this request,
+                    # surfaced beside the local trace for /debug pages
+                    rs.trace["peer_breakdown"] = endp["breakdown"]
                 with self._streams_lock:
                     self._streams.pop(req_id, None)
                 rs._done = True
@@ -376,7 +442,10 @@ class PDPrefill:
                 "slo_class": slo_class,
                 "deadline_s": (round(deadline.remaining(), 6)
                                if deadline is not None else None),
-                "traceparent": traceparent}
+                "traceparent": traceparent,
+                # hop stamp: echoed back in END so every relayed request
+                # doubles as a clock sample (observe/clock.py)
+                "sent_wall": time.time()}
         with self._streams_lock:
             self._streams[req_id] = rs
         shipper = _Shipper(conn, req_id, self.ship_block,

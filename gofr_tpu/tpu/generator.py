@@ -3186,6 +3186,42 @@ class GenerationEngine:
             "cache_tier": stream.cache_tier,
             "cache_tokens": stream.cache_tokens,
         })
+        # critical-path breakdown: the request's life as named segments
+        # that SUM to duration_s (each bounded by consecutive trace
+        # stamps, so the invariant holds by construction). On a decode
+        # worker "prefill" is the ingest install of shipped KV.
+        breakdown: dict = {}
+        prefill_done = trace.get("prefill_done")
+        first_put = trace.get("first_put")
+        cuts = [("queue_wait", submit, admit),
+                ("prefill", admit, prefill_done),
+                ("handoff", prefill_done, first_put),
+                ("decode", first_put, now)]
+        for seg, a, b in cuts:
+            if a is not None and b is not None:
+                breakdown[seg + "_s"] = round(max(0.0, b - a), 6)
+        if breakdown:
+            wide["breakdown"] = breakdown
+        # wall-clock anchor for cross-process placement: emission wall
+        # time minus the monotonic elapsed puts submit on the wall axis
+        # without a second stamp in the hot path
+        if submit is not None:
+            wide["submit_wall_s"] = round(time.time() - (now - submit), 6)
+        if trace.get("kv_transfer_s") is not None:
+            # the P/D wire segment — it PRECEDES submit on the decode
+            # worker (the assembly exists before generate() is called),
+            # so it rides beside the breakdown, not inside it
+            wide["kv_transfer_s"] = trace["kv_transfer_s"]
+        if self.metrics is not None and breakdown:
+            tid = stream.trace_id or None
+            for i, (seg_s, v) in enumerate(sorted(breakdown.items())):
+                try:
+                    self.metrics.record_histogram(
+                        "app_tpu_request_segment_duration", v,
+                        exemplar=tid if i == 0 else None,
+                        segment=seg_s[:-2], program="generate")
+                except Exception:
+                    pass  # telemetry must never take the serving loop down
         if "error" in fields:
             wide["error"] = fields["error"]
         if stream.where is not None:

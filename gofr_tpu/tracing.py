@@ -337,8 +337,9 @@ class TailSampler(SpanExporter):
     def __init__(self, downstream: SpanExporter, sample_rate: float = 1.0,
                  max_traces: int = 512, max_spans_per_trace: int = 256,
                  linger_s: float = 5.0, window: int = 256,
-                 min_samples: int = 20):
+                 min_samples: int = 20, metrics=None):
         self.downstream = downstream
+        self.metrics = metrics
         self.sample_rate = min(1.0, max(0.0, float(sample_rate)))
         self.max_traces = int(max_traces)
         self.max_spans_per_trace = int(max_spans_per_trace)
@@ -355,6 +356,10 @@ class TailSampler(SpanExporter):
         self.kept_traces = 0
         self.dropped_traces = 0
         self.spans_truncated = 0  # per-trace span-cap overflow (visible)
+        # keep verdicts by WHY (the drop rate alone can't distinguish
+        # "sampling works" from "nothing interesting ever fires")
+        self.kept_by_reason = {"interesting": 0, "slow": 0, "sampled": 0}
+        self.linger_sweeps = 0  # sweeps that judged >=1 rootless trace
         # idle flush: the sweep otherwise only runs inside export(), so
         # a process whose span traffic STOPS would strand its buffered
         # rootless traces (including error traces) forever. A daemon
@@ -462,7 +467,8 @@ class TailSampler(SpanExporter):
                     # and slo_class — must not be: flip the verdict so
                     # it and any later spans export.
                     self._verdicts[span.trace_id] = verdict = True
-                    self.kept_traces += 1
+                    self._note_kept("interesting" if self.interesting(span)
+                                    else "slow")
                     self.dropped_traces -= 1
                 if verdict:
                     to_flush.append((span, service_name))
@@ -510,25 +516,42 @@ class TailSampler(SpanExporter):
             return []
         _, spans, is_interesting, service = entry
         keep = is_interesting
+        reason = "interesting" if keep else None
         if root is not None:
             dur_s = root.duration_us / 1e6
             cls = str(root.attributes.get("slo_class") or "latency")
             thresh = self._p99(cls)
             if not keep and thresh is not None and dur_s > thresh:
                 keep = True  # slow tail: above the rolling per-class p99
+                reason = "slow"
             # feed the estimator AFTER judging: a burst of slow roots
             # must not raise the bar fast enough to hide its own tail
             self._note_latency(cls, dur_s)
         if not keep:
             keep = self._sampled(trace_id)
+            reason = "sampled" if keep else None
         self._verdicts[trace_id] = keep
         while len(self._verdicts) > 4096:
             self._verdicts.popitem(last=False)
         if keep:
-            self.kept_traces += 1
+            self._note_kept(reason or "interesting")
             return [(s, service) for s in spans]
         self.dropped_traces += 1
+        self._count("app_tpu_trace_dropped_total")
         return []
+
+    def _note_kept(self, reason: str) -> None:
+        self.kept_traces += 1
+        self.kept_by_reason[reason] = self.kept_by_reason.get(reason, 0) + 1
+        self._count("app_tpu_trace_kept_total", reason=reason)
+
+    def _count(self, name: str, **labels) -> None:
+        if self.metrics is None:
+            return
+        try:
+            self.metrics.increment_counter(name, **labels)
+        except Exception:
+            pass  # telemetry must never take the sampler down
 
     def _sweep_locked(self, force: bool = False) -> list[tuple[Span, str]]:
         """Judge rootless traces past the linger window (and evict by
@@ -536,6 +559,7 @@ class TailSampler(SpanExporter):
         gets a verdict from its buffered spans alone."""
         out: list[tuple[Span, str]] = []
         now = time.monotonic()
+        judged = False
         while self._pending:
             oldest_id, entry = next(iter(self._pending.items()))
             stale = force or (now - entry[0]) >= self.linger_s \
@@ -543,6 +567,10 @@ class TailSampler(SpanExporter):
             if not stale:
                 break
             out.extend(self._decide_locked(oldest_id, None))
+            judged = True
+        if judged:
+            self.linger_sweeps += 1
+            self._count("app_tpu_trace_sweeps_total")
         return out
 
     def flush_pending(self) -> None:
@@ -558,8 +586,10 @@ class TailSampler(SpanExporter):
                 "sample_rate": self.sample_rate,
                 "pending_traces": len(self._pending),
                 "kept_traces": self.kept_traces,
+                "kept_by_reason": dict(self.kept_by_reason),
                 "dropped_traces": self.dropped_traces,
                 "spans_truncated": self.spans_truncated,
+                "linger_sweeps": self.linger_sweeps,
             }
 
     def shutdown(self) -> None:
@@ -589,7 +619,8 @@ def tracer_from_config(config, service_name: str, metrics=None) -> Tracer:
             linger = float(config.get("TPU_TRACE_TAIL_LINGER_S") or 5.0)
         except (TypeError, ValueError):
             linger = 5.0
-        exporter = TailSampler(exporter, sample_rate=rate, linger_s=linger)
+        exporter = TailSampler(exporter, sample_rate=rate, linger_s=linger,
+                               metrics=metrics)
     return Tracer(service_name=service_name, exporter=exporter)
 
 

@@ -183,6 +183,21 @@ class SocketWriter:
         self.sock.close()
 
 
+def observe_backlog(metrics, backlog_bytes: int, **labels) -> None:
+    """Export one outbox-backlog sample (``app_tpu_wire_backlog_bytes``,
+    labeled by caller role): the flow-control signal ``backlog_bytes``
+    already tracks, made scrapeable so a stalled peer shows up on a
+    dashboard before it shows up as a deadline storm. Swallows every
+    failure — telemetry must never take a send path down."""
+    if metrics is None:
+        return
+    try:
+        metrics.set_gauge("app_tpu_wire_backlog_bytes",
+                          float(backlog_bytes), **labels)
+    except Exception:
+        pass
+
+
 class Outbox:
     """Ordered send queue with thread-combining flush.
 

@@ -1,0 +1,384 @@
+"""Fleet observability plane (ISSUE 16): clock-aligned cross-process
+trace merge + per-request critical-path breakdown.
+
+What these tests pin, in order of altitude:
+
+  - the NTP-style offset estimator (observe/clock.py): convergence to
+    a KNOWN simulated skew, the min-RTT sample winning over queue-noisy
+    ones, the rtt/2 + drift uncertainty staying an HONEST bound on the
+    actual error, negative-rtt poison rejection, and the bounded
+    window;
+  - the fleet merge (observe/fleet.py) against a synthetic two-process
+    schedule with KNOWN epochs and offset: peer events land on the
+    local axis exactly where arithmetic says, per-process track groups
+    (pids) and hop slices appear, flow arrows join the shared trace id
+    s -> f, and down/unaligned peers degrade to TYPED markers instead
+    of breaking the merge;
+  - the critical-path breakdown on a real engine wide event: the named
+    segments telescope to the end-to-end duration (within 5% — the
+    acceptance gate), and the per-segment histogram records;
+  - /debug/request with one peer down: a partial story with a typed
+    ``degraded`` entry and HTTP 200 — never a 500.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gofr_tpu import App
+from gofr_tpu.config import MapConfig
+from gofr_tpu.observe import Observe
+from gofr_tpu.observe.clock import ClockRegistry, PeerClock
+from gofr_tpu.observe.fleet import (assemble_request, merge_traces,
+                                    parse_obs_peers, peer_targets)
+from gofr_tpu.observe.recorder import FlightRecorder
+
+# -- the offset estimator -----------------------------------------------------
+
+
+def ntp_sample(pc: PeerClock, t0: float, true_offset: float,
+               send_s: float, recv_s: float, hold_s: float = 0.001):
+    """One simulated exchange: the peer's clock reads LOCAL +
+    ``true_offset``; the request takes ``send_s`` on the way out and
+    ``recv_s`` on the way back."""
+    t1 = t0 + send_s + true_offset
+    t2 = t1 + hold_s
+    t3 = t0 + send_s + hold_s + recv_s
+    pc.add_sample(t0, t1, t2, t3)
+
+
+def test_offset_converges_under_skew():
+    """50 noisy asymmetric samples against a 1.5 s skew: the estimate
+    lands within its OWN reported uncertainty of the truth."""
+    import numpy as np
+
+    rng = np.random.default_rng(42)
+    pc = PeerClock("peer")
+    true = 1.5
+    for i in range(50):
+        ntp_sample(pc, t0=100.0 + i,
+                   true_offset=true,
+                   send_s=float(rng.uniform(0.001, 0.02)),
+                   recv_s=float(rng.uniform(0.001, 0.02)))
+    assert pc.aligned
+    est, unc = pc.offset_s(), pc.uncertainty_s()
+    assert abs(est - true) <= unc, (est, unc)
+    # with 2 ms best-case legs the bound itself must be tight-ish
+    assert unc < 0.025
+
+
+def test_min_rtt_sample_wins():
+    """A queue-delayed sample (wildly asymmetric, big rtt) loses to one
+    clean exchange — min-RTT filtering is the whole estimator."""
+    pc = PeerClock("peer")
+    # 400 ms out / 2 ms back: offset error ~ +199 ms, rtt ~ 402 ms
+    ntp_sample(pc, 10.0, 0.0, send_s=0.4, recv_s=0.002)
+    assert abs(pc.offset_s()) > 0.1
+    # one clean symmetric exchange: 1 ms legs, exact offset
+    ntp_sample(pc, 11.0, 0.0, send_s=0.001, recv_s=0.001)
+    assert abs(pc.offset_s()) < 1e-9
+    assert pc.stats()["rtt_s"] == pytest.approx(0.002)
+
+
+def test_symmetric_exchange_is_exact_and_to_local_inverts():
+    pc = PeerClock("peer")
+    ntp_sample(pc, 50.0, true_offset=-3.25, send_s=0.004, recv_s=0.004)
+    assert pc.offset_s() == pytest.approx(-3.25)
+    # a peer wall stamp maps back onto the local axis
+    assert pc.to_local(2000.0) == pytest.approx(2003.25)
+
+
+def test_negative_rtt_is_poison_not_data():
+    """t2 - t1 exceeding t3 - t0 (torn timestamps, e.g. a wall-clock
+    step mid-exchange) must not enter the window."""
+    pc = PeerClock("peer")
+    pc.add_sample(10.0, 11.0, 13.0, 10.5)  # hold 2 s > round trip 0.5 s
+    assert not pc.aligned
+    assert pc.offset_s() is None and pc.uncertainty_s() is None
+
+
+def test_uncertainty_grows_with_sample_age(monkeypatch):
+    """A stale estimate widens at DRIFT_PPM instead of silently
+    rotting: +100 s of age adds 100 s * 100 ppm = 10 ms."""
+    import gofr_tpu.observe.clock as cmod
+
+    now = [500.0]
+    monkeypatch.setattr(cmod.time, "monotonic", lambda: now[0])
+    pc = PeerClock("peer")
+    ntp_sample(pc, 100.0, 0.0, send_s=0.001, recv_s=0.001)
+    fresh = pc.uncertainty_s()
+    now[0] += 100.0
+    assert pc.uncertainty_s() == pytest.approx(fresh + 0.01)
+
+
+def test_window_is_bounded():
+    pc = PeerClock("peer", window=4)
+    for i in range(10):
+        ntp_sample(pc, float(i), 0.0, send_s=0.001, recv_s=0.001)
+    assert pc.stats()["samples"] == 4
+
+
+def test_registry_observe_note_peer_and_targets():
+    reg = ClockRegistry(window=8)
+    reg.observe("replica:a", 0.0, 0.101, 0.101, 0.002,
+                debug_url="http://a:9100")
+    reg.note_peer("configured", debug_url="http://b:9100")
+    assert reg.peer("replica:a").aligned
+    assert not reg.peer("configured").aligned  # no sample yet
+    targets = peer_targets(Observe(clock=reg))
+    by_name = {t["name"]: t for t in targets}
+    assert by_name["replica:a"]["offset_s"] is not None
+    assert by_name["replica:a"]["debug_url"] == "http://a:9100"
+    assert by_name["configured"]["offset_s"] is None
+    assert by_name["configured"]["aligned"] is False
+
+
+def test_parse_obs_peers_forms():
+    assert parse_obs_peers("a=http://h:1, b=h2:2,, bare:3") == [
+        ("a", "http://h:1"), ("b", "http://h2:2"),
+        ("bare:3", "http://bare:3")]
+    assert parse_obs_peers(None) == []
+
+
+# -- the merge against a known two-process schedule ---------------------------
+
+LOCAL_EPOCHS = (1000.0, 50.0)  # (wall, mono) at export
+PEER_OFFSET = 2.0              # peer wall = local wall + 2.0
+PEER_EPOCHS = (1002.5, 7.0)
+
+
+def _trace(epochs, events):
+    return {"traceEvents": [{"ph": "M", "pid": 1, "tid": 0,
+                             "name": "process_name",
+                             "args": {"name": "export-name"}}, *events],
+            "otherData": {"clock": "monotonic",
+                          "epoch_wall_s": epochs[0],
+                          "epoch_mono_s": epochs[1]}}
+
+
+def _known_fleet():
+    """Local slice at local mono 51.0; peer slice at peer mono 8.0 —
+    which is peer wall 1003.5, i.e. local wall 1001.5, i.e. local mono
+    51.5. One request crosses both processes."""
+    local = _trace(LOCAL_EPOCHS, [
+        {"ph": "X", "pid": 1, "tid": 1, "name": "relay", "cat": "gw",
+         "ts": 51.0e6, "dur": 1e5}])
+    peer = _trace(PEER_EPOCHS, [
+        {"ph": "X", "pid": 1, "tid": 1, "name": "decode", "cat": "eng",
+         "ts": 8.0e6, "dur": 2e5}])
+    local_wide = [{"event": "request", "trace_id": "shared-tid",
+                   "outcome": "ok", "submit_wall_s": 1001.0,
+                   "duration_s": 0.6}]
+    peer_wide = [{"event": "request", "trace_id": "shared-tid",
+                  "outcome": "ok", "submit_wall_s": 1003.5,
+                  "duration_s": 0.4,
+                  "breakdown": {"prefill": 0.3, "decode": 0.1}}]
+    return local, peer, local_wide, peer_wide
+
+
+def test_merge_rebases_peer_events_onto_the_local_axis():
+    local, peer, lw, pw = _known_fleet()
+    merged = merge_traces("gw", local, lw, [
+        {"name": "replica:a", "offset_s": PEER_OFFSET,
+         "uncertainty_s": 0.001, "trace": peer, "wide": pw,
+         "error": None}])
+    ev = merged["traceEvents"]
+    decode = [e for e in ev if e.get("name") == "decode"]
+    assert len(decode) == 1 and decode[0]["pid"] == 2
+    # peer mono 8.0 -> peer wall 1003.5 -> local wall 1001.5 -> 51.5e6
+    assert decode[0]["ts"] == pytest.approx(51.5e6)
+    relay = next(e for e in ev if e.get("name") == "relay")
+    assert relay["pid"] == 1 and relay["ts"] == pytest.approx(51.0e6)
+    # process_name metadata rewritten to fleet names, one per pid
+    names = {e["pid"]: e["args"]["name"] for e in ev
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert names == {1: "gw", 2: "replica:a"}
+    fleet = merged["otherData"]["fleet"]
+    assert [p["pid"] for p in fleet["processes"]] == [1, 2]
+    assert fleet["degraded"] == []
+
+
+def test_merge_draws_request_slices_and_flow_arrows():
+    local, peer, lw, pw = _known_fleet()
+    merged = merge_traces("gw", local, lw, [
+        {"name": "replica:a", "offset_s": PEER_OFFSET,
+         "uncertainty_s": 0.001, "trace": peer, "wide": pw,
+         "error": None}])
+    ev = merged["traceEvents"]
+    hops = [e for e in ev if e.get("cat") == "request"
+            and e.get("ph") == "X"]
+    assert {e["pid"] for e in hops} == {1, 2}
+    by_pid = {e["pid"]: e for e in hops}
+    assert by_pid[1]["ts"] == pytest.approx(51.0e6)   # submit wall 1001.0
+    assert by_pid[2]["ts"] == pytest.approx(51.5e6)   # submit wall 1003.5
+    assert by_pid[2]["args"]["breakdown"] == {"prefill": 0.3,
+                                              "decode": 0.1}
+    flows = [e for e in ev if e.get("name") == "request-hop"]
+    assert [f["ph"] for f in sorted(flows, key=lambda e: e["ts"])] \
+        == ["s", "f"]
+    finish = next(f for f in flows if f["ph"] == "f")
+    assert finish["bp"] == "e"
+    assert len({f["id"] for f in flows}) == 1  # one bound flow chain
+    fleet = merged["otherData"]["fleet"]
+    assert fleet["traces_joined"] == 1 and fleet["flow_events"] == 2
+
+
+def test_merge_single_process_trace_gets_no_flow_arrows():
+    local, _, lw, _ = _known_fleet()
+    merged = merge_traces("gw", local, lw, [])
+    assert merged["otherData"]["fleet"]["flow_events"] == 0
+    assert not [e for e in merged["traceEvents"]
+                if e.get("name") == "request-hop"]
+
+
+def test_merge_degrades_typed_never_breaks():
+    """Down peer -> 'unreachable'; no trace -> 'no-trace'; no clock
+    samples -> 'unaligned' but STILL merged (at raw wall, labeled)."""
+    local, peer, lw, pw = _known_fleet()
+    merged = merge_traces("gw", local, lw, [
+        {"name": "dead", "offset_s": None, "uncertainty_s": None,
+         "trace": None, "wide": [], "error": "ConnectionRefusedError"},
+        {"name": "empty", "offset_s": 0.0, "uncertainty_s": 0.0,
+         "trace": None, "wide": [], "error": None},
+        {"name": "unsynced", "offset_s": None, "uncertainty_s": None,
+         "trace": peer, "wide": pw, "error": None}])
+    fleet = merged["otherData"]["fleet"]
+    reasons = {d["peer"]: d["reason"] for d in fleet["degraded"]}
+    assert reasons == {"dead": "unreachable", "empty": "no-trace",
+                       "unsynced": "unaligned"}
+    # the unsynced peer's events are present, merged at offset 0:
+    # peer wall 1003.5 -> local mono 53.5
+    decode = next(e for e in merged["traceEvents"]
+                  if e.get("name") == "decode")
+    assert decode["ts"] == pytest.approx(53.5e6)
+    # unreachable/no-trace peers never claimed a pid
+    assert [p["name"] for p in fleet["processes"]] == ["gw", "unsynced"]
+
+
+def test_merge_orders_metadata_first_then_by_timestamp():
+    local, peer, lw, pw = _known_fleet()
+    merged = merge_traces("gw", local, lw, [
+        {"name": "replica:a", "offset_s": PEER_OFFSET,
+         "uncertainty_s": 0.001, "trace": peer, "wide": pw,
+         "error": None}])
+    ev = merged["traceEvents"]
+    phases = [e.get("ph") for e in ev]
+    first_body = phases.index(next(p for p in phases if p != "M"))
+    assert all(p == "M" for p in phases[:first_body])
+    ts = [e["ts"] for e in ev[first_body:]]
+    assert ts == sorted(ts)
+
+
+# -- /debug/request assembly: partial, typed, never a 500 ---------------------
+
+
+def test_assemble_request_with_one_peer_down():
+    rec = FlightRecorder()
+    rec.record("request", trace_id="t-1", outcome="ok", duration_s=0.2)
+    rec.record("request", trace_id="other", outcome="ok", duration_s=0.1)
+    story = assemble_request("t-1", "gw", rec, [
+        {"name": "dead", "debug_url": "http://127.0.0.1:9",
+         "offset_s": 0.001, "uncertainty_s": 0.001, "aligned": True},
+        {"name": "unknown", "debug_url": None}], timeout_s=0.5)
+    assert story["found"] == 1
+    assert story["stories"][0]["process"] == "gw"
+    assert [e["trace_id"] for e in story["stories"][0]["events"]] == ["t-1"]
+    reasons = {d["peer"]: d["reason"] for d in story["degraded"]}
+    assert reasons == {"dead": "unreachable", "unknown": "no-debug-url"}
+    assert story["partial"] is True
+
+
+def test_debug_request_http_surface_partial_never_500():
+    """The acceptance arm over real HTTP: a configured peer that is
+    down yields 200 + typed degraded marker, and a missing trace_id is
+    a 400 — never a 500 either way."""
+    app = App(MapConfig({"HTTP_PORT": "0", "METRICS_PORT": "0",
+                         "APP_NAME": "obs", "LOG_LEVEL": "ERROR",
+                         "TPU_OBS_PEERS": "dead=127.0.0.1:9",
+                         "TPU_OBS_FLEET_TIMEOUT_S": "0.5"}))
+    app.run(block=False)
+    try:
+        app.container.observe.recorder.record(
+            "request", trace_id="t-http", outcome="ok", duration_s=0.05)
+        url = (f"http://127.0.0.1:{app.metrics_port}"
+               "/debug/request?trace_id=t-http")
+        with urllib.request.urlopen(url, timeout=10) as r:
+            payload = json.loads(r.read())
+        assert payload["partial"] is True
+        assert {d["peer"]: d["reason"] for d in payload["degraded"]} \
+            == {"dead": "unreachable"}
+        assert payload["found"] == 1
+        assert "clock" in payload
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{app.metrics_port}/debug/request",
+                timeout=10)
+        assert ei.value.code == 400
+    finally:
+        app.stop()
+
+
+# -- the engine-side critical-path breakdown ----------------------------------
+
+
+@pytest.fixture(scope="module")
+def engine_obs():
+    import jax
+
+    from gofr_tpu.metrics import Manager, register_framework_metrics
+    from gofr_tpu.models import LLAMA_CONFIGS, llama
+    from gofr_tpu.tpu import GenerationEngine
+
+    metrics = Manager()
+    register_framework_metrics(metrics)
+    obs = Observe(metrics=metrics)
+    cfg = LLAMA_CONFIGS["tiny"]
+    eng = GenerationEngine(cfg, llama.init(cfg, jax.random.PRNGKey(0)),
+                           slots=2, max_seq=128, prompt_buckets=(16, 32),
+                           metrics=metrics, observe=obs)
+    yield eng, obs, metrics
+    eng.close()
+
+
+def test_breakdown_telescopes_to_duration(engine_obs):
+    """The acceptance invariant: the named segments of a wide event sum
+    to the end-to-end duration within 5% (by construction they
+    telescope — queue_wait/prefill/handoff/decode share cut points)."""
+    import numpy as np
+
+    eng, obs, _ = engine_obs
+    rng = np.random.default_rng(3)
+    toks = eng.generate(rng.integers(1, eng.cfg.vocab_size, 20).tolist(),
+                        max_new_tokens=6).tokens()
+    assert len(toks) == 6
+    wide: list = []
+    deadline = time.monotonic() + 5.0
+    while not wide and time.monotonic() < deadline:
+        # the terminal wide event lands just off the token hot path
+        wide = [e for e in obs.recorder.events(event="request")
+                if e.get("outcome") == "finished"]
+        if not wide:
+            time.sleep(0.01)
+    assert wide, "engine recorded no wide request event"
+    ev = wide[-1]
+    bd = ev["breakdown"]
+    assert set(bd) <= {"queue_wait_s", "prefill_s", "handoff_s",
+                       "decode_s"}
+    assert {"prefill_s", "decode_s"} <= set(bd)
+    assert sum(bd.values()) == pytest.approx(ev["duration_s"], rel=0.05)
+    # the wall anchor the fleet merge places hop slices with
+    assert ev["submit_wall_s"] == pytest.approx(
+        time.time() - ev["duration_s"], abs=5.0)
+
+
+def test_segment_histograms_record(engine_obs):
+    _, _, metrics = engine_obs
+    text = metrics.render_prometheus()
+    assert "app_tpu_request_segment_duration" in text
+    for seg in ("queue_wait", "prefill", "decode"):
+        assert f'segment="{seg}"' in text, seg

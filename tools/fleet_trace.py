@@ -1,0 +1,427 @@
+#!/usr/bin/env python
+"""Fetch (or self-host and validate) the FLEET-merged timeline: every
+process's chrome trace re-based onto one clock axis, with flow arrows
+joining each request's hops across processes.
+
+Two modes:
+
+  --url http://host:2121 [--last-ms N] [--out trace.json]
+      Fetch ``/debug/timeline?fleet=1`` from a running app's metrics
+      port and write the merged Perfetto JSON (stdout or --out). The
+      serving process pulls each peer it knows about (pd handshake,
+      gateway health poll, TPU_OBS_PEERS) and merges on ITS clock.
+
+  --smoke / (no args: full run)
+      CPU-only, no chip lock: host a real gateway + a real replica App
+      (tiny engine behind /generate) on ephemeral ports, drive a traced
+      request through the gateway, and validate the merged trace
+      against the run's KNOWN shape:
+
+        - >= 2 process track groups (gateway + replica), zero degraded
+          peers;
+        - the request's trace id has hop slices in BOTH processes,
+          joined by flow arrows (``s``/``f`` present);
+        - the replica's estimated clock offset is ~0 (same host) and
+          within its own reported uncertainty;
+        - the replica wide event's critical-path breakdown sums to the
+          end-to-end duration within 5%;
+        - ``/debug/request?trace_id=...`` assembles the cross-process
+          story (gateway + replica events, not partial).
+
+      Full runs add a P/D pair arm (PDPrefill -> KVIngestServer over
+      localhost) gating the HELLO/END clock carriers, ``kv_transfer_s``
+      in the decode wide event, and the ship-duration/backlog metrics,
+      then write FLEET_OBS_BENCH.json.
+
+Output follows the bench stdout contract (tools/README.md): the LAST
+stdout line is the JSON artifact; progress goes to stderr; failures
+land in a ``failures`` list instead of a non-zero exit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+TRACE_ID = "f1ee70b5e12a4b0fa11ce0ffee0bd000"
+TRACEPARENT = f"00-{TRACE_ID}-00f067aa0ba902b7-01"
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# -- fetch mode ---------------------------------------------------------------
+
+def fetch(url: str, last_ms: float | None, out: str | None) -> int:
+    target = url.rstrip("/") + "/debug/timeline?fleet=1"
+    if last_ms is not None:
+        target += f"&last_ms={last_ms}"
+    log(f"fetching {target}")
+    with urllib.request.urlopen(target, timeout=30) as r:
+        payload = r.read()
+    merged = json.loads(payload)  # refuse to write a non-JSON body
+    fleet = (merged.get("otherData") or {}).get("fleet") or {}
+    log(f"merged {len(fleet.get('processes', []))} processes, "
+        f"{fleet.get('traces_joined', 0)} traces joined, "
+        f"degraded={fleet.get('degraded', [])}")
+    if out:
+        Path(out).write_bytes(payload)
+        log(f"wrote {out} ({len(payload)} bytes) — load in ui.perfetto.dev")
+    else:
+        sys.stdout.write(payload.decode())
+    return 0
+
+
+# -- self-hosted gateway + replica arm ----------------------------------------
+
+def _get_json(port: int, path: str):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _replica_app(name: str, params):
+    """A real App whose /generate drives a real tiny engine wired to
+    the App's OWN Observe bundle — so its metrics port serves the
+    engine's timeline and wide events, like a production replica."""
+    from gofr_tpu import App
+    from gofr_tpu.config import MapConfig
+    from gofr_tpu.models import LLAMA_CONFIGS
+    from gofr_tpu.tpu import GenerationEngine
+
+    app = App(MapConfig({"HTTP_PORT": "0", "METRICS_PORT": "0",
+                         "APP_NAME": name, "LOG_LEVEL": "ERROR"}))
+    eng = GenerationEngine(LLAMA_CONFIGS["tiny"], params, slots=2,
+                           max_seq=256, prompt_buckets=(8, 16, 32),
+                           prefill_chunk=16, decode_block=4,
+                           metrics=app.container.metrics,
+                           observe=app.container.observe)
+
+    @app.post("/generate")
+    def generate(ctx):
+        body = ctx.bind()
+        stream = eng.generate(
+            [int(t) for t in body["tokens"]],
+            max_new_tokens=int(body.get("max_new_tokens", 8)),
+            traceparent=ctx.header("traceparent"))
+
+        def lines():
+            for tok in stream:
+                yield (json.dumps({"token": int(tok)}) + "\n").encode()
+
+        ctx.stream(lines())
+        return None
+
+    app.run(block=False)
+    return app, eng
+
+
+def _gateway_app(replica_address: str):
+    from gofr_tpu import App
+    from gofr_tpu.config import MapConfig
+
+    gw = App(MapConfig({
+        "HTTP_PORT": "0", "METRICS_PORT": "0", "APP_NAME": "gw",
+        "LOG_LEVEL": "ERROR", "TPU_SERVING_ROLE": "gateway",
+        "TPU_GATEWAY_REPLICAS": replica_address,
+        "TPU_GATEWAY_HEALTH_INTERVAL_S": "0.2",
+        "TPU_GATEWAY_CONNECT_TIMEOUT_S": "2.0"}))
+    gw.run(block=False)
+    return gw
+
+
+def _post_generate(port: int, tokens, max_new: int, headers: dict):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps({"tokens": [int(t) for t in tokens],
+                         "max_new_tokens": max_new}).encode(),
+        headers={"Content-Type": "application/json", **headers},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return [json.loads(line) for line in
+                resp.read().decode().splitlines() if line]
+
+
+def _validate_merged(merged: dict, trace_id: str) -> list[str]:
+    failures: list[str] = []
+    fleet = (merged.get("otherData") or {}).get("fleet") or {}
+    procs = fleet.get("processes") or []
+    if len(procs) < 2:
+        failures.append(f"merged trace has {len(procs)} processes, want >=2")
+    if fleet.get("degraded"):
+        failures.append(f"degraded peers in a healthy fleet: "
+                        f"{fleet['degraded']}")
+    if not fleet.get("traces_joined"):
+        failures.append("no trace id joined across processes")
+
+    ev = merged.get("traceEvents", [])
+    names = sum(1 for e in ev
+                if e.get("ph") == "M" and e.get("name") == "process_name")
+    if names < 2:
+        failures.append(f"{names} process_name metadata entries, want >=2")
+    req_pids = {e["pid"] for e in ev
+                if e.get("ph") == "X" and e.get("cat") == "request"
+                and (e.get("args") or {}).get("trace_id") == trace_id}
+    if len(req_pids) < 2:
+        failures.append(f"trace {trace_id[:8]} hop slices on pids "
+                        f"{sorted(req_pids)}, want both processes")
+    flow_phs = {e["ph"] for e in ev if e.get("name") == "request-hop"}
+    if not {"s", "f"} <= flow_phs:
+        failures.append(f"flow arrows incomplete: phases {sorted(flow_phs)}")
+    # replica offset: same host, so ~0 and inside its own error bar
+    for p in procs:
+        if p.get("pid") == 1:
+            continue
+        off, unc = p.get("offset_s"), p.get("uncertainty_s")
+        if off is None:
+            failures.append(f"peer {p.get('name')} merged unaligned")
+        elif abs(off) > (unc or 0.0) + 0.05:
+            failures.append(
+                f"peer {p.get('name')} offset {off * 1e3:.2f}ms outside "
+                f"uncertainty {((unc or 0.0)) * 1e3:.2f}ms (+50ms slack)")
+    return failures
+
+
+def _validate_story(story: dict, trace_id: str) -> tuple[list[str], float]:
+    """Gates on /debug/request: both processes contribute events, and
+    the engine-side breakdown telescopes to the duration within 5%."""
+    failures: list[str] = []
+    ratio = 0.0
+    if story.get("trace_id") != trace_id:
+        failures.append("request story echoes the wrong trace id")
+    if story.get("partial"):
+        failures.append(f"healthy fleet but partial story: "
+                        f"{story.get('degraded')}")
+    stories = story.get("stories") or []
+    with_events = [s for s in stories if s.get("events")]
+    if len(with_events) < 2:
+        failures.append(f"{len(with_events)} processes hold events for the "
+                        "trace, want gateway AND replica")
+    for s in stories:
+        for ev in s.get("events") or []:
+            bd = ev.get("breakdown")
+            dur = ev.get("duration_s")
+            if s.get("source") != "peer" or not bd or not dur:
+                continue  # the 5% gate is on the engine-side event
+            ratio = sum(bd.values()) / dur
+            if abs(ratio - 1.0) > 0.05:
+                failures.append(
+                    f"breakdown sums to {ratio:.3f}x the end-to-end "
+                    f"duration (segments {bd}, duration {dur:.4f}s)")
+    if ratio == 0.0:
+        failures.append("no engine wide event carried a breakdown")
+    return failures, ratio
+
+
+def run_gateway_arm(params, n_requests: int) -> tuple[dict, list[str]]:
+    arm: dict = {}
+    failures: list[str] = []
+    log("fleet_trace: starting replica (tiny engine) + gateway")
+    rep, eng = _replica_app("replica-a", params)
+    gw = _gateway_app(f"127.0.0.1:{rep.http_port}")
+    try:
+        # deterministic clock samples: each health poll is one NTP
+        # exchange (the background poller keeps refreshing after)
+        for _ in range(4):
+            gw._gateway.table.poll_once()
+        import numpy as np
+
+        rng = np.random.default_rng(11)
+        V = eng.cfg.vocab_size
+        lines = _post_generate(gw.http_port, rng.integers(1, V, 12),
+                               6, {"traceparent": TRACEPARENT})
+        if len(lines) != 6:
+            failures.append(f"traced request returned {len(lines)} tokens, "
+                            "want 6")
+        for i in range(n_requests - 1):  # background traffic, own traces
+            _post_generate(gw.http_port, rng.integers(1, V, 8), 4, {})
+        time.sleep(0.3)  # wide events flush off the serving path
+
+        merged = _get_json(gw.metrics_port, "/debug/timeline?fleet=1")
+        fleet = (merged.get("otherData") or {}).get("fleet") or {}
+        arm["processes"] = len(fleet.get("processes") or [])
+        arm["traces_joined"] = fleet.get("traces_joined")
+        arm["flow_events"] = fleet.get("flow_events")
+        arm["degraded"] = fleet.get("degraded")
+        for p in fleet.get("processes") or []:
+            if p.get("pid") != 1 and p.get("offset_s") is not None:
+                arm["replica_offset_ms"] = round(p["offset_s"] * 1e3, 3)
+                arm["replica_uncertainty_ms"] = round(
+                    (p.get("uncertainty_s") or 0.0) * 1e3, 3)
+        failures += _validate_merged(merged, TRACE_ID)
+
+        story = _get_json(gw.metrics_port,
+                          f"/debug/request?trace_id={TRACE_ID}")
+        story_failures, ratio = _validate_story(story, TRACE_ID)
+        failures += story_failures
+        arm["request_events_found"] = story.get("found")
+        arm["breakdown_sum_ratio"] = round(ratio, 4)
+    finally:
+        gw.stop()
+        rep.stop()
+        eng.close()
+    return arm, failures
+
+
+# -- the P/D pair arm (full runs) ---------------------------------------------
+
+def run_pd_arm(params) -> tuple[dict, list[str]]:
+    """PDPrefill -> KVIngestServer over localhost: the HELLO handshake
+    and every REQ->END round trip feed the prefill side's clock
+    registry; the decode wide event carries ``kv_transfer_s`` beside a
+    telescoping breakdown; shipping records duration + backlog."""
+    import jax.numpy as jnp
+
+    from gofr_tpu.metrics import Manager, register_framework_metrics
+    from gofr_tpu.models import LLAMA_CONFIGS
+    from gofr_tpu.observe import Observe
+    from gofr_tpu.pd import KVIngestServer, PDPrefill
+    from gofr_tpu.tpu import GenerationEngine
+    from gofr_tpu.tpu.kvcache import model_fingerprint
+
+    arm: dict = {}
+    failures: list[str] = []
+    cfg = LLAMA_CONFIGS["tiny"]
+    fp = model_fingerprint(cfg, params, extra="pd")
+
+    def engine(observe, metrics):
+        return GenerationEngine(cfg, params, slots=2, max_seq=128,
+                                prompt_buckets=(16, 32), kv_dtype=jnp.int8,
+                                metrics=metrics, observe=observe)
+
+    pre_metrics = Manager()
+    register_framework_metrics(pre_metrics)
+    dec_metrics = Manager()
+    register_framework_metrics(dec_metrics)
+    obs_pre, obs_dec = Observe(metrics=pre_metrics), Observe(
+        metrics=dec_metrics)
+    log("fleet_trace: starting P/D pair (prefill -> decode over localhost)")
+    pre = engine(obs_pre, pre_metrics)
+    dec = engine(obs_dec, dec_metrics)
+    srv = KVIngestServer(dec, fp, "127.0.0.1", 0, metrics=dec_metrics)
+    pd = PDPrefill(pre, fp, "127.0.0.1", srv.port, ship_block=16,
+                   metrics=pre_metrics)
+    try:
+        import numpy as np
+
+        rng = np.random.default_rng(5)
+        for _ in range(3):
+            toks = pd.generate(rng.integers(1, cfg.vocab_size, 40).tolist(),
+                               max_new_tokens=8).tokens()
+            if len(toks) != 8:
+                failures.append(f"pd relay served {len(toks)} tokens, want 8")
+        time.sleep(0.2)
+
+        peers = obs_pre.clock.stats()
+        pd_peers = {k: v for k, v in peers.items() if k.startswith("pd:")}
+        arm["clock_peers"] = list(pd_peers)
+        if not pd_peers:
+            failures.append("no decode peer in the prefill clock registry")
+        for name, st in pd_peers.items():
+            arm["peer_samples"] = st.get("samples")
+            arm["peer_offset_ms"] = (round(st["offset_s"] * 1e3, 3)
+                                     if st.get("offset_s") is not None
+                                     else None)
+            arm["peer_uncertainty_ms"] = (
+                round(st["uncertainty_s"] * 1e3, 3)
+                if st.get("uncertainty_s") is not None else None)
+            # HELLO gives 1; each of the 3 ENDs adds one more
+            if (st.get("samples") or 0) < 2:
+                failures.append(f"{name}: {st.get('samples')} clock samples, "
+                                "want HELLO + END carriers")
+            if st.get("offset_s") is None:
+                failures.append(f"{name}: no usable clock sample")
+            elif abs(st["offset_s"]) > (st.get("uncertainty_s") or 0) + 0.05:
+                failures.append(
+                    f"{name}: offset {st['offset_s'] * 1e3:.2f}ms outside "
+                    f"uncertainty (+50ms slack)")
+
+        wide = [e for e in obs_dec.recorder.events(event="request")
+                if e.get("kv_transfer_s") is not None]
+        arm["decode_wide_with_kv_transfer"] = len(wide)
+        if not wide:
+            failures.append("no decode wide event carried kv_transfer_s")
+        else:
+            ev = wide[-1]
+            bd, dur = ev.get("breakdown") or {}, ev.get("duration_s")
+            if bd and dur:
+                ratio = sum(bd.values()) / dur
+                arm["decode_breakdown_sum_ratio"] = round(ratio, 4)
+                if abs(ratio - 1.0) > 0.05:
+                    failures.append(f"decode breakdown sums to {ratio:.3f}x "
+                                    f"duration ({bd})")
+            else:
+                failures.append("decode wide event missing breakdown")
+
+        text = pre_metrics.render_prometheus()
+        if "app_tpu_pd_ship_duration" not in text:
+            failures.append("no app_tpu_pd_ship_duration samples on the "
+                            "prefill side")
+        if "app_tpu_wire_backlog_bytes" not in text:
+            failures.append("no app_tpu_wire_backlog_bytes gauge on the "
+                            "prefill side")
+    finally:
+        pd.close()
+        srv.close()
+        pre.close()
+        dec.close()
+    return arm, failures
+
+
+def run_bench(smoke: bool) -> dict:
+    import jax
+
+    from gofr_tpu.models import LLAMA_CONFIGS, llama
+
+    art: dict = {"bench": "fleet_obs", "smoke": smoke}
+    failures: list[str] = []
+    params = llama.init(LLAMA_CONFIGS["tiny"], jax.random.PRNGKey(0))
+
+    arm, f = run_gateway_arm(params, n_requests=2 if smoke else 6)
+    art["gateway_arm"] = arm
+    failures += f
+
+    if not smoke:
+        arm, f = run_pd_arm(params)
+        art["pd_arm"] = arm
+        failures += f
+
+    art["failures"] = failures
+    art["ok"] = not failures
+    return art
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", help="metrics-port base URL of a running app")
+    ap.add_argument("--last-ms", type=float, default=None)
+    ap.add_argument("--out", help="write the trace/artifact to this file")
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast CI arm of the self-hosted bench")
+    args = ap.parse_args()
+
+    if args.url:
+        return fetch(args.url, args.last_ms, args.out)
+
+    art = run_bench(smoke=args.smoke)
+    if not args.smoke:
+        out = args.out or str(Path(__file__).resolve().parent.parent
+                              / "FLEET_OBS_BENCH.json")
+        Path(out).write_text(json.dumps(art, indent=2) + "\n")
+        log(f"wrote {out}")
+    print(json.dumps(art))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
