@@ -88,6 +88,22 @@ class MissingParameter(BadRequest):
         self.params = params
 
 
+class ShardingConfigError(GofrError, ValueError):
+    """A mesh/sharding configuration the engine refuses to serve with —
+    raised at engine construction, before any request is accepted.
+    Names the offending ``TPU_SHARDING`` row so the operator can fix
+    the config line rather than chase wrong logits: the known case is a
+    tp that splits a KV head (n_kv_heads % tp != 0) combined with
+    dp/fsdp > 1, a VERIFIED wrong-logits hazard (see
+    docs/advanced-guide/multichip-serving.md "known limits").
+    Subclasses ValueError so config-validation callers that catch
+    ValueError keep working."""
+
+    def __init__(self, message: str, sharding_row: str = ""):
+        super().__init__(message)
+        self.sharding_row = sharding_row
+
+
 class InternalServerError(HTTPError):
     status_code = 500
 

@@ -359,7 +359,7 @@ def _causal_scan(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                  lengths: jnp.ndarray | None, rope_max: int, rope_tables,
                  constrain, collect_kv: bool, flash: bool = False,
                  attend_override=None, collect_router: bool = False,
-                 adapter=None):
+                 adapter=None, mesh=None):
     """Shared causal body for forward/prefill: embed, mask, scan layers.
 
     Returns (x [B,S,D], kv  — stacked [L,B,S,KV,hd] pair when
@@ -401,7 +401,7 @@ def _causal_scan(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
 
         def attend(q, k, v):
             return causal_attention_auto(q, k, v, lengths=lengths,
-                                         mask=valid)
+                                         mask=valid, mesh=mesh)
     else:
         def attend(q, k, v):
             return causal_attention(q, k, v, mask=valid)
@@ -446,19 +446,22 @@ def forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
 def prefill(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
             cache: KVCache, lengths: jnp.ndarray | None = None,
             rope_tables=None, flash: bool = False,
-            adapter=None) -> tuple[jnp.ndarray, KVCache]:
+            adapter=None, mesh=None) -> tuple[jnp.ndarray, KVCache]:
     """Process prompts [B, S] (right-padded), fill the cache.
 
     ``lengths`` [B]: true prompt lengths (defaults to full S).
     Returns (logits [B, S, V] in f32, cache with lengths set).
-    ``flash=True`` is an explicit single-device opt-in (the serving
-    engine sets it when mesh is None): Pallas calls do not partition
-    under GSPMD, so the default stays safe for sharded jits.
+    ``flash=True`` routes attention through the Pallas flash kernel;
+    on sharded jits pass ``mesh`` as well so the kernel runs under
+    shard_map per head/batch shard (a bare pallas_call does not
+    partition under GSPMD — ops.flash picks shard_map or the jnp
+    fallback from the mesh).
     """
     S = tokens.shape[1]
     x, (k_stack, v_stack), lengths, _ = _causal_scan(
         params, cfg, tokens, lengths, cache.k.shape[2], rope_tables,
-        constrain=None, collect_kv=True, flash=flash, adapter=adapter)
+        constrain=None, collect_kv=True, flash=flash, adapter=adapter,
+        mesh=mesh)
     # k_stack: [L, B, S, KV, hd] -> write into the cache's first S slots
     if S > cache.k.shape[2]:
         raise ValueError(f"prompt length {S} exceeds cache capacity {cache.k.shape[2]}")
@@ -491,7 +494,7 @@ def write_kv(cache: KVCache, k_stack, v_stack, index5, lengths) -> KVCache:
 def prefill_kv(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                lengths: jnp.ndarray | None = None, rope_max: int | None = None,
                rope_tables=None, flash: bool = False, adapter=None,
-               logit_pos: jnp.ndarray | None = None):
+               logit_pos: jnp.ndarray | None = None, mesh=None):
     """Causal forward returning the raw KV stacks instead of a filled cache.
 
     The continuous-batching serving engine prefills ONE sequence at a time
@@ -513,7 +516,7 @@ def prefill_kv(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     x, (k_stack, v_stack), lengths, _ = _causal_scan(
         params, cfg, tokens, lengths, rope_max or tokens.shape[1],
         rope_tables, constrain=None, collect_kv=True, flash=flash,
-        adapter=adapter)
+        adapter=adapter, mesh=mesh)
     if logit_pos is not None:
         x = jnp.take_along_axis(x, logit_pos[:, None, None]
                                 .astype(jnp.int32), axis=1)  # [B, 1, D]
@@ -690,16 +693,17 @@ def multi_request_serving_config(cfg: ModelConfig) -> ModelConfig:
 
 def decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                 cache: KVCache, rope_tables=None, flash: bool = False,
-                adapter=None) -> tuple[jnp.ndarray, KVCache]:
+                adapter=None, mesh=None) -> tuple[jnp.ndarray, KVCache]:
     """One decode step for tokens [B] against the cache.
 
     Returns (logits [B, V] f32, updated cache with lengths+1).
 
     ``flash=True`` routes attention through the Pallas flash-decode
     kernel (ops.flash_decode) when backend+shapes allow — the cache
-    streams from HBM exactly once, int8 on the wire. Single-device
-    engines only (a pallas_call does not partition under GSPMD); the
-    jnp reference stays the default and the fallback.
+    streams from HBM exactly once, int8 on the wire. On sharded jits
+    pass ``mesh`` as well: the kernel then runs under shard_map per
+    head/batch shard (a bare pallas_call does not partition under
+    GSPMD); the jnp reference stays the default and the fallback.
 
     Decode is HBM-bound, so the cache is READ-ONLY inside the layer scan
     (scan ``xs`` slicing reads each layer's [B, Smax, KV, hd] in place; the
@@ -726,7 +730,10 @@ def decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     x = params["embedding"][tokens[:, None]].astype(cfg.jdtype)  # [B,1,D]
 
     if flash:
-        from ..ops.flash_decode import decode_attention_auto as _decode_attn
+        import functools
+
+        from ..ops.flash_decode import decode_attention_auto
+        _decode_attn = functools.partial(decode_attention_auto, mesh=mesh)
     else:
         _decode_attn = decode_attention_appended
 

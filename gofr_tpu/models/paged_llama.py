@@ -106,7 +106,8 @@ def _pool_coords(table: jnp.ndarray, positions: jnp.ndarray, T: int,
 def paged_decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                       cache: PagedKVCache, table: jnp.ndarray,
                       rope_tables=None, flash: bool = True,
-                      adapter=None) -> tuple[jnp.ndarray, PagedKVCache]:
+                      adapter=None, mesh=None
+                      ) -> tuple[jnp.ndarray, PagedKVCache]:
     """One decode step for tokens [B] against the paged pool.
 
     ``table`` [B, MB] int32: clamped block ids (see module docstring).
@@ -120,7 +121,9 @@ def paged_decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     write position is clamped into the table's range, so a violated
     contract corrupts only that slot's own (or the trash) block.
     ``flash=False`` routes attention through the dense-gather reference
-    (CPU tests; the kernel gate also falls back off-TPU)."""
+    (CPU tests; the kernel gate also falls back off-TPU). With ``mesh``
+    the kernel runs under shard_map per tp head shard — no dense pool
+    gather on mesh (ops.paged_attention.paged_decode_sharded)."""
     cfg = multi_request_serving_config(cfg)
     B = tokens.shape[0]
     T = cache.block_size
@@ -132,7 +135,12 @@ def paged_decode_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
 
     x = params["embedding"][tokens[:, None]].astype(cfg.jdtype)
 
-    attn = paged_attention_auto if flash else _reference_attention
+    if flash:
+        import functools
+
+        attn = functools.partial(paged_attention_auto, mesh=mesh)
+    else:
+        attn = _reference_attention
 
     def body(x, xs):
         layer_w, k_layer, v_layer, ks_layer, vs_layer = xs
@@ -181,8 +189,8 @@ def _reference_attention(q, k_pool, v_pool, k_new, v_new, table, lengths,
 
 def paged_verify_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                       cache: PagedKVCache, table: jnp.ndarray,
-                      rope_tables=None, adapter=None, flash: bool = True
-                      ) -> tuple[jnp.ndarray, PagedKVCache]:
+                      rope_tables=None, adapter=None, flash: bool = True,
+                      mesh=None) -> tuple[jnp.ndarray, PagedKVCache]:
     """Speculative-decoding verify pass over the paged pool — the exact
     contract of llama.verify_step (logits [B, W, V]; lengths returned
     UNCHANGED, acceptance is the caller's; W KV rows written at each
@@ -193,9 +201,10 @@ def paged_verify_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
     exactly once through the same scalar-prefetch kernel as decode, and
     the W x W in-window part folds in exactly — off-TPU the auto gate
     falls back to window_attention_appended over a dense gather of the
-    table. ``flash=False`` forces that dense-gather reference: mesh
-    engines need it because a pallas_call is opaque to the GSPMD
-    partitioner (same contract as paged_decode_step's flag).
+    table. ``flash=False`` forces that dense-gather reference. With
+    ``mesh`` the kernel runs under shard_map per tp head shard
+    (ops.paged_attention.paged_window_sharded) — speculative decoding
+    keeps the kernel, and the no-dense-gather rule, on mesh engines.
 
     CAPACITY CONTRACT (same as verify_step): callers must only honor
     acceptance for slots with lengths + W <= capacity; rows past
@@ -224,7 +233,8 @@ def paged_verify_step(params: dict, cfg: ModelConfig, tokens: jnp.ndarray,
                     q, k_layer, v_layer, k_new, v_new, table, lengths,
                     ks_layer, vs_layer)
             return paged_window_auto(q, k_layer, v_layer, k_new, v_new,
-                                     table, lengths, ks_layer, vs_layer)
+                                     table, lengths, ks_layer, vs_layer,
+                                     mesh=mesh)
 
         x, kv, _ = _layer(x, layer_w, cfg, cos, sin, positions,
                           kv_write=lambda k, v: (k, v), attend=attend,

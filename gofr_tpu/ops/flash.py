@@ -24,10 +24,14 @@ shapes, jnp reference otherwise (CPU tests, tiny buckets, odd dims).
 The reference stays the numerics oracle — tests/test_flash.py asserts
 allclose between the two on CPU via Pallas interpret mode.
 
-Sharding caveat: a pallas_call is a custom call — opaque to the GSPMD
-partitioner — so flash must NOT be traced inside a mesh-sharded jit.
-Callers opt in explicitly (llama.prefill/prefill_kv ``flash`` flag; the
-serving engine enables it only when mesh is None).
+Sharding: a pallas_call is a custom call — opaque to the GSPMD
+partitioner — so flash must not be traced BARE inside a mesh-sharded
+jit. On a mesh, ``causal_attention_auto`` instead wraps the kernel in
+``shard_map`` over the tp (and data) axes: every device runs this
+single-device kernel on its local [KV/tp] head shard, with no
+collectives inside attention (the o-proj psum downstream is
+unchanged). The jnp reference remains the fallback when tp would
+split a KV head (parallel.sharding.attention_shard_axes).
 
 Backward: flash is an inference-path kernel here (prefill admission);
 the custom VJP recomputes attention with the jnp reference so code that
@@ -48,8 +52,33 @@ from .pallas_compat import CompilerParams
 
 from .attention import causal_attention
 
+try:  # jax >= 0.4.35 exposes shard_map at the top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map
+
 NEG_INF = -1e30
 _LANES = 128  # VMEM scratch minor dim (min f32 tile is 8 x 128)
+
+
+def interpret_env() -> bool:
+    """GOFR_FLASH_INTERPRET=1 forces Pallas interpret mode through every
+    ops/*_auto dispatcher — the CPU escape hatch that lets engine-level
+    tests and the mesh A/B bench exercise the kernels without a TPU.
+    Re-read every call so tests can flip it per-case."""
+    return os.environ.get("GOFR_FLASH_INTERPRET") == "1"
+
+
+def fit_block(n: int, block: int) -> int:
+    """Shrink ``block`` until it divides ``n``: clamp to n, then halve
+    (1 in the worst case — everything divides by 1). Interpret mode
+    only: on device the Mosaic tile constraints make sub-8 blocks
+    unloweable, so the non-interpret dispatchers gate instead of
+    clamping."""
+    block = min(block, n) if n else block
+    while block > 1 and n % block:
+        block //= 2
+    return max(block, 1)
 
 
 def _flash_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref,
@@ -199,16 +228,18 @@ def _kernel_ok(q: jnp.ndarray, block_q: int, block_k: int) -> bool:
     return tpu_backend_ok()
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def _flash_diffable(q, k, v, lengths, interpret):
-    return flash_causal_prefill(q, k, v, lengths, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_diffable(q, k, v, lengths, interpret, block_q=128, block_k=128):
+    return flash_causal_prefill(q, k, v, lengths, block_q=block_q,
+                                block_k=block_k, interpret=interpret)
 
 
-def _flash_fwd(q, k, v, lengths, interpret):
-    return _flash_diffable(q, k, v, lengths, interpret), (q, k, v, lengths)
+def _flash_fwd(q, k, v, lengths, interpret, block_q=128, block_k=128):
+    return (_flash_diffable(q, k, v, lengths, interpret, block_q, block_k),
+            (q, k, v, lengths))
 
 
-def _flash_bwd(interpret, res, g):
+def _flash_bwd(interpret, block_q, block_k, res, g):
     # Inference kernel; gradients recompute via the jnp oracle so a
     # flash-enabled forward stays differentiable (training keeps the
     # reference path anyway).
@@ -225,11 +256,35 @@ def _flash_bwd(interpret, res, g):
 _flash_diffable.defvjp(_flash_fwd, _flash_bwd)
 
 
+def flash_prefill_sharded(q, k, v, lengths, *, mesh, batch_axes=(),
+                          head_axis=None, block_q: int = 128,
+                          block_k: int = 128,
+                          interpret: bool = False) -> jnp.ndarray:
+    """shard_map'd flash prefill: every device runs the single-device
+    kernel on its local head shard (heads over ``head_axis``, batch over
+    ``batch_axes`` when set — parallel.sharding.attention_shard_axes
+    picks both). Lengths ride replicated unless batch shards. No
+    collectives inside attention; check_rep is off because a
+    pallas_call has no replication rule."""
+    from jax.sharding import PartitionSpec as P
+
+    bax = tuple(batch_axes) or None
+    qspec = P(bax, None, head_axis, None)
+    def run(q, k, v, lengths):
+        return _flash_diffable(q, k, v, lengths, interpret, block_q, block_k)
+
+    fn = shard_map(run, mesh=mesh,
+                   in_specs=(qspec, qspec, qspec, P(bax)),
+                   out_specs=qspec, check_rep=False)
+    return fn(q, k, v, lengths)
+
+
 def causal_attention_auto(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                           lengths: jnp.ndarray | None = None,
                           mask: jnp.ndarray | None = None, *,
                           block_q: int = 128, block_k: int = 128,
-                          interpret: bool = False) -> jnp.ndarray:
+                          interpret: bool = False,
+                          mesh=None) -> jnp.ndarray:
     """Flash kernel when the backend+shapes allow, jnp reference otherwise.
 
     Accepts ``lengths`` [B] or a PREFIX validity ``mask`` [B, S]
@@ -237,7 +292,13 @@ def causal_attention_auto(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     produces). A non-prefix mask is honored only by the reference
     fallback; the kernel path derives lengths as mask.sum(-1), which is
     equivalent for prefix masks alone.
+
+    With ``mesh``, the kernel is wrapped in shard_map over the tp/data
+    axes (flash_prefill_sharded); the reference — which GSPMD partitions
+    fine on its own — remains the fallback when tp would split a KV head
+    or the shapes fail the kernel gate.
     """
+    interpret = interpret or interpret_env()
     if lengths is None and mask is not None:
         lengths = mask.astype(jnp.int32).sum(axis=-1)
     if lengths is not None and mask is None:
@@ -247,6 +308,22 @@ def causal_attention_auto(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if lengths is None:
         lengths = jnp.full((q.shape[0],), q.shape[1], jnp.int32)
         mask = None
+    if interpret:
+        block_q = fit_block(q.shape[1], block_q)
+        block_k = fit_block(q.shape[1], block_k)
+    if mesh is not None:
+        from ..parallel.sharding import attention_shard_axes
+
+        batch_axes, head_axis = attention_shard_axes(
+            mesh, q.shape[0], q.shape[2], k.shape[2])
+        if (head_axis is not None or batch_axes) and \
+                (interpret or _kernel_ok(q, block_q, block_k)):
+            return flash_prefill_sharded(
+                q, k, v, lengths.astype(jnp.int32), mesh=mesh,
+                batch_axes=batch_axes, head_axis=head_axis,
+                block_q=block_q, block_k=block_k, interpret=interpret)
+        return causal_attention(q, k, v, mask=mask)
     if interpret or _kernel_ok(q, block_q, block_k):
-        return _flash_diffable(q, k, v, lengths.astype(jnp.int32), interpret)
+        return _flash_diffable(q, k, v, lengths.astype(jnp.int32), interpret,
+                               block_q, block_k)
     return causal_attention(q, k, v, mask=mask)

@@ -35,9 +35,13 @@ vs ~5.5 ms of int8 HBM traffic at 8B dims), and zero sub-granule
 slicing inside the kernel. The [H, KV*D] accumulator's kv(h) slice is
 selected after the kernel, again in O(B*H*D) jnp.
 
-Sharding caveat (same as ops.flash): a pallas_call is opaque to the
-GSPMD partitioner — single-device engines only; mesh engines keep the
-jnp reference. Dispatch via ``decode_attention_auto``.
+Sharding (same as ops.flash): a pallas_call is opaque to the GSPMD
+partitioner, so on a mesh ``decode_attention_auto`` wraps the kernel in
+``shard_map`` over the tp (and data) axes — every device streams only
+its local [KV/tp] head shard of the cache, no collectives inside
+attention (flash_decode_sharded). The jnp reference remains the
+fallback when tp would split a KV head. Dispatch via
+``decode_attention_auto``.
 """
 
 from __future__ import annotations
@@ -240,6 +244,50 @@ def flash_decode_appended(q, k_cache, v_cache, k_new, v_new, lengths,
     return out.astype(q.dtype).reshape(b, 1, h, d)
 
 
+def flash_decode_sharded(q, k_cache, v_cache, k_new, v_new, lengths,
+                         k_scale=None, v_scale=None, *, mesh,
+                         batch_axes=(), head_axis=None,
+                         block_s: int = 128,
+                         interpret: bool = False) -> jnp.ndarray:
+    """shard_map'd flash_decode_appended: each device runs the
+    single-device kernel (including the appended-token fold) on its
+    local [KV/tp] head shard — and its local batch shard on
+    data-parallel meshes. The specs mirror parallel.kv_cache_specs so
+    GSPMD never gathers the cache at the shard_map boundary; no
+    collectives inside attention (the o-proj psum downstream is
+    unchanged). check_rep off: pallas_call has no replication rule."""
+    from jax.sharding import PartitionSpec as P
+
+    from .flash import shard_map
+
+    bax = tuple(batch_axes) or None
+    qspec = P(bax, None, head_axis, None)      # q/k_new/v_new [B,1,·,D]
+    cspec = P(bax, None, head_axis, None)      # caches [B,Smax,KV,D]
+    sspec = P(bax, None, head_axis)            # scales [B,Smax,KV]
+    lspec = P(bax)
+    if k_scale is not None:
+        def run(q, kc, vc, kn, vn, ln, ks, vs):
+            return flash_decode_appended(q, kc, vc, kn, vn, ln, ks, vs,
+                                         block_s=block_s,
+                                         interpret=interpret)
+
+        fn = shard_map(run, mesh=mesh,
+                       in_specs=(qspec, cspec, cspec, cspec, cspec, lspec,
+                                 sspec, sspec),
+                       out_specs=qspec, check_rep=False)
+        return fn(q, k_cache, v_cache, k_new, v_new, lengths,
+                  k_scale, v_scale)
+
+    def run(q, kc, vc, kn, vn, ln):
+        return flash_decode_appended(q, kc, vc, kn, vn, ln,
+                                     block_s=block_s, interpret=interpret)
+
+    fn = shard_map(run, mesh=mesh,
+                   in_specs=(qspec, cspec, cspec, cspec, cspec, lspec),
+                   out_specs=qspec, check_rep=False)
+    return fn(q, k_cache, v_cache, k_new, v_new, lengths)
+
+
 def _kernel_gate(q, k_cache, block_s: int) -> str | None:
     """None when the Pallas kernel can run; otherwise the NAME of the
     first failing gate. Single source of truth for dispatch AND for the
@@ -288,11 +336,18 @@ def _warn_block_s_once(kind: str, msg: str) -> None:
 def decode_attention_auto(q, k_cache, v_cache, k_new, v_new, lengths,
                           k_scale=None, v_scale=None, *,
                           block_s: int | None = None,
-                          interpret: bool = False) -> jnp.ndarray:
+                          interpret: bool = False,
+                          mesh=None) -> jnp.ndarray:
     """Flash-decode kernel when backend+shapes allow, jnp reference
     otherwise. Same contract as decode_attention_appended.
     ``block_s`` defaults from GOFR_FLASH_BLOCK_S (128): larger blocks
-    amortize per-grid-step overhead, at (block_s/S)-granular DMA skip."""
+    amortize per-grid-step overhead, at (block_s/S)-granular DMA skip.
+    With ``mesh``, the kernel runs under shard_map per head/batch shard
+    (flash_decode_sharded); the reference — GSPMD-partitionable on its
+    own — remains the fallback when tp would split a KV head."""
+    from .flash import fit_block, interpret_env
+
+    interpret = interpret or interpret_env()
     explicit = False
     if block_s is not None and block_s <= 0:
         # explicit caller value, same ZeroDivision hazard as the env
@@ -320,6 +375,10 @@ def decode_attention_auto(q, k_cache, v_cache, k_new, v_new, lengths,
                     f"positive integer; using the default block_s=128")
                 explicit = False  # don't blame the env var for 128's gates
             block_s = 128
+    if interpret:
+        # interpret mode runs anywhere — clamp the block to the cache
+        # length instead of gating (tiny test buckets never divide 128)
+        block_s = fit_block(k_cache.shape[1], block_s)
     gate = None if interpret else _kernel_gate(q, k_cache, block_s)
     if gate == "block_s" and explicit:
         # every gate the env var cannot fix passed; only the operator's
@@ -331,6 +390,18 @@ def decode_attention_auto(q, k_cache, v_cache, k_new, v_new, lengths,
             "rejected", f"GOFR_FLASH_BLOCK_S={block_s} {reason}; the "
             f"flash-decode kernel is DISABLED and attention falls "
             f"back to the jnp reference path")
+    if mesh is not None:
+        from ..parallel.sharding import attention_shard_axes
+
+        batch_axes, head_axis = attention_shard_axes(
+            mesh, q.shape[0], q.shape[2], k_cache.shape[2])
+        if gate is None and (head_axis is not None or batch_axes):
+            return flash_decode_sharded(
+                q, k_cache, v_cache, k_new, v_new, lengths,
+                k_scale, v_scale, mesh=mesh, batch_axes=batch_axes,
+                head_axis=head_axis, block_s=block_s, interpret=interpret)
+        return decode_attention_appended(q, k_cache, v_cache, k_new, v_new,
+                                         lengths, k_scale, v_scale)
     if gate is None:
         return flash_decode_appended(q, k_cache, v_cache, k_new, v_new,
                                      lengths, k_scale, v_scale,

@@ -24,7 +24,15 @@ host side maintains make this fast and safe:
 
 The jnp reference (``paged_attention_reference``) gathers each slot's
 blocks into a dense view and calls the exact reference attention — the
-numerics oracle for interpret-mode tests and the CPU/sharded fallback.
+numerics oracle for interpret-mode tests and the CPU fallback.
+
+Sharding: on a mesh the ``*_auto`` dispatchers wrap the kernel in
+``shard_map`` over the tp axis — the pool shards KV-heads over tp
+(parallel.paged_cache_specs), the block table and lengths ride
+replicated, and every device streams only its local [KV/tp] pane of
+each block. No dense gather, no collectives inside attention. The
+dense-gather reference remains the fallback only when tp would split
+a KV head.
 
 Reference provenance: the reference (GoFr) is a pure-Go microservice
 framework with no ML/serving code at all — this module has NO reference
@@ -161,6 +169,68 @@ def paged_decode_attention(q, k_pool, v_pool, k_new, v_new, table, lengths,
     return out.astype(q.dtype).reshape(b, 1, h, d)
 
 
+def _paged_sharded(inner, mesh, head_axis, args, scales):
+    """shard_map a paged kernel entry point over the tp axis: pool and
+    q/k_new/v_new shard KV-heads (the paged mesh layout is tp-only —
+    parallel.paged_cache_specs replicates batch, table, and lengths).
+    Each device streams its local [KV/tp] pane of every block; no dense
+    gather, no collectives. check_rep off: pallas_call has no
+    replication rule."""
+    from jax.sharding import PartitionSpec as P
+
+    from .flash import shard_map
+
+    hspec = P(None, None, head_axis, None)   # q/k_new/v_new and pools
+    sspec = P(None, None, head_axis)         # pool scales [N, T, KV]
+    in_specs = (hspec,) * 5 + (P(), P())     # q, pools, new kv, table, lens
+    if scales is not None:
+        in_specs = in_specs + (sspec, sspec)
+        args = args + scales
+    fn = shard_map(inner, mesh=mesh, in_specs=in_specs, out_specs=hspec,
+                   check_rep=False)
+    return fn(*args)
+
+
+def paged_decode_sharded(q, k_pool, v_pool, k_new, v_new, table, lengths,
+                         k_scale=None, v_scale=None, *, mesh,
+                         head_axis=None,
+                         interpret: bool = False) -> jnp.ndarray:
+    """shard_map'd paged_decode_attention — see _paged_sharded."""
+    if k_scale is not None:
+        def run(q, kp, vp, kn, vn, tab, ln, ks, vs):
+            return paged_decode_attention(q, kp, vp, kn, vn, tab, ln,
+                                          ks, vs, interpret=interpret)
+    else:
+        def run(q, kp, vp, kn, vn, tab, ln):
+            return paged_decode_attention(q, kp, vp, kn, vn, tab, ln,
+                                          interpret=interpret)
+    scales = (k_scale, v_scale) if k_scale is not None else None
+    return _paged_sharded(run, mesh, head_axis,
+                          (q, k_pool, v_pool, k_new, v_new, table, lengths),
+                          scales)
+
+
+def paged_window_sharded(q, k_pool, v_pool, k_new, v_new, table, lengths,
+                         k_scale=None, v_scale=None, *, mesh,
+                         head_axis=None,
+                         interpret: bool = False) -> jnp.ndarray:
+    """shard_map'd paged_window_attention (speculative verify) — the
+    kv-major row flattening is per-KV-head, so it holds unchanged on
+    each device's local [KV/tp] shard."""
+    if k_scale is not None:
+        def run(q, kp, vp, kn, vn, tab, ln, ks, vs):
+            return paged_window_attention(q, kp, vp, kn, vn, tab, ln,
+                                          ks, vs, interpret=interpret)
+    else:
+        def run(q, kp, vp, kn, vn, tab, ln):
+            return paged_window_attention(q, kp, vp, kn, vn, tab, ln,
+                                          interpret=interpret)
+    scales = (k_scale, v_scale) if k_scale is not None else None
+    return _paged_sharded(run, mesh, head_axis,
+                          (q, k_pool, v_pool, k_new, v_new, table, lengths),
+                          scales)
+
+
 def gather_blocks(pool, table):
     """Dense per-slot view of a paged buffer: [N, T, ...] gathered by
     table [B, MB] -> [B, MB*T, ...]. Materializes the full dense cache —
@@ -240,11 +310,25 @@ def paged_window_attention(q, k_pool, v_pool, k_new, v_new, table,
 
 def paged_window_auto(q, k_pool, v_pool, k_new, v_new, table, lengths,
                       k_scale=None, v_scale=None, *,
-                      interpret: bool = False) -> jnp.ndarray:
+                      interpret: bool = False, mesh=None) -> jnp.ndarray:
     """Window kernel when backend+shapes allow, dense-gather reference
-    (paged_window_reference) otherwise."""
+    (paged_window_reference) otherwise. With ``mesh``, the kernel runs
+    under shard_map per tp head shard (paged_window_sharded); the
+    reference remains the fallback when tp would split a KV head."""
+    from .flash import interpret_env
+
+    interpret = interpret or interpret_env()
     b, w, h, d = q.shape
     probe = jax.ShapeDtypeStruct((b, 1, h * w, d), q.dtype)
+    if mesh is not None:
+        head_axis = _mesh_head_axis(mesh, h, k_pool.shape[2])
+        if head_axis is not None and (interpret or _kernel_ok(probe, k_pool)):
+            return paged_window_sharded(q, k_pool, v_pool, k_new, v_new,
+                                        table, lengths, k_scale, v_scale,
+                                        mesh=mesh, head_axis=head_axis,
+                                        interpret=interpret)
+        return paged_window_reference(q, k_pool, v_pool, k_new, v_new,
+                                      table, lengths, k_scale, v_scale)
     if interpret or _kernel_ok(probe, k_pool):
         return paged_window_attention(q, k_pool, v_pool, k_new, v_new,
                                       table, lengths, k_scale, v_scale,
@@ -279,10 +363,35 @@ def _kernel_ok(q, k_pool) -> bool:
     return tpu_backend_ok()
 
 
+def _mesh_head_axis(mesh, n_heads: int, n_kv_heads: int):
+    """tp axis name when it divides both head counts (the shard_map'able
+    condition), else None — the head-splitting-tp jnp fallback."""
+    from ..parallel.sharding import attention_shard_axes
+
+    _, head_axis = attention_shard_axes(mesh, 0, n_heads, n_kv_heads)
+    return head_axis
+
+
 def paged_attention_auto(q, k_pool, v_pool, k_new, v_new, table, lengths,
                          k_scale=None, v_scale=None, *,
-                         interpret: bool = False) -> jnp.ndarray:
-    """Kernel when backend+shapes allow, dense-gather reference otherwise."""
+                         interpret: bool = False, mesh=None) -> jnp.ndarray:
+    """Kernel when backend+shapes allow, dense-gather reference
+    otherwise. With ``mesh``, the kernel runs under shard_map per tp
+    head shard (paged_decode_sharded) — the mesh serving path never
+    gathers a dense pool view; the reference remains the fallback when
+    tp would split a KV head."""
+    from .flash import interpret_env
+
+    interpret = interpret or interpret_env()
+    if mesh is not None:
+        head_axis = _mesh_head_axis(mesh, q.shape[2], k_pool.shape[2])
+        if head_axis is not None and (interpret or _kernel_ok(q, k_pool)):
+            return paged_decode_sharded(q, k_pool, v_pool, k_new, v_new,
+                                        table, lengths, k_scale, v_scale,
+                                        mesh=mesh, head_axis=head_axis,
+                                        interpret=interpret)
+        return paged_attention_reference(q, k_pool, v_pool, k_new, v_new,
+                                         table, lengths, k_scale, v_scale)
     if interpret or _kernel_ok(q, k_pool):
         return paged_decode_attention(q, k_pool, v_pool, k_new, v_new,
                                       table, lengths, k_scale, v_scale,

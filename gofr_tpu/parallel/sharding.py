@@ -226,6 +226,27 @@ def paged_cache_specs(mesh: Mesh, cache) -> Any:
     )
 
 
+def attention_shard_axes(mesh: Mesh, batch: int, n_heads: int,
+                         n_kv_heads: int) -> tuple[tuple, str | None]:
+    """(batch_axes, head_axis) for shard_map'ing an attention kernel on
+    ``mesh``: batch over the data axes when their product divides it,
+    query/KV heads over tp when tp divides both counts. Mirrors
+    fit_spec's replicate-on-non-divide rule, so the specs the ops/*_auto
+    dispatchers build from this always agree with the cache placements
+    kv_cache_specs / paged_cache_specs produce — a mismatch would make
+    GSPMD gather the cache at the shard_map boundary. head_axis is None
+    exactly when tp would split a KV head (the jnp-fallback condition,
+    same predicate as kv_head_shards)."""
+    nb = 1
+    for ax in DATA_AXES:
+        nb *= mesh.shape.get(ax, 1)
+    batch_axes = DATA_AXES if nb > 1 and batch % nb == 0 else ()
+    tp = mesh.shape.get(AXIS_TP, 1)
+    head_axis = AXIS_TP if (tp > 1 and n_heads % tp == 0
+                            and n_kv_heads % tp == 0) else None
+    return batch_axes, head_axis
+
+
 def kv_head_shards(mesh: Mesh, n_kv_heads: int) -> int:
     """How many tp shards the KV-head axis actually splits into on
     ``mesh`` — mirrors fit_spec's divisibility rule (a tp that does

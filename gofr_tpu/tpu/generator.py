@@ -459,16 +459,18 @@ class GenerationEngine:
         # post-block GIL-yield sleep ("admit window"); the env knob
         # TPU_ADMIT_WINDOW_MS keeps the name. 0 falls back to 1 ms.
         self._admit_window = max(0.0, float(admit_window_ms)) / 1e3
-        # flash-decode kernel (ops.flash_decode): single-device only
-        # (pallas is opaque to GSPMD). FENCED, not just opt-in: the
-        # 2026-07-31 device capture (BENCH_CANDIDATE.json) measured the
-        # kernel SLOWER than the fused XLA step inside the K-step scan
-        # (2309 vs 2709 tok/s — see PERF.md "flash-decode regression"),
-        # so GOFR_FLASH_DECODE=1 alone now logs the recorded regression
-        # and stays on the XLA path; GOFR_FLASH_DECODE_FORCE=1 runs the
-        # kernel anyway (the A/B-profiling escape hatch).
+        # flash-decode kernel (ops.flash_decode). FENCED, not just
+        # opt-in: the 2026-07-31 device capture (BENCH_CANDIDATE.json)
+        # measured the kernel SLOWER than the fused XLA step inside the
+        # K-step scan (2309 vs 2709 tok/s — see PERF.md "flash-decode
+        # regression"), so GOFR_FLASH_DECODE=1 alone now logs the
+        # recorded regression and stays on the XLA path;
+        # GOFR_FLASH_DECODE_FORCE=1 runs the kernel anyway (the
+        # A/B-profiling escape hatch). Mesh engines run it shard_map'd
+        # per head/batch shard (ops.flash_decode.flash_decode_sharded)
+        # under the same env gating.
         self._flash_decode = False
-        if mesh is None and os.environ.get("GOFR_FLASH_DECODE") == "1":
+        if os.environ.get("GOFR_FLASH_DECODE") == "1":
             if os.environ.get("GOFR_FLASH_DECODE_FORCE") == "1":
                 self._flash_decode = True
             elif logger is not None:
@@ -646,22 +648,31 @@ class GenerationEngine:
             tp = mesh.shape.get("tp", 1)
             data = mesh.devices.size // max(tp * mesh.shape.get("sp", 1)
                                             * mesh.shape.get("pp", 1), 1)
-            if tp > 1 and cfg.n_kv_heads % tp and data > 1 \
-                    and logger is not None:
+            if tp > 1 and cfg.n_kv_heads % tp and data > 1:
                 # VERIFIED numerics hazard (tools/multichip_bench.py
                 # bring-up, CPU GSPMD): a tp that splits a KV head
                 # (n_kv_heads % tp != 0) combined with dp/fsdp > 1
                 # produced logits off by O(1) — not reduction noise —
                 # while the same tp with data axes = 1, and any
                 # head-aligned tp, stayed exact. Until root-caused in
-                # the partitioner, pick tp dividing n_kv_heads on
-                # multi-axis meshes (docs/advanced-guide/
+                # the partitioner this config is REFUSED at startup
+                # (it served wrong answers silently when it was only a
+                # warning); tp alone (data axes = 1) falls back to the
+                # jnp reference instead (docs/advanced-guide/
                 # multichip-serving.md "known limits").
-                logger.warn({
-                    "event": "tp splits a KV head on a multi-axis mesh",
-                    "tp": int(tp), "n_kv_heads": int(cfg.n_kv_heads),
-                    "detail": "known wrong-logits hazard; prefer tp "
-                              "dividing n_kv_heads"})
+                from ..errors import ShardingConfigError
+
+                row = ",".join(
+                    f"{ax}={n}" for ax, n in
+                    zip(mesh.axis_names, mesh.devices.shape) if n > 1)
+                raise ShardingConfigError(
+                    f"TPU_SHARDING='{row}': tp={tp} splits a KV head "
+                    f"(n_kv_heads={cfg.n_kv_heads}) on a multi-axis mesh "
+                    f"(data axes product {data}) — a verified "
+                    f"wrong-logits configuration. Use a tp that divides "
+                    f"n_kv_heads, or drop the data axes (dp/fsdp=1) to "
+                    f"serve tp-only on the jnp fallback.",
+                    sharding_row=row)
             self._rep_sh = replicated(mesh)
             struct = jax.eval_shape(_init_cache)  # _cache_sh still None
             self._cache_sh = (paged_cache_specs(mesh, struct) if self._paged
@@ -1173,14 +1184,15 @@ class GenerationEngine:
                     top_k, key, adapter=None):
         """tokens [1, Sb] (padded), length/slot scalars. Writes the slot's
         KV, sets its cursor, returns (first_token scalar, cache)."""
-        # flash prefill only off-mesh: a Pallas call inside a GSPMD-sharded
-        # jit does not partition (custom calls are opaque to the
-        # partitioner) — sharded engines keep the fusable jnp reference.
+        # flash prefill everywhere: bare Pallas calls do not partition
+        # under GSPMD, so on mesh engines ops.flash wraps the kernel in
+        # shard_map per head shard (jnp reference when tp would split a
+        # KV head) — the mesh= plumbing picks the form.
         key, sub = jax.random.split(key)  # chained: see _fused_decode_scan
         logits, k, v, _ = llama.prefill_kv(
             params, self.cfg, tokens, jnp.asarray([length]),
             rope_max=self.max_seq, rope_tables=self.rope_tables,
-            flash=self.mesh is None, adapter=adapter,
+            flash=True, mesh=self.mesh, adapter=adapter,
             logit_pos=jnp.asarray([length - 1]))
         lengths = cache.lengths.at[slot].set(length)
         cache = llama.write_kv(cache, k, v, (0, slot, 0, 0, 0), lengths)
@@ -1323,7 +1335,7 @@ class GenerationEngine:
             return llama.decode_step(
                 params, self.cfg, tokens, cache,
                 rope_tables=self.rope_tables, flash=self._flash_decode,
-                adapter=adapter)
+                adapter=adapter, mesh=self.mesh)
 
         return self._fused_decode_scan(cache, pack, carry, key, step_model)
 
@@ -1336,12 +1348,12 @@ class GenerationEngine:
         from ..models import paged_llama
 
         key, sub = jax.random.split(key)  # chained: see _fused_decode_scan
-        # flash prefill only off-mesh (pallas is opaque to GSPMD) —
+        # flash prefill everywhere — shard_map'd per head shard on mesh,
         # same contract as the contiguous _prefill_fn
         logits, k, v, _ = llama.prefill_kv(
             params, self.cfg, tokens, jnp.asarray([length]),
             rope_max=self.max_seq, rope_tables=self.rope_tables,
-            flash=self.mesh is None, adapter=adapter,
+            flash=True, mesh=self.mesh, adapter=adapter,
             logit_pos=jnp.asarray([length - 1]))
         cache = paged_llama.write_prompt_blocks(cache, k, v, blocks, length)
         cache = cache._replace(lengths=cache.lengths.at[slot].set(length))
@@ -1359,7 +1371,7 @@ class GenerationEngine:
         logits, stepped = paged_llama.paged_verify_step(
             params, self.cfg, window, cache, table,
             rope_tables=self.rope_tables, adapter=adapter,
-            flash=self.mesh is None)
+            flash=True, mesh=self.mesh)
         return self._verify_epilogue(logits, window, active, stepped)
 
     def _paged_step_fn(self, cache, params, pack, carry, key):
@@ -1377,7 +1389,7 @@ class GenerationEngine:
             return paged_llama.paged_decode_step(
                 params, self.cfg, tokens, cache, table,
                 rope_tables=self.rope_tables, adapter=adapter,
-                flash=self.mesh is None)
+                flash=True, mesh=self.mesh)
 
         return self._fused_decode_scan(cache, pack, carry, key, step_model)
 

@@ -1,0 +1,213 @@
+"""Mesh-native attention kernels: shard_map'd flash prefill/decode and
+paged attention on the virtual 8-device CPU mesh (tests/conftest.py).
+
+GOFR_FLASH_INTERPRET=1 forces every *_auto dispatcher into interpret
+mode, so the REAL kernel bodies run (as XLA emulation) inside shard_map
+on tp=2 and tp=4 meshes; tokens are asserted EXACT against the
+single-device jnp-reference engine built before the env flag is set.
+tiny's n_kv_heads=2 covers tp=2; tp=4 uses a 4-KV-head variant so both
+factorizations stay in the head-aligned regime. The head-splitting
+regime is covered the other way round: tp-only meshes fall back to the
+jnp reference (still token-exact), and tp + data axes refuse at
+construction with a typed ShardingConfigError naming the TPU_SHARDING
+row (the PR-13 verified wrong-logits hazard).
+
+Structural guarantees (monkeypatch counters, not numerics):
+- the mesh paged decode/verify path never materializes a dense pool
+  view (gather_blocks raises if reached);
+- the shard_map'd kernel forms are actually dispatched (a silent
+  fallback to the reference would otherwise pass every exactness test).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from gofr_tpu.errors import ShardingConfigError
+from gofr_tpu.models import LLAMA_CONFIGS, llama
+from gofr_tpu.ops import flash, flash_decode, paged_attention
+from gofr_tpu.parallel import make_mesh, shard_params
+from gofr_tpu.tpu import GenerationEngine
+
+TINY = LLAMA_CONFIGS["tiny"]            # n_heads=4, n_kv_heads=2
+TINY4 = TINY.with_(name="tiny4", n_kv_heads=4)  # tp=4 head-aligned
+
+PROMPTS = [[5, 17, 42, 7], [3, 1, 4, 1, 5, 9, 2, 6]]
+REP = [7, 9, 7, 9, 7, 9, 7, 9, 7, 9]   # repetitive: spec windows accept
+N_NEW = 20
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama.init(TINY, jax.random.PRNGKey(1))
+
+
+@pytest.fixture(scope="module")
+def tiny4_params():
+    return llama.init(TINY4, jax.random.PRNGKey(1))
+
+
+def _cfg_params(tp, tiny_params, tiny4_params):
+    """tp=2 rides tiny (n_kv_heads=2); tp=4 needs the 4-KV-head variant."""
+    return (TINY, tiny_params) if tp == 2 else (TINY4, tiny4_params)
+
+
+def _engine(cfg, params, *, mesh=None, kv_dtype=None, paged=False, **kw):
+    extra = dict(paged_blocks=25, paged_block_size=8) if paged else {}
+    return GenerationEngine(cfg, params, slots=4, max_seq=64,
+                            prompt_buckets=(8, 16), mesh=mesh,
+                            kv_dtype=kv_dtype, **extra, **kw)
+
+
+def _tokens(eng, prompts=PROMPTS, n=N_NEW):
+    # single-stream greedy probes: batching streams together can flip
+    # borderline argmax between factorizations (see CHANGES.md, PR 13)
+    try:
+        return [eng.generate(p, max_new_tokens=n).tokens() for p in prompts]
+    finally:
+        eng.close()
+
+
+def _counted(monkeypatch, module, name):
+    """Wrap module.name with a call counter (trace-time dispatch proof)."""
+    calls = []
+    inner = getattr(module, name)
+
+    def wrapper(*a, **kw):
+        calls.append(name)
+        return inner(*a, **kw)
+
+    monkeypatch.setattr(module, name, wrapper)
+    return calls
+
+
+def _interpret_on(monkeypatch, flash_decode_env=False):
+    monkeypatch.setenv("GOFR_FLASH_INTERPRET", "1")
+    if flash_decode_env:
+        # the contiguous decode kernel stays env-fenced (recorded device
+        # regression, PERF.md) — opt in explicitly for the kernel path
+        monkeypatch.setenv("GOFR_FLASH_DECODE", "1")
+        monkeypatch.setenv("GOFR_FLASH_DECODE_FORCE", "1")
+
+
+# -- token exactness: contiguous engine ---------------------------------------
+
+@pytest.mark.parametrize("kv_dtype", [None, jnp.int8])
+@pytest.mark.parametrize("tp", [2, 4])
+def test_mesh_contiguous_token_exact(tp, kv_dtype, tiny_params, tiny4_params,
+                                     monkeypatch):
+    """shard_map'd flash prefill + flash-decode v3 on a dp x tp mesh are
+    token-exact vs the single-device jnp-reference engine, fp and int8
+    KV, and the sharded kernel forms actually dispatch."""
+    cfg, params = _cfg_params(tp, tiny_params, tiny4_params)
+    want = _tokens(_engine(cfg, params, kv_dtype=kv_dtype))
+
+    _interpret_on(monkeypatch, flash_decode_env=True)
+    prefills = _counted(monkeypatch, flash, "flash_prefill_sharded")
+    decodes = _counted(monkeypatch, flash_decode, "flash_decode_sharded")
+    mesh = make_mesh(tp=tp, dp=8 // tp)
+    got = _tokens(_engine(cfg, shard_params(params, mesh), mesh=mesh,
+                          kv_dtype=kv_dtype))
+    assert got == want
+    assert prefills and decodes  # kernel path, not a silent fallback
+
+
+@pytest.mark.parametrize("kv_dtype", [None, jnp.int8])
+@pytest.mark.parametrize("tp", [2, 4])
+def test_mesh_paged_token_exact(tp, kv_dtype, tiny_params, tiny4_params,
+                                monkeypatch):
+    """shard_map'd paged attention over the block pool is token-exact vs
+    the single-device reference, fp and int8 KV, tp=2 and tp=4."""
+    cfg, params = _cfg_params(tp, tiny_params, tiny4_params)
+    want = _tokens(_engine(cfg, params, kv_dtype=kv_dtype, paged=True))
+
+    _interpret_on(monkeypatch)
+    decodes = _counted(monkeypatch, paged_attention, "paged_decode_sharded")
+    mesh = make_mesh(tp=tp, dp=8 // tp)
+    got = _tokens(_engine(cfg, shard_params(params, mesh), mesh=mesh,
+                          kv_dtype=kv_dtype, paged=True))
+    assert got == want
+    assert decodes
+
+
+# -- token exactness: speculative verify over the paged pool ------------------
+
+@pytest.mark.parametrize("kv_dtype", [None, jnp.int8])
+def test_mesh_paged_spec_verify_token_exact(kv_dtype, tiny_params,
+                                            monkeypatch):
+    """Speculative decoding on the mesh: the shard_map'd verify-window
+    kernel accepts/rejects exactly like the spec-less single-device
+    engine (same tokens), and the verify pass actually runs."""
+    want = _tokens(_engine(TINY, tiny_params, kv_dtype=kv_dtype),
+                   prompts=[REP], n=30)
+
+    _interpret_on(monkeypatch)
+    windows = _counted(monkeypatch, paged_attention, "paged_window_sharded")
+    mesh = make_mesh(tp=2, dp=4)
+    eng = _engine(TINY, shard_params(tiny_params, mesh), mesh=mesh,
+                  kv_dtype=kv_dtype, paged=True, spec_decode_k=3)
+    try:
+        got = [eng.generate(REP, max_new_tokens=30).tokens()]
+        st = eng.stats()["spec_decode"]
+        assert st["emitted"] >= st["windows"] > 0  # verify pass ran
+    finally:
+        eng.close()
+    assert got == want
+    assert windows
+
+
+# -- structural: mesh paged serving never gathers a dense pool view -----------
+
+def test_mesh_paged_never_materializes_dense_pool(tiny_params, monkeypatch):
+    """The mesh paged decode/verify path must stream blocks through the
+    table inside the kernel — gather_blocks (the reference's dense
+    [B, S, KV, hd] materialization, exactly what paging exists to avoid)
+    raises if any mesh serving trace reaches it."""
+    _interpret_on(monkeypatch)
+
+    def _boom(pool, table):
+        raise AssertionError(
+            "mesh paged serving materialized a dense pool view")
+
+    monkeypatch.setattr(paged_attention, "gather_blocks", _boom)
+    mesh = make_mesh(tp=2, dp=4)
+    eng = _engine(TINY, shard_params(tiny_params, mesh), mesh=mesh,
+                  paged=True, spec_decode_k=3)
+    try:
+        out = eng.generate(REP, max_new_tokens=30).tokens()
+        assert len(out) == 30
+        assert eng.stats()["spec_decode"]["windows"] > 0
+    finally:
+        eng.close()
+
+
+# -- head-splitting tp: jnp fallback (tp-only) or typed refusal (tp+data) -----
+
+def test_head_splitting_tp_only_falls_back_token_exact(tiny_params,
+                                                       monkeypatch):
+    """tp=4 over tiny's 2 KV heads on a tp-ONLY mesh is legal: the auto
+    dispatchers decline shard_map (a split head has no local kernel
+    form) and serve the GSPMD-partitioned jnp reference, token-exact."""
+    want = _tokens(_engine(TINY, tiny_params))
+
+    _interpret_on(monkeypatch)
+    mesh = make_mesh(tp=4, devices=jax.devices()[:4])
+    got = _tokens(_engine(TINY, shard_params(tiny_params, mesh), mesh=mesh))
+    assert got == want
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_head_splitting_tp_with_data_axes_refused(tiny_params, paged):
+    """tp splitting a KV head COMBINED with data axes is the verified
+    wrong-logits configuration (PR 13): construction raises a typed
+    ShardingConfigError naming the offending TPU_SHARDING row, before
+    any request can be accepted."""
+    mesh = make_mesh(tp=4, dp=2)
+    with pytest.raises(ShardingConfigError) as exc:
+        _engine(TINY, shard_params(tiny_params, mesh), mesh=mesh,
+                paged=paged)
+    assert "TPU_SHARDING='dp=2,tp=4'" in str(exc.value)
+    assert exc.value.sharding_row == "dp=2,tp=4"
+    assert "n_kv_heads=2" in str(exc.value)
+    # typed AND a ValueError: config-validation callers keep working
+    assert isinstance(exc.value, ValueError)
