@@ -39,6 +39,17 @@ Seams (grep for ``chaos.fire``):
                       an injected error is treated as that attempt's
                       transport loss, driving the pre-first-token
                       failover path on attempt N exactly (``every=N``)
+  GATEWAY_MIDSTREAM   gateway relay loop, before EACH relayed token
+                      line AFTER the first — an injected error is that
+                      line's transport loss, driving the POST-commit
+                      auto-resume path after exactly N relayed tokens
+                      (``every=N, limit=1``)
+  GENERATOR_MIDKILL   tpu/generator._deliver, after EACH delivered
+                      token — an injected error kills THAT stream
+                      after exactly N emitted tokens (``every=N,
+                      limit=1``), the in-process stand-in for a
+                      replica SIGKILL mid-stream; the typed error line
+                      carries a resume token
   GRPC_STREAM         grpcx/server._handle_stream, before dispatch —
                       transport-level latency/errors per RPC
   HBM_ALLOC           tpu/hbm lease points (lease/alloc/check) — an
@@ -69,8 +80,9 @@ import threading
 import time
 
 __all__ = [
-    "BATCHER_DISPATCH", "GATEWAY_PICK", "GATEWAY_RELAY",
-    "GENERATOR_CHUNK", "GENERATOR_PREFILL", "GENERATOR_STEP",
+    "BATCHER_DISPATCH", "GATEWAY_MIDSTREAM", "GATEWAY_PICK",
+    "GATEWAY_RELAY", "GENERATOR_CHUNK", "GENERATOR_MIDKILL",
+    "GENERATOR_PREFILL", "GENERATOR_STEP",
     "GRPC_STREAM", "HBM_ALLOC", "HTTP_REQUEST", "SERVICE_REQUEST", "SEAMS",
     "ChaosSchedule", "DeviceLost", "ResourceExhausted", "Rule",
     "active", "fire", "install", "scope", "slow_h2_preface", "slow_loris",
@@ -78,9 +90,11 @@ __all__ = [
 ]
 
 BATCHER_DISPATCH = "batcher.dispatch"
+GATEWAY_MIDSTREAM = "gateway.midstream"
 GATEWAY_PICK = "gateway.pick"
 GATEWAY_RELAY = "gateway.relay"
 GENERATOR_CHUNK = "generator.chunk"
+GENERATOR_MIDKILL = "generator.midkill"
 GENERATOR_PREFILL = "generator.prefill"
 GENERATOR_STEP = "generator.step"
 GRPC_STREAM = "grpc.stream"
@@ -88,8 +102,9 @@ HBM_ALLOC = "hbm.alloc"
 HTTP_REQUEST = "http.request"
 SERVICE_REQUEST = "service.request"
 
-SEAMS = (BATCHER_DISPATCH, GATEWAY_PICK, GATEWAY_RELAY, GENERATOR_CHUNK,
-         GENERATOR_PREFILL, GENERATOR_STEP, GRPC_STREAM, HBM_ALLOC,
+SEAMS = (BATCHER_DISPATCH, GATEWAY_MIDSTREAM, GATEWAY_PICK, GATEWAY_RELAY,
+         GENERATOR_CHUNK, GENERATOR_MIDKILL, GENERATOR_PREFILL,
+         GENERATOR_STEP, GRPC_STREAM, HBM_ALLOC,
          HTTP_REQUEST, SERVICE_REQUEST)
 
 

@@ -16,9 +16,16 @@ them robust AS A UNIT):
   - **failover with a retry budget**: a replica lost BEFORE the first
     token is retried transparently on another replica (nothing was
     delivered — safe), bounded by a token-bucket budget so a dying
-    fleet can't amplify into a retry storm; loss AFTER the first
-    token terminates the stream with a typed 503 + Retry-After line
-    (the P/D relay contract, in ndjson);
+    fleet can't amplify into a retry storm;
+  - **durable streams (PR 18)**: the commit point moved from "first
+    token" to "stream end" — a replica lost AFTER the first token is
+    auto-resumed on a ring successor (whose T2 namespace covers the
+    prompt+emitted block chain warm) by replaying the request as a
+    ``continue_from`` continuation, spliced token-exact into the
+    client's stream; the legacy typed 503 + Retry-After line only
+    goes out when the retry budget / deadline / attempt cap is
+    exhausted — and then it carries a resume token so the CLIENT can
+    continue where the gateway could not;
   - **zero-loss rolling drain**: the moment a replica's readiness
     flips (its ``App.stop(grace_s)`` drain window), health polls and
     inline drain-503s stop NEW routing there while in-flight relays
@@ -49,20 +56,24 @@ docs/tpu/config-reference.md):
   TPU_GATEWAY_STREAM_TIMEOUT_S   mid-stream stall bound (120)
   TPU_GATEWAY_BREAKER_THRESHOLD  health-client breaker threshold (3)
   TPU_GATEWAY_BREAKER_INTERVAL_S breaker recovery probe interval (2.0)
+  TPU_RESUME                     post-commit auto-resume (default true)
+  TPU_RESUME_MAX                 resume attempts per stream (default 3)
 """
 
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
+import uuid
 
 from .. import chaos, tracing
 from ..errors import BadRequest, DeadlineExceeded, HTTPError, TooManyRequests
 from ..resilience import current_deadline, current_slo_class
 from ..service.wrap import hop_context, set_header_default
-from .relay import (ReplicaResponse, TransportLoss, first_line, forward,
-                    relay_lines)
+from .relay import (ReplicaResponse, TransportLoss, error_line,
+                    first_line, forward, relay_lines)
 from .router import (AffinityRouter, GatewayUnavailable, HashRing,
                      RetryBudget)
 from .table import Replica, ReplicaTable
@@ -108,6 +119,39 @@ def parse_replicas(spec: str | None) -> list[str]:
     return out
 
 
+class _ResumeCtx:
+    """Everything the post-commit auto-resume loop needs about one
+    request: the stamped forward payload, the affinity key, and the
+    client headers to re-derive hop context from on each continuation.
+    The request id and sampling seed are chosen HERE, before the first
+    forward — a SIGKILLed replica emits nothing, so anything a resume
+    needs must already be in the first attempt's body."""
+
+    __slots__ = ("payload", "key", "plen", "rid",
+                 "client_headers", "resumable")
+
+    def __init__(self, payload: dict, key, plen: int,
+                 client_headers: dict):
+        self.payload = payload
+        self.key = key
+        self.plen = plen
+        self.rid = payload.get("request_id")
+        self.client_headers = client_headers
+        # flips False the moment the stream breaks the cursor contract
+        # (a cursor-less legacy replica, a splice gap): from then on
+        # the gateway is the PR 14 transparent relay again
+        self.resumable = True
+
+    def body(self) -> bytes:
+        return json.dumps(self.payload).encode()
+
+    def resume_body(self, emitted: list) -> bytes:
+        p = dict(self.payload)
+        p["resume_from"] = len(emitted)
+        p["emitted"] = list(emitted)
+        return json.dumps(p).encode()
+
+
 class Gateway:
     """The router + failover engine behind the gateway App's routes."""
 
@@ -117,6 +161,7 @@ class Gateway:
                  retry_burst: float = 10.0,
                  connect_timeout_s: float = 2.0,
                  stream_timeout_s: float = 120.0,
+                 resume: bool = True, resume_max: int = 3,
                  logger=None, metrics=None, observe=None):
         self.table = table
         self.path = path
@@ -127,12 +172,15 @@ class Gateway:
         self.budget = RetryBudget(ratio=retry_ratio, burst=retry_burst)
         self.connect_timeout_s = float(connect_timeout_s)
         self.stream_timeout_s = float(stream_timeout_s)
+        self.resume = bool(resume)
+        self.resume_max = max(0, int(resume_max))
         self.logger = logger
         self.metrics = metrics
         self.observe = observe  # wide-event recorder + clock registry
         self._lock = threading.Lock()
         self.outcomes = {"ok": 0, "shed": 0, "failed": 0, "midstream": 0}
         self.failovers = {"transport": 0, "drain": 0, "shed": 0}
+        self.resumes = 0
 
     # -- bookkeeping ----------------------------------------------------------
     def _outcome(self, kind: str) -> None:
@@ -251,6 +299,15 @@ class Gateway:
                           "failovers": max(0, st["tried"] - 1),
                           "duration_s": round(now - st["t0"], 6),
                           "submit_wall_s": round(st["submit_wall"], 6)}
+            if st.get("resumes"):
+                # a stream that died mid-relay and was spliced back is
+                # its own terminal outcome — dashboards count resumes
+                # without joining on fields
+                wide["outcome"] = "resumed"
+                wide["resume_count"] = st["resumes"]
+                wide["resumed_at_cursor"] = st.get("resumed_at_cursor")
+                if st.get("recompute_tokens") is not None:
+                    wide["recompute_tokens"] = st["recompute_tokens"]
             bd = {k: round(v, 6) for k, v in st["bd"].items()}
             if bd:
                 wide["breakdown"] = bd
@@ -278,9 +335,36 @@ class Gateway:
         except Exception:
             pass  # telemetry must never take the relay down
 
+    def _resume_ctx(self, ctx, body: bytes, key, plen) -> _ResumeCtx | None:
+        """Stamp the forward body for durability: a request id (the
+        dedup identity a resumed replay carries) and, for sampled
+        requests, a pinned seed (resume-exact sampling re-keys on
+        (seed, absolute position) — the continuation must draw from
+        the same stream the dead replica did). None when resume is off
+        or the body isn't the generate contract (the gateway stays a
+        transparent relay for anything else)."""
+        if not self.resume or self.resume_max <= 0:
+            return None
+        try:
+            payload = json.loads(body)
+        except Exception:  # noqa: BLE001 — unreachable after key parse
+            return None
+        if not isinstance(payload, dict) or not isinstance(
+                payload.get("tokens"), list):
+            return None
+        if not payload.get("request_id"):
+            payload["request_id"] = f"gw-{uuid.uuid4().hex[:16]}"
+        if (payload.get("temperature") or 0) \
+                and payload.get("seed") is None:
+            payload["seed"] = random.getrandbits(31)
+        return _ResumeCtx(payload, key, plen, dict(ctx.request.headers))
+
     def _relay_attempts(self, ctx, st: dict):
         body = ctx.request.body or b""
         key, plen = self._affinity_key(body)
+        rctx = self._resume_ctx(ctx, body, key, plen)
+        if rctx is not None:
+            body = rctx.body()
         headers, read_timeout = self._forward_headers(ctx.request.headers)
         # hop stamp: when THIS hop forwarded, on the gateway's wall
         # clock — /debug/request places the gateway->replica gap with it
@@ -354,15 +438,22 @@ class Gateway:
                 self._failover("transport", replica)
                 continue
             if kind == "stream":
-                # COMMIT: the first token is in hand — relay verbatim
+                # the first token is in hand: requests_total counts
+                # here, but with durable streams this is no longer the
+                # commit point — the resume relay keeps the request
+                # recoverable until the terminal chunk
                 replica.mark_up()
                 with replica._lock:
                     replica.relayed += 1
                 self._outcome("ok")
-                ctx.stream(relay_lines(
-                    first, payload, replica,
-                    retry_after=replica.reconnect.retry_after(),
-                    on_loss=self._on_midstream_loss))
+                if rctx is not None:
+                    ctx.stream(self._relay_resume(
+                        st, first, payload, replica, rctx))
+                else:
+                    ctx.stream(relay_lines(
+                        first, payload, replica,
+                        retry_after=replica.reconnect.retry_after(),
+                        on_loss=self._on_midstream_loss))
                 return None
             r: ReplicaResponse = payload
             if r.status == 429:
@@ -404,6 +495,226 @@ class Gateway:
             "no replica could serve (all down, draining, or tried)",
             retry_after=self.table.retry_after_hint())
 
+    # -- durable streams: the post-commit auto-resume relay -------------------
+    def _relay_resume(self, st: dict, first: bytes, stream,
+                      replica: Replica, rctx: _ResumeCtx):
+        """``relay_lines``' durable twin: the commit point moves from
+        "first token" to "stream end". Cursor-carrying lines are
+        tracked as the client's authoritative emitted list; on a
+        mid-stream loss (transport truncation, OR a typed error line
+        carrying a resume token — the replica's engine declared the
+        death itself) the loop re-picks via the ring, replays
+        prompt+emitted as a ``continue_from`` continuation, validates
+        the splice cursor, and keeps relaying: zero duplicate, zero
+        missing tokens. Replayed-duplicate lines (cursor below the
+        client's position) are swallowed, so even an over-replaying
+        replica can't double-deliver. Only when resume is exhausted
+        does the typed error line go out — carrying the resume token
+        so the client can continue on its own."""
+        emitted: list = [int(t) for t in
+                         (rctx.payload.get("emitted") or [])]
+        cur = (first, stream, replica)
+        while True:
+            line, strm, rep = cur
+            loss: BaseException | None = None
+            transport = False
+            with rep._lock:
+                rep.inflight += 1
+            try:
+                while line is not None:
+                    try:
+                        obj = json.loads(line)
+                    except Exception:  # noqa: BLE001 — non-JSON payload
+                        obj = None
+                    if isinstance(obj, dict) and "token" in obj \
+                            and "cursor" in obj:
+                        cursor = int(obj["cursor"])
+                        if cursor == len(emitted):
+                            emitted.append(int(obj["token"]))
+                            yield line
+                        elif cursor < len(emitted):
+                            pass  # replayed duplicate: client has it
+                        else:
+                            # cursor gap: the contract broke — stop
+                            # trusting resume, stay a transparent relay
+                            rctx.resumable = False
+                            yield line
+                    elif isinstance(obj, dict) and "error" in obj:
+                        err = (obj["error"]
+                               if isinstance(obj["error"], dict) else {})
+                        if err.get("resume") is not None and \
+                                int(err.get("status", 0)) in (429, 503):
+                            # the replica PROCESS is alive (it spoke) —
+                            # one engine stream died; resume without
+                            # marking the replica down
+                            loss = TransportLoss(
+                                "replica ended mid-stream: "
+                                + str(err.get("message", ""))[:200])
+                            break
+                        yield line
+                        return  # terminal typed line: relay + end
+                    else:
+                        rctx.resumable = False  # cursor-less replica
+                        yield line
+                    try:
+                        chaos.fire(chaos.GATEWAY_MIDSTREAM)
+                        line = strm.next_line()
+                    except (TransportLoss, OSError) as e:
+                        loss, transport = e, True
+                        break
+                    except Exception as e:  # noqa: BLE001 — chaos seam
+                        loss, transport = e, True
+                        break
+            finally:
+                with rep._lock:
+                    rep.inflight -= 1
+                strm.close()
+            if loss is None:
+                return  # clean terminal chunk: the durable commit
+            if transport:
+                rep.mark_down()
+            nxt = self._resume_attempt(st, rep, rctx, emitted,
+                                       exclude_dead=transport)
+            if nxt is None:
+                self._on_midstream_loss(rep, loss)
+                yield self._resume_error_line(rep, rctx, emitted)
+                return
+            cur = nxt
+
+    def _resume_attempt(self, st: dict, dead: Replica,
+                        rctx: _ResumeCtx, emitted: list, *,
+                        exclude_dead: bool = True):
+        """One auto-resume: budget + deadline + attempt-cap gated
+        re-pick and continuation forward. Routing prefers the ring
+        successor for the SAME affinity key — the replica whose T2
+        namespace covers the prompt+emitted chain warm. Returns the
+        next ``(first_line, stream, replica)`` or None when the typed
+        line must go out after all. A replica whose engine killed one
+        stream (typed loss) stays eligible — it is alive and has the
+        warmest cache of anyone."""
+        if not rctx.resumable or not emitted:
+            return None
+        if st.get("resumes", 0) >= self.resume_max:
+            return None
+        dl = current_deadline()
+        if dl is not None and dl.remaining() <= 0:
+            return None
+        t0 = time.monotonic()
+        try:
+            headers, read_timeout = self._forward_headers(
+                rctx.client_headers)
+            headers["X-Obs-Hop"] = repr(time.time())
+            body = rctx.resume_body(emitted)
+            tried: set[int] = {dead.idx} if exclude_dead else set()
+            n = len(self.table)
+            attempts = 0
+            while attempts < n:
+                attempts += 1
+                if not self.budget.withdraw():
+                    self._exhausted()
+                    return None
+                try:
+                    rep, label = self.router.pick(
+                        rctx.key, rctx.plen + len(emitted),
+                        exclude=tried)
+                except Exception:  # noqa: BLE001 — nobody pickable
+                    return None
+                tried.add(rep.idx)
+                st["tried"] = st.get("tried", 0) + 1
+                try:
+                    kind, payload = forward(
+                        rep, self.path, body, headers,
+                        connect_timeout_s=self.connect_timeout_s,
+                        read_timeout_s=read_timeout)
+                except Exception:  # noqa: BLE001 — attempt loss
+                    rep.mark_down()
+                    continue
+                if kind != "stream":
+                    r: ReplicaResponse = payload
+                    if r.status == 429:
+                        rep.note_shed(r.header("X-Shed-Reason"),
+                                      r.retry_after())
+                        continue
+                    if r.status == 503:
+                        rep.mark_drain(r.retry_after())
+                        continue
+                    return None  # non-retriable: typed line goes out
+                try:
+                    nfirst = first_line(payload)
+                    obj = json.loads(nfirst)
+                    if int(obj["cursor"]) > len(emitted):
+                        raise ValueError("splice cursor gap")
+                except Exception:  # noqa: BLE001 — broken splice
+                    # 200 but not the resume contract (legacy replica
+                    # regenerating from scratch): relaying would
+                    # duplicate tokens — drop the attempt, not resume
+                    payload.close()
+                    continue
+                rep.mark_up()
+                with rep._lock:
+                    rep.relayed += 1
+                st["resumes"] = st.get("resumes", 0) + 1
+                st["resumed_at_cursor"] = len(emitted)
+                if isinstance(obj, dict) and "recompute" in obj:
+                    st["recompute_tokens"] = int(obj["recompute"])
+                st["replica"], st["route"] = rep.address, label
+                self._note_resume(dead, rep, st.get("recompute_tokens"))
+                return nfirst, payload, rep
+            return None
+        finally:
+            st["bd"]["resume_s"] = st["bd"].get("resume_s", 0.0) \
+                + (time.monotonic() - t0)
+
+    def _note_resume(self, lost: Replica, to: Replica,
+                     recompute) -> None:
+        with self._lock:
+            self.resumes += 1
+        if self.metrics is not None:
+            try:
+                self.metrics.increment_counter(
+                    "app_tpu_gateway_resumes_total")
+                if recompute is not None:
+                    span = tracing.current_span()
+                    self.metrics.record_histogram(
+                        "app_tpu_resume_recompute_tokens",
+                        float(recompute),
+                        exemplar=(span.trace_id if span is not None
+                                  else None))
+            except Exception:
+                pass
+        if self.logger is not None:
+            self.logger.info({"event": "gateway stream resumed",
+                              "from": lost.address, "to": to.address,
+                              "recompute_tokens": recompute})
+
+    def _resume_error_line(self, rep: Replica, rctx: _ResumeCtx,
+                           emitted: list) -> bytes:
+        """The exhausted-resume terminal: the legacy typed 503 line,
+        plus the resume token when the stream is still continuable —
+        the client (service/client.py) can pick up where the gateway's
+        budget ran out."""
+        retry_after = rep.reconnect.retry_after()
+        if not rctx.resumable or not emitted:
+            return error_line(f"replica {rep.address} lost mid-stream",
+                              status=503, retry_after=retry_after)
+        detail: dict = {
+            "message": f"replica {rep.address} lost mid-stream and "
+                       "auto-resume is exhausted",
+            "status": 503, "retry_after": round(float(retry_after), 3)}
+        resume: dict = {"request_id": rctx.rid, "cursor": len(emitted)}
+        seed = rctx.payload.get("seed")
+        if seed is not None:
+            resume["seed"] = int(seed)
+        try:
+            from ..serving import resume_chain
+            resume["chain"] = resume_chain(
+                rctx.payload["tokens"], emitted, self.block,
+                int(rctx.payload.get("adapter", 0) or 0))
+        except Exception:
+            pass  # fingerprint is advisory; the token works without it
+        detail["resume"] = resume
+        return (json.dumps({"error": detail}) + "\n").encode()
+
     def _on_midstream_loss(self, replica: Replica, err) -> None:
         replica.mark_down()
         # NOT an _outcome: this request already counted "ok" at its
@@ -428,8 +739,10 @@ class Gateway:
         with self._lock:
             outcomes = dict(self.outcomes)
             failovers = dict(self.failovers)
+            resumes = self.resumes
         return {"path": self.path, "outcomes": outcomes,
-                "failovers": failovers, "budget": self.budget.stats(),
+                "failovers": failovers, "resumes": resumes,
+                "budget": self.budget.stats(),
                 "router": self.router.stats(),
                 "table": self.table.stats()}
 
@@ -462,6 +775,8 @@ def gateway_from_config(cfg, *, logger=None, metrics=None,
                                         2.0),
         stream_timeout_s=cfg.get_float("TPU_GATEWAY_STREAM_TIMEOUT_S",
                                        120.0),
+        resume=cfg.get_bool("TPU_RESUME", True),
+        resume_max=cfg.get_int("TPU_RESUME_MAX", 3),
         logger=logger, metrics=metrics, observe=observe)
 
 

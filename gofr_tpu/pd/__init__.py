@@ -119,7 +119,12 @@ def wire_role(engine, role: str, cfg, *, logger=None, metrics=None):
         engine.pd_prefill = PDPrefill(
             gen, fingerprint, host, port, logger=logger, metrics=metrics,
             ship_block=max(1, cfg.get_int("TPU_PD_BLOCK", 16)),
-            window_bytes=window)
+            window_bytes=window,
+            # durable streams: a decode-peer death mid-stream re-hands
+            # the relay off as a continuation instead of shedding it
+            resume=cfg.get_bool("TPU_RESUME_PD", True),
+            resume_max=cfg.get_int("TPU_RESUME_MAX", 3),
+            resume_wait_s=cfg.get_float("TPU_RESUME_WAIT_S", 5.0))
         engine.serving_role = ROLE_PREFILL
         if logger is not None:
             logger.info({"event": "pd prefill role wired",

@@ -290,6 +290,13 @@ class KVIngestServer:
                          "tokens received")
             return
         prompt = np.asarray(meta["prompt"], np.int32)
+        # durable streams: a re-handoff REQ carries the already-
+        # delivered tokens — the engine admits prompt+emitted as ONE
+        # continuation prompt (the shipped KV covers the concat), and
+        # the REQ's pinned seed keeps sampled continuations resume-
+        # exact (the PRNG re-keys on absolute token position)
+        emitted = [int(t) for t in (meta.get("resume_emitted") or [])]
+        seed = meta.get("seed")
         try:
             kv = concat_blocks(asm.parts)
             eos = meta.get("eos")
@@ -304,6 +311,8 @@ class KVIngestServer:
                 logprobs=True,
                 deadline=asm.deadline,
                 slo_class=meta.get("slo_class"),
+                seed=int(seed) if seed is not None else None,
+                continue_from=(prompt, emitted) if emitted else None,
                 ingest=(kv, int(eof["first_token"]),
                         float(eof.get("first_lp") or 0.0)),
                 traceparent=meta.get("traceparent"))
@@ -376,7 +385,12 @@ class KVIngestServer:
         and sends END/ERR with a blocking flush."""
         # the FIRST delivered token is skipped: the prefill worker
         # sampled it and already delivered it to the client (TTFT is
-        # the prefill pool's latency); this stream owns tokens 2+
+        # the prefill pool's latency); this stream owns tokens 2+.
+        # Each TOK carries the resume contract's monotone cursor — the
+        # absolute generated-token index of the ORIGINAL request
+        # (stream.cursor_base counts the continuation's replayed
+        # tokens; +1 skips the prefill-delivered first token)
+        base = int(getattr(stream, "cursor_base", 0) or 0) + 1
         sent = [0]
         skipped = [False]
 
@@ -385,7 +399,7 @@ class KVIngestServer:
                 skipped[0] = True
                 return True
             tok, lp = item if isinstance(item, tuple) else (item, None)
-            conn.send(p.pack_tok(req_id, tok, lp))
+            conn.send(p.pack_tok(req_id, tok, base + sent[0], lp))
             sent[0] += 1
             if sent[0] % 32 == 0:
                 # sampled, not per-token: the gauge is a trend line
@@ -402,7 +416,8 @@ class KVIngestServer:
                     skipped[0] = True
                     continue
                 tok, lp = item if isinstance(item, tuple) else (item, None)
-                conn.send(p.pack_tok(req_id, tok, lp), block=True)
+                conn.send(p.pack_tok(req_id, tok, base + sent[0], lp),
+                          block=True)
                 sent[0] += 1
             conn.send(p.pack_json(p.END, req_id,
                                   self._end_payload(sent[0], stream, asm)

@@ -23,11 +23,12 @@ from __future__ import annotations
 
 import itertools
 import json
+import random
 import socket
 import threading
 import time
 
-from ..errors import GofrError
+from ..errors import DeadlineExceeded, GofrError
 from ..resilience import current_deadline, current_slo_class
 from ..service.reconnect import ReconnectBackoff
 from ..tpu.kvcache.quant import concat_blocks, encode_block
@@ -43,17 +44,30 @@ class RelayStream(PushStream):
     by the peer reader thread (or straight into a transport sink),
     terminals follow GenStream's convention (error then None). Carries
     the attribute surface transports read off GenStream (``trace``,
-    ``prompt_len``, ``request_id``, ``cancel``)."""
+    ``prompt_len``, ``request_id``, ``cancel``, and the durable-stream
+    fields ``seed`` / ``cursor_base`` / ``cache_tokens``).
+
+    The stream OUTLIVES any one wire request: a decode-peer loss
+    re-submits the same RelayStream under a fresh ``_wire_id`` (the
+    re-handoff), so the client keeps reading one queue while the
+    request changes wire identity underneath."""
 
     def __init__(self, request_id: int, owner: "PDPrefill",
                  logprobs: bool = False):
         super().__init__()
         self.request_id = request_id
+        self._wire_id = request_id  # current wire req_id (re-handoffs bump)
         self.logprobs = logprobs
         self.prompt_len = 0
         self.trace: dict[str, float] = {}
         self.cancelled = threading.Event()
         self.failed: str | None = None
+        self.seed: int | None = None
+        self.cursor_base = 0       # client-replayed tokens before this stream
+        self.cache_tokens = 0      # copied from the local prefill's stream
+        self.emitted: list[int] = []  # tokens THIS stream delivered
+        self.resumes = 0
+        self.resume_info: dict | None = None  # everything a re-submit needs
         self._owner = owner
         self._local = None  # the prefill-side GenStream while it runs
         self._done = False
@@ -66,7 +80,7 @@ class RelayStream(PushStream):
         local = self._local
         if local is not None:
             local.cancel()
-        self._owner._cancel(self.request_id)
+        self._owner._cancel(self._wire_id)
 
 
 class _Shipper:
@@ -173,7 +187,8 @@ class PDPrefill:
     def __init__(self, generator, fingerprint: str, peer_host: str,
                  peer_port: int, *, logger=None, metrics=None,
                  ship_block: int = 16, window_bytes: int = 8 << 20,
-                 connect_timeout_s: float = 3.0):
+                 connect_timeout_s: float = 3.0, resume: bool = True,
+                 resume_max: int = 3, resume_wait_s: float = 5.0):
         self.gen = generator
         self.fingerprint = fingerprint
         self.peer = (peer_host, int(peer_port))
@@ -182,6 +197,9 @@ class PDPrefill:
         self.ship_block = int(ship_block)
         self.window_bytes = int(window_bytes)
         self.connect_timeout_s = float(connect_timeout_s)
+        self.resume = bool(resume)
+        self.resume_max = max(0, int(resume_max))
+        self.resume_wait_s = float(resume_wait_s)
         import numpy as np
 
         from ..tpu.kvcache import KVLayout
@@ -205,6 +223,7 @@ class PDPrefill:
         self.relayed = 0
         self.reconnects = 0
         self.peer_losses = 0
+        self.resumed = 0
 
     def _note_peer_clock(self, t0, t1, t2, t3, debug_port=None) -> None:
         """Feed one NTP sample for the decode peer into the Observe
@@ -325,9 +344,15 @@ class PDPrefill:
             if rs is None:
                 continue
             if mtype == p.TOK:
-                tok, lp = p.unpack_tok(payload)
+                tok, cursor, lp = p.unpack_tok(payload)
+                # the resume contract's splice check: a token the
+                # client already has (a re-handoff over-replaying)
+                # is swallowed, never double-delivered
+                if cursor < rs.cursor_base + len(rs.emitted):
+                    continue
                 if not rs.trace.get("first_put"):
                     rs.trace["first_put"] = time.monotonic()
+                rs.emitted.append(int(tok))
                 rs._push((tok, lp) if rs.logprobs else tok)
             elif mtype == p.END:
                 t3 = time.time()
@@ -362,12 +387,24 @@ class PDPrefill:
                 rs._q.put(None)
         self._on_conn_lost(conn)
 
+    def _fail_stream(self, rs: RelayStream, err: BaseException) -> None:
+        if rs._done:
+            return
+        rs.failed = str(err)
+        rs._done = True
+        rs._q.put(err)
+        rs._q.put(None)
+
     def _on_conn_lost(self, conn: p.Conn) -> None:
-        """The decode peer vanished (crash, kill, network): every
-        in-flight relay is SHED typed (503 + Retry-After — clients
-        retry like any shed) and the path enters reconnect backoff.
-        This worker's engine is untouched: new prefills keep serving
-        and the next request after the peer returns re-handshakes."""
+        """The decode peer vanished (crash, kill, network). Relays with
+        >= 1 delivered token RESUME (durable streams): a bounded waiter
+        re-handshakes the peer — its restart, or a replacement behind
+        the same address — and re-submits prompt+emitted as a
+        continuation; the client's stream splices token-exact and never
+        sees the loss. Relays with NOTHING delivered are SHED typed
+        (503 + Retry-After) as before: the gateway's pre-commit
+        failover owns those. The path enters reconnect backoff either
+        way; this worker's engine is untouched."""
         with self._conn_lock:
             if self._conn is conn:
                 self._conn = None
@@ -381,19 +418,71 @@ class PDPrefill:
             if self.logger is not None:
                 self.logger.warn({"event": "pd decode peer lost",
                                   "in_flight": len(orphans)})
+        shed: list[RelayStream] = []
+        for req_id, rs in orphans:
+            if (self.resume and rs.emitted and not rs._done
+                    and not rs.cancelled.is_set()
+                    and rs.resumes < self.resume_max
+                    and rs.resume_info is not None):
+                rs.resumes += 1
+                threading.Thread(target=self._resume_relay, args=(rs,),
+                                 name=f"gofr-pd-resume-{req_id}",
+                                 daemon=True).start()
+            else:
+                shed.append(rs)
         err = p.DecodePeerUnavailable(
-            "decode peer lost mid-stream", retry_after=self._reconnect.retry_after())
-        for _, rs in orphans:
-            rs.failed = str(err)
-            rs._done = True
-            rs._q.put(err)
-            rs._q.put(None)
+            "decode peer lost mid-stream",
+            retry_after=self._reconnect.retry_after())
+        for rs in shed:
+            self._fail_stream(rs, err)
         if self.metrics is not None and orphans:
             try:
                 self.metrics.increment_counter(
                     "app_tpu_pd_peer_losses_total")
             except Exception:
                 pass
+
+    def _resume_relay(self, rs: RelayStream) -> None:
+        """The re-handoff waiter: retry the handshake (bounded by
+        ``TPU_RESUME_WAIT_S`` and the request deadline — a restarting
+        decode worker needs a moment to bind) and re-submit the SAME
+        RelayStream as a continuation under a fresh wire req_id.
+        Exhaustion falls back to the legacy typed shed; the typed
+        line's resume token still lets the CLIENT continue."""
+        info = rs.resume_info or {}
+        deadline = info.get("deadline")
+        t_end = time.monotonic() + self.resume_wait_s
+        while not rs.cancelled.is_set() and not rs._done:
+            if deadline is not None and deadline.remaining() <= 0:
+                self._fail_stream(rs, DeadlineExceeded(
+                    "deadline expired while resuming after decode "
+                    "peer loss"))
+                return
+            try:
+                emitted = list(info.get("emitted0") or []) \
+                    + list(rs.emitted)
+                self._submit(rs, emitted)
+            except p.DecodePeerUnavailable as e:
+                if time.monotonic() < t_end:
+                    time.sleep(min(0.25, self.resume_wait_s))
+                    continue
+                self._fail_stream(rs, e)
+                return
+            except BaseException as e:  # noqa: BLE001 — typed fallback
+                self._fail_stream(rs, e)
+                return
+            self.resumed += 1
+            if self.metrics is not None:
+                try:
+                    self.metrics.increment_counter(
+                        "app_tpu_pd_resumes_total")
+                except Exception:
+                    pass
+            if self.logger is not None:
+                self.logger.info({"event": "pd stream resumed",
+                                  "emitted": len(emitted),
+                                  "attempt": rs.resumes})
+            return
 
     def _cancel(self, req_id: int) -> None:
         with self._streams_lock:
@@ -409,22 +498,31 @@ class PDPrefill:
     def generate(self, prompt, max_new_tokens: int = 128,
                  temperature: float = 0.0, top_k: int = 0,
                  eos_id=None, adapter: int = 0, logprobs: bool = False,
-                 deadline=None, slo_class: str | None = None) -> RelayStream:
+                 deadline=None, slo_class: str | None = None,
+                 seed: int | None = None,
+                 continue_from=None) -> RelayStream:
         """The prefill worker's ``generate``: same signature and same
         ambient deadline/SLO pickup as the fused engine's, returning a
-        RelayStream of the decode peer's tokens."""
+        RelayStream of the decode peer's tokens. ``seed`` /
+        ``continue_from`` follow the generator's durable-streams
+        contract; a sampled request's seed is pinned HERE and crosses
+        the wire in REQ, so a decode-peer re-handoff — and a
+        client-side resume — redraw the exact same sample stream."""
         if deadline is None:
             deadline = current_deadline()
         if slo_class is None:
             slo_class = current_slo_class()
-        conn = self._ensure_conn()
         import numpy as np
 
-        prompt = np.asarray(prompt, np.int32).reshape(-1)
-        req_id = next(self._ids)
-        rs = RelayStream(req_id, self, logprobs=logprobs)
-        rs.prompt_len = len(prompt)
-        rs.trace["submit"] = time.monotonic()
+        emitted0: list[int] = []
+        if continue_from is not None:
+            base, em = continue_from
+            prompt = np.asarray(base, np.int32).reshape(-1)
+            emitted0 = [int(t) for t in em]
+        else:
+            prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if temperature > 0 and seed is None:
+            seed = random.getrandbits(31)
         traceparent = None
         from .. import tracing
 
@@ -435,17 +533,60 @@ class PDPrefill:
             eos_wire: object = sorted(int(t) for t in eos_id)
         else:
             eos_wire = int(eos_id) if eos_id is not None else None
-        meta = {"prompt": prompt.tolist(), "plen": int(len(prompt)),
-                "max_new": int(max_new_tokens),
-                "temperature": float(temperature), "top_k": int(top_k),
-                "eos": eos_wire, "adapter": int(adapter),
-                "slo_class": slo_class,
+        rs = RelayStream(0, self, logprobs=logprobs)
+        rs.prompt_len = int(len(prompt)) + len(emitted0)
+        rs.cursor_base = len(emitted0)
+        rs.seed = seed
+        rs.trace["submit"] = time.monotonic()
+        rs.resume_info = {
+            "prompt": prompt, "emitted0": emitted0,
+            "max_new": int(max_new_tokens),
+            "temperature": float(temperature), "top_k": int(top_k),
+            "eos_id": eos_id, "eos_wire": eos_wire,
+            "adapter": int(adapter), "slo_class": slo_class,
+            "deadline": deadline, "traceparent": traceparent,
+            "seed": seed}
+        self._submit(rs, emitted0)
+        self.relayed += 1
+        if self.metrics is not None:
+            try:
+                self.metrics.increment_counter("app_tpu_pd_requests_total",
+                                               role="prefill")
+            except Exception:
+                pass
+        return rs
+
+    def _submit(self, rs: RelayStream, emitted: list) -> None:
+        """Submit — or RE-submit after a decode-peer loss — one relay
+        under a fresh wire req_id. The local KV-only prefill admits
+        prompt+emitted as a continuation when tokens were already
+        delivered: a warm re-handoff recomputes only the un-cached
+        tail, and the shipped KV covers the whole concat (the decode
+        side's plen check holds)."""
+        info = rs.resume_info or {}
+        conn = self._ensure_conn()
+        req_id = next(self._ids)
+        rs._wire_id = req_id
+        if not rs.request_id:
+            rs.request_id = req_id
+        prompt = info["prompt"]
+        deadline = info["deadline"]
+        meta = {"prompt": prompt.tolist(),
+                "plen": int(len(prompt)) + len(emitted),
+                "max_new": info["max_new"],
+                "temperature": info["temperature"],
+                "top_k": info["top_k"], "eos": info["eos_wire"],
+                "adapter": info["adapter"],
+                "slo_class": info["slo_class"],
                 "deadline_s": (round(deadline.remaining(), 6)
                                if deadline is not None else None),
-                "traceparent": traceparent,
+                "traceparent": info["traceparent"],
+                "seed": info["seed"],
                 # hop stamp: echoed back in END so every relayed request
                 # doubles as a clock sample (observe/clock.py)
                 "sent_wall": time.time()}
+        if emitted:
+            meta["resume_emitted"] = [int(t) for t in emitted]
         with self._streams_lock:
             self._streams[req_id] = rs
         shipper = _Shipper(conn, req_id, self.ship_block,
@@ -456,10 +597,13 @@ class PDPrefill:
             # again, and the peer must already know the request
             conn.send(p.pack_json(p.REQ, req_id, meta), block=True)
             local = self.gen.generate(
-                prompt, max_new_tokens=max_new_tokens,
-                temperature=temperature, top_k=top_k, eos_id=eos_id,
-                adapter=adapter, logprobs=True, deadline=deadline,
-                slo_class=slo_class, kv_sink=shipper.ship)
+                prompt, max_new_tokens=info["max_new"],
+                temperature=info["temperature"], top_k=info["top_k"],
+                eos_id=info["eos_id"], adapter=info["adapter"],
+                logprobs=True, deadline=deadline,
+                slo_class=info["slo_class"], kv_sink=shipper.ship,
+                seed=info["seed"],
+                continue_from=((prompt, emitted) if emitted else None))
         except (EOFError, OSError) as e:
             # the peer died under the REQ send: a SHED, not a 500 —
             # the typed-503 contract holds at every loss site
@@ -475,14 +619,6 @@ class PDPrefill:
                                                     local, shipper),
                          name=f"gofr-pd-finish-{req_id}",
                          daemon=True).start()
-        self.relayed += 1
-        if self.metrics is not None:
-            try:
-                self.metrics.increment_counter("app_tpu_pd_requests_total",
-                                               role="prefill")
-            except Exception:
-                pass
-        return rs
 
     def _finish(self, conn: p.Conn, req_id: int, rs: RelayStream,
                 local, shipper: _Shipper) -> None:
@@ -498,6 +634,12 @@ class PDPrefill:
             first, first_lp = toks[0]
             shipper.finish()
             rs.trace["prefill_done"] = time.monotonic()
+            # durable-stream surface: how warm THIS prefill ran (the
+            # resume contract's recompute report) and the engine's
+            # pinned auto-seed, for resume tokens
+            rs.cache_tokens = int(getattr(local, "cache_tokens", 0) or 0)
+            if getattr(local, "seed", None) is not None:
+                rs.seed = int(local.seed)
             # FIRST TOKEN LEAVES HERE, from the prefill pool: TTFT is
             # the prefill worker's latency alone — no handoff, no
             # decode-slot wait on its critical path (the decode worker
@@ -505,11 +647,15 @@ class PDPrefill:
             # push precedes KV_EOF, so wire tokens can only follow it.
             if not rs._done:
                 rs.trace.setdefault("first_put", time.monotonic())
+                rs.emitted.append(int(first))
                 rs._push((int(first), float(first_lp)) if rs.logprobs
                          else int(first))
             conn.send(p.pack_json(p.KV_EOF, req_id, {
                 "first_token": int(first), "first_lp": float(first_lp),
-                "plen": rs.prompt_len, "blocks": shipper.frames}),
+                # THIS submit's prefill length (a re-handoff's concat
+                # is longer than the original rs.prompt_len)
+                "plen": int(getattr(local, "prompt_len", rs.prompt_len)),
+                "blocks": shipper.frames}),
                 block=True)
         except BaseException as e:  # noqa: BLE001 — typed per-request fail
             err: BaseException = shipper.error or e
@@ -518,7 +664,10 @@ class PDPrefill:
                     "decode peer lost during kv ship",
                     retry_after=self._reconnect.retry_after())
             self._cancel(req_id)
-            if not rs._done:
+            # a re-handoff may have re-submitted this stream under a
+            # NEW wire id while this (old) finisher was dying on the
+            # old connection — never fail a stream someone else owns
+            if not rs._done and rs._wire_id == req_id:
                 rs.failed = str(err)
                 rs._done = True
                 rs._q.put(err)
@@ -531,6 +680,7 @@ class PDPrefill:
                 "connected": self.connected, "in_flight": in_flight,
                 "relayed": self.relayed, "reconnects": self.reconnects,
                 "peer_losses": self.peer_losses,
+                "resumed": self.resumed,
                 "ship_block": self.ship_block,
                 "window_bytes": self.window_bytes}
 

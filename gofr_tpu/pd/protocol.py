@@ -23,14 +23,25 @@ Flow (prefill -> decode unless noted)::
     HELLO {fingerprint, layers, kv_heads, head_dim, version}
     <- HELLO_OK {}                      (or ERR req_id=0: refuse + close)
     REQ  {prompt, max_new, temperature, top_k, eos, adapter,
-          slo_class, deadline_s, traceparent, plen}
+          slo_class, deadline_s, traceparent, plen, seed,
+          resume_emitted?}              (resume_emitted marks a durable-
+                                         stream re-handoff: the decode
+                                         side admits prompt+emitted as a
+                                         ``continue_from`` continuation)
     KV   [start][frame] ...             (streamed per ship block, in
                                          token order, as prefill chunks
                                          complete — ingest assembly
                                          overlaps prefill compute and
                                          wire transfer)
     KV_EOF {first_token, first_lp, plen, blocks}
-    <- TOK [i32 token][f32 lp] ...      (decode -> prefill, per token)
+    <- TOK [i32 token][i32 cursor][f32 lp] ...
+                                        (decode -> prefill, per token;
+                                         cursor = absolute generated-
+                                         token index of the ORIGINAL
+                                         request — the stream resume
+                                         contract's monotone cursor,
+                                         so a re-handoff splices
+                                         token-exact)
     <- END {tokens}                     (or <- ERR {code, message,
                                          retry_after})
     CANCEL {}                           (prefill -> decode, either
@@ -56,7 +67,8 @@ from ..errors import (DeadlineExceeded, HTTPError, ServiceUnavailable,
                       TooManyRequests, format_retry_after)
 from ..wire import Outbox, SocketWriter
 
-PD_VERSION = 1
+PD_VERSION = 2  # v2: TOK carries the resume cursor; REQ carries
+#                 seed / resume_emitted (durable streams, PR 18)
 
 # message types
 HELLO = 0
@@ -71,7 +83,8 @@ CANCEL = 8
 
 _HEAD = struct.Struct("<IBI")   # length, type, req_id
 _KV_START = struct.Struct("<I")
-_TOK = struct.Struct("<if")     # token id, logprob (f32: wire precision)
+_TOK = struct.Struct("<iif")    # token id, cursor, logprob (f32: wire
+#                                 precision)
 
 # one message may carry at most this much (a KV frame for one ship
 # block of a 70B-class model is ~MBs; anything past this is a framing
@@ -141,12 +154,13 @@ def pack_kv(req_id: int, start: int, frame: bytes) -> bytes:
     return pack_msg(KV, req_id, _KV_START.pack(start) + frame)
 
 
-def pack_tok(req_id: int, token: int, lp: float | None) -> bytes:
-    return pack_msg(TOK, req_id, _TOK.pack(int(token),
-                                           0.0 if lp is None else float(lp)))
+def pack_tok(req_id: int, token: int, cursor: int,
+             lp: float | None) -> bytes:
+    return pack_msg(TOK, req_id, _TOK.pack(
+        int(token), int(cursor), 0.0 if lp is None else float(lp)))
 
 
-def unpack_tok(payload) -> tuple[int, float]:
+def unpack_tok(payload) -> tuple[int, int, float]:
     return _TOK.unpack(bytes(payload[:_TOK.size]))
 
 

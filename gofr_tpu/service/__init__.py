@@ -15,7 +15,7 @@ any combination composes: ``new_http_service(addr, log, metrics,
 CircuitBreakerOption(...), BasicAuthOption(...), HealthOption(...))``.
 """
 
-from .client import HTTPService, Response, new_http_service
+from .client import HTTPService, Response, new_http_service, stream_generate
 from .circuit_breaker import CircuitBreaker, CircuitBreakerOption, CircuitOpenError
 from .reconnect import ReconnectBackoff
 from .retry import Retry, RetryOption
@@ -26,6 +26,7 @@ __all__ = [
     "HTTPService",
     "Response",
     "new_http_service",
+    "stream_generate",
     "CircuitBreaker",
     "CircuitBreakerOption",
     "CircuitOpenError",

@@ -11,15 +11,19 @@ Reference: pkg/gofr/service/new.go —
 
 from __future__ import annotations
 
+import http.client
 import json
 import time
 import urllib.error
 import urllib.parse
 import urllib.request
+import uuid
 from typing import Any, Mapping
 
 from .. import chaos
 from ..datasource import Health, STATUS_DOWN, STATUS_UP
+from ..errors import HTTPError, ServiceUnavailable
+from ..resilience import current_deadline
 from .wrap import VerbSurface, hop_context
 
 
@@ -162,3 +166,93 @@ def new_http_service(address: str, logger=None, metrics=None, *options,
     for opt in options:
         svc = opt.add_option(svc)
     return svc
+
+
+def stream_generate(service, body: Mapping[str, Any],
+                    path: str = "/generate", *, max_resumes: int = 3):
+    """Streaming ``/generate`` call honoring the durable-streams
+    resume contract (docs/advanced-guide/resilience.md) — gofr-to-gofr
+    calls get mid-stream durability without a gateway hop.
+
+    Yields token ids as ndjson lines arrive. On a mid-stream loss —
+    the typed error line's resume token, or raw transport truncation
+    after >= 1 token — the call re-POSTs the continuation (prompt +
+    received tokens, same ``request_id``/``seed``) under the ambient
+    Deadline, bounded by ``max_resumes``; replayed duplicates (cursor
+    below our position) are swallowed, so the yielded stream is
+    token-exact across any number of server deaths.
+
+    ``service`` is an HTTPService (or any object with ``address``) or
+    a bare ``host:port`` string. Pre-first-token failures raise typed
+    (the caller's own retry policy owns those — nothing was
+    delivered)."""
+    address = str(getattr(service, "address", service)).rstrip("/")
+    if not address.startswith("http"):
+        address = f"http://{address}"
+    url = f"{address}/{path.lstrip('/')}"
+    base_timeout = float(getattr(service, "timeout", 120.0))
+    payload = dict(body)
+    emitted = [int(t) for t in (payload.get("emitted") or [])]
+    if not payload.get("request_id"):
+        # the dedup identity a resumed replay carries — chosen before
+        # the first POST so a dead server never holds the only copy
+        payload["request_id"] = f"cl-{uuid.uuid4().hex[:16]}"
+    resumes = 0
+    while True:
+        hdrs = {"Content-Type": "application/json"}
+        timeout = hop_context(hdrs, base_timeout)
+        resume: dict | None = None
+        try:
+            chaos.fire(chaos.SERVICE_REQUEST)
+            req = urllib.request.Request(
+                url, data=json.dumps(payload).encode(), method="POST",
+                headers=hdrs)
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                for raw in resp:
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    obj = json.loads(line)
+                    if "token" in obj:
+                        cursor = int(obj.get("cursor", len(emitted)))
+                        if cursor < len(emitted):
+                            continue  # replayed duplicate: we have it
+                        emitted.append(int(obj["token"]))
+                        yield int(obj["token"])
+                        continue
+                    err = (obj.get("error") or {}) \
+                        if isinstance(obj, dict) else {}
+                    resume = err.get("resume")
+                    if resume is None:
+                        raise HTTPError(
+                            str(err.get("message", "stream failed")),
+                            status_code=int(err.get("status", 503)))
+                    break
+            if resume is None:
+                return  # clean end: the stream completed
+            if isinstance(resume, dict) and resume.get("seed") \
+                    is not None and payload.get("seed") is None:
+                payload["seed"] = int(resume["seed"])
+        except urllib.error.HTTPError as e:
+            # a buffered non-2xx (shed, drain, bad request): typed,
+            # never resumed blind — nothing streamed on this attempt
+            data = e.read()
+            try:
+                msg = json.loads(data)["error"]["message"]
+            except Exception:  # noqa: BLE001 — non-envelope body
+                msg = data.decode("utf-8", "replace")[:200]
+            raise HTTPError(msg, status_code=e.code) from e
+        except (OSError, http.client.HTTPException,
+                urllib.error.URLError):
+            if not emitted:
+                raise  # pre-first-token: the caller's retry owns it
+            resume = {}  # transport truncation mid-stream: resume blind
+        resumes += 1
+        dl = current_deadline()
+        if resumes > max_resumes or (dl is not None
+                                     and dl.remaining() <= 0):
+            raise ServiceUnavailable(
+                f"stream lost after {len(emitted)} tokens and client "
+                f"resume is exhausted ({resumes - 1} resumes)")
+        payload["resume_from"] = len(emitted)
+        payload["emitted"] = list(emitted)

@@ -368,7 +368,10 @@ class TPUEngine:
         worker (``TPU_SERVING_ROLE=prefill``) this routes through the
         P/D coordinator: local prefill-only compute, KV shipped to the
         decode pool, tokens relayed back — same signature, same
-        ambient deadline/SLO pickup, the handler never knows."""
+        ambient deadline/SLO pickup, the handler never knows. The
+        durable-streams params (``seed``, ``continue_from``) pass
+        through on both paths, so a resumed continuation admits
+        identically on fused, prefill and decode workers."""
         if self.pd_prefill is not None:
             return self.pd_prefill.generate(*args, **kw)
         if self.generator is None:

@@ -265,6 +265,15 @@ class GenStream(PushStream):
         # "mid-decode"; "post-handoff" for ingested P/D requests — the
         # decode-side record that a request died AFTER the pool boundary)
         self.where: str | None = None
+        # durable-stream resume state (docs/advanced-guide/resilience.md
+        # "stream resume contract"): ``cursor_base`` is the absolute
+        # generated-token index this stream CONTINUES from (0 for a
+        # fresh request) — token i of this stream sits at absolute
+        # cursor ``cursor_base + i``; ``seed`` is the per-request
+        # sampling seed the resume token must carry so a continuation
+        # re-keys the PRNG identically (None for greedy requests)
+        self.cursor_base = 0
+        self.seed: int | None = None
 
     def tokens(self) -> list[int]:
         """Drain the whole stream (blocking) into a list of ids
@@ -279,7 +288,7 @@ class _Request:
     __slots__ = ("stream", "prompt", "max_new", "temperature", "top_k",
                  "eos_id", "adapter", "enqueued_at", "lattice_peek",
                  "kv_match", "deadline", "slo_class", "kv_sink",
-                 "kv_shipped", "ingest")
+                 "kv_shipped", "ingest", "seed", "pos_base")
 
     @property
     def logprobs(self) -> bool:
@@ -317,6 +326,14 @@ class _Request:
         self.kv_sink = None
         self.kv_shipped = 0
         self.ingest: "tuple | None" = None
+        # per-request sampling seed (int32; 0 for greedy) and the
+        # absolute generated-token index this request resumes from —
+        # together they re-key every sample on ABSOLUTE position
+        # (fold_in(PRNGKey(seed), pos)), which is what makes a
+        # mid-stream continuation sample-exact: token P of a resumed
+        # stream consumes the key token P of the original would have
+        self.seed = 0
+        self.pos_base = 0
 
 
 class _Inflight:
@@ -707,9 +724,20 @@ class GenerationEngine:
         self._budgets = np.zeros((slots,), np.int32)
         self._eos_mat = np.full((slots, self.EOS_MAX), llama.EOS_PAD,
                                 np.int32)
+        # durable-streams sampling state: each slot's request seed and
+        # the absolute generated-token position of its next sample
+        # (pos_base + delivered count) — see _resume_keys
+        self._slot_seed = np.zeros((slots,), np.int32)
+        self._pos_abs = np.zeros((slots,), np.int32)
+        # auto-seed counter for sampled requests submitted without an
+        # explicit seed: deterministic per engine (same engine seed +
+        # same request order -> same streams), and surfaced on the
+        # stream so resume tokens can replay it
+        self._auto_seed = itertools.count(1)
         # the coalesced dispatch pack: every host-owned per-slot decode
         # input (last token, active, budget, temp, top-k, adapter,
-        # host-wins, EOS set, block table) rides to the device as ONE
+        # host-wins, seed, position, EOS set, block table) rides to the
+        # device as ONE
         # [B, W] int32 h2d transfer, rebuilt only when a mirror is
         # dirty — in steady-state decode the dispatch is all-device
         # (cache/key/carry chain from the previous block's outputs)
@@ -929,10 +957,12 @@ class GenerationEngine:
 
         outputs: (token, logprob, next_key, cache) for prefill/
         final-chunk, (tokens, logprobs, emitted, slot-state carry,
-        next_key, cache) for the fused step — the PRNG key chains
-        through every sampling program (split in-trace, no host
-        round-trip per block), and the carry chains the per-slot
-        decode state the pipeline's next dispatch consumes."""
+        next_key, cache) for the fused step — sampling keys derive
+        in-trace from each request's (seed, absolute position) pair
+        (see _resume_keys; the threaded key is signature ballast), and
+        the carry chains the per-slot decode state — last token,
+        active, budget, position — the pipeline's next dispatch
+        consumes."""
         mesh = self.mesh
         if mesh is not None:
             rep = self._rep_sh
@@ -945,8 +975,8 @@ class GenerationEngine:
                                                        cache_sh))
             self._step_jit = jax.jit(step_fn, donate_argnums=(0,),
                                      out_shardings=(rep, rep, rep,
-                                                    (rep, rep, rep), rep,
-                                                    cache_sh))
+                                                    (rep, rep, rep, rep),
+                                                    rep, cache_sh))
             if self._spec_k:
                 verify_fn = (self._paged_verify_fn if self._paged
                              else self._verify_fn)
@@ -1150,17 +1180,32 @@ class GenerationEngine:
 
     # dispatch-pack column layout (_dispatch_pack / _fused_decode_scan
     # must agree): 0 last_token, 1 active, 2 budget, 3 temp (f32 bits),
-    # 4 top_k, 5 adapter, 6 host_wins, 7.. EOS set, then (paged) the
-    # block-table row
-    _PACK_EXTRA = 7
+    # 4 top_k, 5 adapter, 6 host_wins, 7 seed, 8 pos (absolute
+    # generated-token index of the slot's NEXT sample — the host-side
+    # truth the carry merge reads under host_wins), 9.. EOS set, then
+    # (paged) the block-table row
+    _PACK_EXTRA = 9
 
     # -- jitted device functions --------------------------------------------
-    def _sample(self, logits, temps, key, top_ks):
+    @staticmethod
+    def _resume_keys(seeds, pos):
+        """Per-slot sampling keys: fold_in(PRNGKey(seed), position).
+        Re-keying every sample on the request's seed and the ABSOLUTE
+        generated-token position (not the engine's chained key, not a
+        step count) is the durable-streams invariant: a continuation
+        admitted with ``continue_from`` samples token P with exactly
+        the key the original stream would have, on any replica."""
+        return jax.vmap(
+            lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s), p)
+        )(seeds, pos)
+
+    def _sample(self, logits, temps, keys, top_ks):
         """Greedy where temp==0; categorical(logits/temp) otherwise,
         truncated to the request's top-k logits when top_k > 0 — all
-        fused per-slot so mixed-sampling batches stay one program."""
-        B, V = logits.shape
-        keys = jax.random.split(key, B)
+        fused per-slot so mixed-sampling batches stay one program.
+        ``keys`` [B, ...]: one PRNG key per slot, derived by the caller
+        from (request seed, absolute position) — see _resume_keys."""
+        V = logits.shape[-1]
         safe_t = jnp.maximum(temps, 1e-6)[:, None]
         scaled = logits / safe_t
         sampled = jax.vmap(jax.random.categorical)(keys, scaled)
@@ -1181,14 +1226,17 @@ class GenerationEngine:
         return tok, lp
 
     def _prefill_fn(self, cache, params, tokens, length, slot, temp,
-                    top_k, key, adapter=None):
+                    top_k, key, seed, pos, adapter=None):
         """tokens [1, Sb] (padded), length/slot scalars. Writes the slot's
-        KV, sets its cursor, returns (first_token scalar, cache)."""
+        KV, sets its cursor, returns (first_token scalar, cache).
+        ``seed``/``pos``: the request's sampling seed and the absolute
+        position of the token sampled here (pos_base — 0 for a fresh
+        request, the emitted count for a continuation); ``key`` chains
+        through unchanged for signature stability."""
         # flash prefill everywhere: bare Pallas calls do not partition
         # under GSPMD, so on mesh engines ops.flash wraps the kernel in
         # shard_map per head shard (jnp reference when tp would split a
         # KV head) — the mesh= plumbing picks the form.
-        key, sub = jax.random.split(key)  # chained: see _fused_decode_scan
         logits, k, v, _ = llama.prefill_kv(
             params, self.cfg, tokens, jnp.asarray([length]),
             rope_max=self.max_seq, rope_tables=self.rope_tables,
@@ -1197,11 +1245,14 @@ class GenerationEngine:
         lengths = cache.lengths.at[slot].set(length)
         cache = llama.write_kv(cache, k, v, (0, slot, 0, 0, 0), lengths)
         last = logits[0, 0]  # [V] at the true prompt end (logit_pos)
-        tok, lp = self._sample(last[None, :], temp[None], sub, top_k[None])
+        tok, lp = self._sample(last[None, :], temp[None],
+                               self._resume_keys(seed[None], pos[None]),
+                               top_k[None])
         return tok[0], lp[0], key, cache
 
     def _chunk_fn(self, cache, params, tokens, start, slot, total_len,
-                  pos_in_chunk, temp, top_k, key, adapter, sample: bool):
+                  pos_in_chunk, temp, top_k, key, seed, pos, adapter,
+                  sample: bool):
         """Chunked prefill for prompts longer than the largest bucket:
         slice the slot's cache view, run one chunk against it, write back.
         The final chunk (``sample=True``) also sets the slot's cursor to
@@ -1241,8 +1292,9 @@ class GenerationEngine:
             return llama.KVCache(k_new, v_new, lengths, ks, vs)
         lengths = cache.lengths.at[slot].set(total_len)
         last = logits[0, 0]  # [V] at pos_in_chunk (logit_pos)
-        key, sub = jax.random.split(key)  # chained: see _fused_decode_scan
-        tok, lp = self._sample(last[None, :], temp[None], sub, top_k[None])
+        tok, lp = self._sample(last[None, :], temp[None],
+                               self._resume_keys(seed[None], pos[None]),
+                               top_k[None])
         return (tok[0], lp[0], key,
                 llama.KVCache(k_new, v_new, lengths, ks, vs))
 
@@ -1259,24 +1311,30 @@ class GenerationEngine:
 
         ``pack`` [B, W] int32 is the coalesced host dispatch state (one
         h2d when dirty — see _dispatch_pack); ``carry`` is the device
-        slot-state chain (last token, active, budget) returned by the
-        PREVIOUS block — per slot, ``host_wins`` picks which side is
-        the truth (host after admission/retire/verify, device in steady
-        state). Chaining ACTIVE and BUDGET through the device is what
-        makes depth-2 pipelining exact: block N+1 is dispatched before
-        the host has seen block N's tokens, and a stream that hits EOS/
-        budget/capacity inside N self-deactivates via the in-scan stop
-        mask (llama.decode_stop_mask) so N+1 freezes it instead of
-        emitting junk. ``emitted`` [K, B] tells the host exactly which
-        tokens are real — host delivery replays it verbatim, so device
-        stop masks and host retirement stay token-equivalent.
+        slot-state chain (last token, active, budget, position)
+        returned by the PREVIOUS block — per slot, ``host_wins`` picks
+        which side is the truth (host after admission/retire/verify,
+        device in steady state). Chaining ACTIVE and BUDGET through the
+        device is what makes depth-2 pipelining exact: block N+1 is
+        dispatched before the host has seen block N's tokens, and a
+        stream that hits EOS/budget/capacity inside N self-deactivates
+        via the in-scan stop mask (llama.decode_stop_mask) so N+1
+        freezes it instead of emitting junk. ``emitted`` [K, B] tells
+        the host exactly which tokens are real — host delivery replays
+        it verbatim, so device stop masks and host retirement stay
+        token-equivalent.
 
-        The PRNG key chains THROUGH the program (split in-trace, next
-        key returned): the host never dispatches a separate
-        random.split between blocks — through the tunnel that was a
-        full extra roundtrip per block. Key consumption is shape-only
-        (every slot splits every step, active or not), so stop masks
-        never perturb a neighbor slot's sampling."""
+        Sampling keys derive in-trace from the pack's per-request SEED
+        and the carried absolute POSITION (fold_in(PRNGKey(seed), pos))
+        — never from a chained engine key — so a stream interrupted
+        anywhere and resumed via ``generate(continue_from=...)`` samples
+        the identical tokens (the durable-streams contract). Position
+        rides the device carry (not the pack) because under pipelining
+        the host cannot know block N's emitted count when it packs
+        block N+1; it advances only where a token was actually emitted,
+        so delivered token i of a request always consumed position
+        ``pos_base + i``. ``key`` chains through untouched (returned
+        as-is) purely for dispatch-signature stability."""
         E = self.EOS_MAX
         host_tokens = pack[:, 0]
         host_active = pack[:, 1].astype(bool)
@@ -1284,35 +1342,44 @@ class GenerationEngine:
         temps = jax.lax.bitcast_convert_type(pack[:, 3], jnp.float32)
         top_ks = pack[:, 4]
         host_wins = pack[:, 6].astype(bool)
+        seeds = pack[:, 7]
+        host_pos = pack[:, 8]
         eos_ids = pack[:, self._PACK_EXTRA:self._PACK_EXTRA + E]
-        dev_tokens, dev_active, dev_budget = carry
+        dev_tokens, dev_active, dev_budget, dev_pos = carry
         tokens0 = jnp.where(host_wins, host_tokens, dev_tokens)
         active0 = jnp.where(host_wins, host_active, dev_active)
         budget0 = jnp.where(host_wins, host_budget, dev_budget)
+        pos0 = jnp.where(host_wins, host_pos, dev_pos)
         # the host retires one delivered token before the cursor hits
         # capacity (see _deliver's at_capacity): post-step cursors at
         # max_seq - 2 mean the NEXT delivery would reach the bound
         cap = jnp.int32(self.max_seq - 2)
-        keys = jax.random.split(key, self.decode_block + 1)
-        next_key = keys[0]
 
-        def body(carry, step_key):
-            tokens, active, budget, cache = carry
+        def body(carry, _):
+            tokens, active, budget, pos, cache = carry
             logits, stepped = step_model(tokens, cache)
             lengths = jnp.where(active, stepped.lengths, cache.lengths)
             stepped = stepped._replace(lengths=lengths)
-            toks, lps = self._sample(logits, temps, step_key, top_ks)
+            toks, lps = self._sample(logits, temps,
+                                     self._resume_keys(seeds, pos),
+                                     top_ks)
             toks = jnp.where(active, toks, tokens)
             emitted = active
             budget = jnp.where(active, budget - 1, budget)
+            # position advances only where a token was emitted: frozen
+            # slots must not burn positions, or a resume after their
+            # retirement would re-key mid-stream
+            pos = pos + emitted.astype(jnp.int32)
             stop = active & llama.decode_stop_mask(toks, lengths, budget,
                                                    eos_ids, cap)
-            return (toks, active & ~stop, budget, stepped), \
+            return (toks, active & ~stop, budget, pos, stepped), \
                 (toks, lps, emitted)
 
-        (last, active, budget, cache), (toks, lps, emitted) = jax.lax.scan(
-            body, (tokens0, active0, budget0, cache), keys[1:])
-        return toks, lps, emitted, (last, active, budget), next_key, cache
+        (last, active, budget, pos, cache), (toks, lps, emitted) = \
+            jax.lax.scan(body, (tokens0, active0, budget0, pos0, cache),
+                         None, length=self.decode_block)
+        return (toks, lps, emitted, (last, active, budget, pos), key,
+                cache)
 
     def _verify_epilogue(self, logits, window, active, stepped):
         """Shared verify-pass tail: greedy tokens + their logprobs, the
@@ -1340,14 +1407,15 @@ class GenerationEngine:
         return self._fused_decode_scan(cache, pack, carry, key, step_model)
 
     def _paged_prefill_fn(self, cache, params, tokens, length, blocks,
-                          slot, temp, top_k, key, adapter=None):
+                          slot, temp, top_k, key, seed, pos,
+                          adapter=None):
         """Paged admission: prefill the prompt, write its KV into the
         slot's allocated ``blocks`` ([ceil(Sb/T)] int32 — entries past
         the prompt's own blocks point at the trash block so bucket
-        padding lands nowhere), set the cursor, sample the first token."""
+        padding lands nowhere), set the cursor, sample the first token
+        (re-keyed on ``seed``/``pos`` — see _resume_keys)."""
         from ..models import paged_llama
 
-        key, sub = jax.random.split(key)  # chained: see _fused_decode_scan
         # flash prefill everywhere — shard_map'd per head shard on mesh,
         # same contract as the contiguous _prefill_fn
         logits, k, v, _ = llama.prefill_kv(
@@ -1358,7 +1426,9 @@ class GenerationEngine:
         cache = paged_llama.write_prompt_blocks(cache, k, v, blocks, length)
         cache = cache._replace(lengths=cache.lengths.at[slot].set(length))
         last = logits[0, 0]  # [V] at the true prompt end (logit_pos)
-        tok, lp = self._sample(last[None, :], temp[None], sub, top_k[None])
+        tok, lp = self._sample(last[None, :], temp[None],
+                               self._resume_keys(seed[None], pos[None]),
+                               top_k[None])
         return tok[0], lp[0], key, cache
 
     def _paged_verify_fn(self, cache, params, window, active, key, table,
@@ -1447,7 +1517,9 @@ class GenerationEngine:
                  logprobs: bool = False, deadline=None,
                  slo_class: str | None = None,
                  kv_sink=None, ingest=None,
-                 traceparent: str | None = None) -> GenStream:
+                 traceparent: str | None = None,
+                 seed: int | None = None,
+                 continue_from=None) -> GenStream:
         """Enqueue a prompt (sequence of token ids); returns a GenStream
         yielding generated ids as the device produces them.
 
@@ -1489,13 +1561,47 @@ class GenerationEngine:
         ambient trace context — the cross-process propagation seam, so
         both pools' spans join ONE distributed trace and the tail
         sampler's deterministic trace-id verdict keeps or drops the
-        whole handoff together."""
+        whole handoff together.
+
+        Durable streams (docs/advanced-guide/resilience.md): ``seed``
+        fixes the request's sampling PRNG; every sample is keyed on
+        ``fold_in(PRNGKey(seed), absolute_position)``, so the stream is
+        replayable token-exact from any position. Sampled requests
+        without a seed get a deterministic per-engine one (surfaced as
+        ``stream.seed`` for resume tokens). ``continue_from=(prompt,
+        emitted)`` admits a CONTINUATION of an interrupted stream: the
+        prompt + already-emitted tokens prefill as one prompt (the
+        emitted tokens extend the same block-chain hashes the radix
+        index and T2 keys use, so a warm resume prefills only the
+        un-cached tail), ``max_new_tokens`` still counts from the
+        ORIGINAL request (the continuation yields at most
+        ``max_new_tokens - len(emitted)`` more), and sampling resumes
+        at absolute position ``len(emitted)`` — greedy continuations
+        are bit-exact by construction, seeded-sampled ones by the
+        position re-keying."""
         if self._closed:
             raise GenerationError("generation engine is closed")
         if self._draining:
             raise GenerationError("generation engine is draining")
         if self.down is not None:
             raise GenerationError(f"generation engine is down: {self.down}")
+        pos_base = 0
+        if continue_from is not None:
+            base, emitted = continue_from
+            base = np.asarray(base, np.int32).reshape(-1)
+            emitted = np.asarray(emitted, np.int32).reshape(-1)
+            # the continuation's prefill IS prompt + emitted: one
+            # prompt whose block-chain hashes extend the original's, so
+            # the radix index / T1 / T2 tiers cover everything a warm
+            # replica already computed and only the tail re-prefills
+            prompt = np.concatenate([base, emitted])
+            pos_base = int(emitted.size)
+            max_new_tokens = int(max_new_tokens) - pos_base
+            if max_new_tokens <= 0:
+                raise GenerationError(
+                    f"continue_from carries {pos_base} emitted tokens "
+                    "but the request budget allows no more — nothing "
+                    "to resume")
         if kv_sink is not None and ingest is not None:
             raise GenerationError("kv_sink and ingest are exclusive "
                                   "(a request is prefill-only OR "
@@ -1538,11 +1644,21 @@ class GenerationEngine:
             raise GenerationError(
                 f"adapter {adapter} out of range (engine has "
                 f"{self._n_adapters} LoRA adapter slots)")
+        if seed is not None:
+            seed = int(seed) & 0x7FFFFFFF
+        elif temperature > 0:
+            # deterministic per-engine auto-seed: same engine seed +
+            # same submission order -> same streams, and the value is
+            # surfaced on the stream so a resume token can replay it
+            seed = (self._seed * 1000003 + next(self._auto_seed)) \
+                & 0x7FFFFFFF
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         stream = GenStream(next(_REQ_IDS), self, logprobs=logprobs)
         stream.trace["submit"] = time.monotonic()
         stream.prompt_len = len(prompt)
         stream.slo_class = slo_class
+        stream.cursor_base = pos_base
+        stream.seed = seed
         if len(prompt) == 0:
             stream._q.put(GenerationError("empty prompt"))
             stream._q.put(None)
@@ -1624,6 +1740,8 @@ class GenerationEngine:
                                slo_class=slo_class)
                 req.kv_sink = kv_sink
                 req.ingest = ingest
+                req.seed = 0 if seed is None else seed
+                req.pos_base = pos_base
                 self._pending.put(req)
         except BaseException:
             self._obs_end(stream, "failed", error="rejected at admission")
@@ -1760,8 +1878,8 @@ class GenerationEngine:
                                 self._scratch, self.params, toks,
                                 jnp.int32(0), jnp.int32(0), jnp.int32(1),
                                 jnp.int32(0), jnp.float32(0.0),
-                                jnp.int32(0), self._key,
-                                self._adapter1(None)))
+                                jnp.int32(0), self._key, jnp.int32(0),
+                                jnp.int32(0), self._adapter1(None)))
                     if self._paged:
                         # dummy KV lands in the trash block (blocks all
                         # 0); the cursor restore below undoes lengths
@@ -1771,15 +1889,15 @@ class GenerationEngine:
                             self._prefill_jit(
                                 self.cache, self.params, toks, jnp.int32(1),
                                 zeros, jnp.int32(free), jnp.float32(0.0),
-                                jnp.int32(0), self._key,
-                                self._adapter1(None)))
+                                jnp.int32(0), self._key, jnp.int32(0),
+                                jnp.int32(0), self._adapter1(None)))
                     else:
                         _, _, self._key, self.cache = jax.block_until_ready(
                             self._prefill_jit(
                                 self.cache, self.params, toks, jnp.int32(1),
                                 jnp.int32(free), jnp.float32(0.0),
-                                jnp.int32(0), self._key,
-                                self._adapter1(None)))
+                                jnp.int32(0), self._key, jnp.int32(0),
+                                jnp.int32(0), self._adapter1(None)))
                     if chunked_reachable:
                         # chunked-admission lattice: the final chunk
                         # compiles per bucket, mid chunks only at C
@@ -1788,6 +1906,7 @@ class GenerationEngine:
                                 self.cache, self.params, toks, jnp.int32(0),
                                 jnp.int32(free), jnp.int32(1), jnp.int32(0),
                                 jnp.float32(0.0), jnp.int32(0), self._key,
+                                jnp.int32(0), jnp.int32(0),
                                 self._adapter1(None)))
                 if chunked_reachable:
                     toks = jnp.zeros((1, C), jnp.int32)
@@ -1795,7 +1914,7 @@ class GenerationEngine:
                         self.cache, self.params, toks, jnp.int32(0),
                         jnp.int32(free), jnp.int32(0), jnp.int32(0),
                         jnp.float32(0.0), jnp.int32(0), self._key,
-                        self._adapter1(None)))
+                        jnp.int32(0), jnp.int32(0), self._adapter1(None)))
                 if paged_chunks:
                     toks = jnp.zeros((1, C), jnp.int32)
                     self._scratch = jax.block_until_ready(
@@ -1803,6 +1922,7 @@ class GenerationEngine:
                             self._scratch, self.params, toks, jnp.int32(0),
                             jnp.int32(0), jnp.int32(0), jnp.int32(0),
                             jnp.float32(0.0), jnp.int32(0), self._key,
+                            jnp.int32(0), jnp.int32(0),
                             self._adapter1(None)))
                     self.cache = jax.block_until_ready(
                         self._row_to_blocks_jit(
@@ -1996,7 +2116,8 @@ class GenerationEngine:
         np.array copies before conversion: see _dev's aliasing note."""
         return (jnp.asarray(np.array(self._last_tokens)),
                 jnp.asarray(np.array(self._active)),
-                jnp.asarray(np.array(self._budgets)))
+                jnp.asarray(np.array(self._budgets)),
+                jnp.asarray(np.array(self._pos_abs)))
 
     def _dispatch_pack(self):
         """The decode dispatch's ONE host input: every host-owned
@@ -2021,6 +2142,8 @@ class GenerationEngine:
             p[:, 4] = self._top_ks
             p[:, 5] = self._slot_adapter
             p[:, 6] = self._host_wins
+            p[:, 7] = self._slot_seed
+            p[:, 8] = self._pos_abs
             p[:, self._PACK_EXTRA:self._PACK_EXTRA + E] = self._eos_mat
             if self._paged:
                 p[:, self._PACK_EXTRA + E:] = self._table
@@ -2273,8 +2396,8 @@ class GenerationEngine:
             tok, lp, self._key, self.cache = self._prefill_jit(
                 self.cache, self.params, jnp.asarray(padded), jnp.int32(L),
                 jnp.int32(idx), jnp.float32(req.temperature),
-                jnp.int32(req.top_k), self._key,
-                self._adapter1(req))
+                jnp.int32(req.top_k), self._key, jnp.int32(req.seed),
+                jnp.int32(req.pos_base), self._adapter1(req))
             return int(tok), float(lp)
         return self._chunk_lattice("cache", idx, req, pos)
 
@@ -2343,7 +2466,7 @@ class GenerationEngine:
                 jnp.asarray(chunk[None, :]), jnp.int32(pos),
                 jnp.int32(slot), jnp.int32(0), jnp.int32(0),
                 jnp.float32(0.0), jnp.int32(0), self._key,
-                self._adapter1(req)))
+                jnp.int32(0), jnp.int32(0), self._adapter1(req)))
             pos += T
             req.stream.chunks += 1
             if self._tl is not None:
@@ -2393,7 +2516,8 @@ class GenerationEngine:
             getattr(self, attr), self.params, jnp.asarray(final[None, :]),
             jnp.int32(L - Sb), jnp.int32(slot), jnp.int32(L),
             jnp.int32(Sb - 1), jnp.float32(req.temperature),
-            jnp.int32(req.top_k), self._key, self._adapter1(req))
+            jnp.int32(req.top_k), self._key, jnp.int32(req.seed),
+            jnp.int32(req.pos_base), self._adapter1(req))
         setattr(self, attr, new_cache)
         return int(tok), float(lp)
 
@@ -2489,7 +2613,8 @@ class GenerationEngine:
                 self.cache, self.params, jnp.asarray(padded), jnp.int32(L),
                 jnp.asarray(write_blocks, jnp.int32), jnp.int32(idx),
                 jnp.float32(req.temperature), jnp.int32(req.top_k),
-                self._key, self._adapter1(req))
+                self._key, jnp.int32(req.seed), jnp.int32(req.pos_base),
+                self._adapter1(req))
             self._write_table_row(idx)
             return int(tok), float(lp)
         if m > 0:
@@ -3183,6 +3308,11 @@ class GenerationEngine:
         submit = trace.get("submit")
         admit = trace.get("admit")
         now = time.monotonic()
+        if stream.cursor_base and outcome == "finished":
+            # a continuation that ran to completion IS the resumed tail
+            # of an interrupted stream — surface it as its own outcome
+            # so dashboards can count resumes without joining on fields
+            outcome = "resumed"
         wide = self._wide_fields(outcome, stream.trace_id, stream.slo_class)
         wide.update({
             "request_id": stream.request_id,
@@ -3198,6 +3328,14 @@ class GenerationEngine:
             "cache_tier": stream.cache_tier,
             "cache_tokens": stream.cache_tokens,
         })
+        if stream.cursor_base:
+            # durable-streams resume: where the continuation picked up
+            # and how much prefix it actually had to recompute (a warm
+            # resume covers most of prompt+emitted from T1/T2 and
+            # recomputes only the tail)
+            wide["resumed_at_cursor"] = stream.cursor_base
+            wide["recompute_tokens"] = max(
+                0, stream.prompt_len - stream.cache_tokens)
         # critical-path breakdown: the request's life as named segments
         # that SUM to duration_s (each bounded by consecutive trace
         # stamps, so the invariant holds by construction). On a decode
@@ -3439,7 +3577,8 @@ class GenerationEngine:
         self.total_requests += 1
         self._temps[idx] = req.temperature
         self._top_ks[idx] = req.top_k
-        self._touch("temps", "top_ks")
+        self._slot_seed[idx] = req.seed
+        self._touch("temps", "top_ks", "seeds")
         if self._spec_k:
             self._hist_append(idx, int(first))
         self._deliver(idx, slot, first, first_lp)
@@ -3459,9 +3598,13 @@ class GenerationEngine:
                 self._stop_cursors[idx] = min(
                     req.stream.prompt_len + slot.remaining,
                     self.max_seq - 2)
+            # the slot's next sample sits at absolute position
+            # pos_base + delivered-so-far (the prefill's first token
+            # consumed pos_base itself)
+            self._pos_abs[idx] = req.pos_base + slot.generated
             self._host_wins[idx] = True
             self._touch("active", "last_tokens", "host_wins", "budgets",
-                        "eos")
+                        "eos", "pos")
         self._obs_gauges()
 
     def _eos_row(self, idx: int, eos_id) -> None:
@@ -3517,6 +3660,21 @@ class GenerationEngine:
         slot.generated += 1
         slot.remaining -= 1
         self.total_tokens += 1
+        try:
+            # durable-streams chaos seam: a seeded GENERATOR_MIDKILL
+            # (every=N, limit=1) kills THIS stream after exactly N
+            # delivered tokens — the in-process stand-in for a replica
+            # SIGKILL mid-stream, replayable by digest. Only the one
+            # stream dies (typed error + retire); the engine keeps
+            # serving, exactly like a per-request failure.
+            chaos.fire(chaos.GENERATOR_MIDKILL)
+        except BaseException as e:  # noqa: BLE001 — per-request failure
+            req.stream.failed = (f"chaos mid-stream kill after "
+                                 f"{slot.generated} tokens")
+            req.stream._q.put(GenerationError(
+                f"mid-stream kill after {slot.generated} tokens: {e!r}"))
+            self._retire(idx, slot)
+            return
         if req.stream.obs_entry is not None:
             req.stream.obs_entry.tokens = slot.generated
         if self.metrics is not None:
@@ -3563,6 +3721,8 @@ class GenerationEngine:
         self._top_ks[idx] = 0
         self._slot_adapter[idx] = 0
         self._budgets[idx] = 0
+        self._slot_seed[idx] = 0
+        self._pos_abs[idx] = 0
         self._eos_mat[idx, :] = llama.EOS_PAD
         # host wins the next dispatch's merge for this slot: a host-only
         # retirement (cancel, deadline, paged starvation) deactivates a
@@ -4029,13 +4189,18 @@ class GenerationEngine:
         for idx in np.flatnonzero(snap_active):
             s = self._slots[idx]
             self._budgets[idx] = s.remaining if s.request is not None else 0
+            # absolute sampling position mirrors the delivered count
+            # (verify passes are greedy, but the mirror must stay true
+            # for the next decode dispatch's host_wins merge)
+            self._pos_abs[idx] = (s.request.pos_base + s.generated
+                                  if s.request is not None else 0)
             if self._paged:
                 self._stop_cursors[idx] = (
                     min(int(self._cursors[idx]) + s.remaining,
                         self.max_seq - 2)
                     if s.request is not None else 0)
         self._host_wins |= snap_active
-        self._touch("last_tokens", "host_wins", "budgets")
+        self._touch("last_tokens", "host_wins", "budgets", "pos")
 
     def _decode_tick(self) -> "_Inflight | None":
         """Dispatch one fused decode block; the reap fetches [K, B]

@@ -112,7 +112,7 @@ def test_protocol_frame_round_trip():
     try:
         msgs = [pdp.pack_json(pdp.REQ, 7, {"prompt": [1, 2], "plen": 2}),
                 pdp.pack_kv(7, 16, b"\x01" * 40),
-                pdp.pack_tok(7, 123, -1.5),
+                pdp.pack_tok(7, 123, 5, -1.5),
                 pdp.pack_msg(pdp.CANCEL, 7)]
         a.sendall(b"".join(msgs))
         got = [pdp.read_msg(b) for _ in msgs]
@@ -121,8 +121,8 @@ def test_protocol_frame_round_trip():
         assert json.loads(bytes(got[0][2]))["plen"] == 2
         start, frame = pdp.unpack_kv(got[1][2])
         assert start == 16 and frame == b"\x01" * 40
-        tok, lp = pdp.unpack_tok(got[2][2])
-        assert tok == 123 and abs(lp - (-1.5)) < 1e-6
+        tok, cursor, lp = pdp.unpack_tok(got[2][2])
+        assert tok == 123 and cursor == 5 and abs(lp - (-1.5)) < 1e-6
         a.close()
         assert pdp.read_msg(b) is None  # EOF
     finally:

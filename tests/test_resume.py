@@ -1,0 +1,639 @@
+"""Durable streams (ISSUE 18): token-exact mid-stream resume.
+
+The kill matrix, bottom-up:
+
+  - generator continuation: a stream killed after N delivered tokens
+    (the ``generator.midkill`` chaos seam — the in-process stand-in
+    for a replica SIGKILL) resumes via ``continue_from`` bit-exact
+    against the uninterrupted reference, on contiguous, paged and
+    mesh tensor-parallel engines, greedy AND seeded sampling (PRNG
+    re-keyed on absolute position), with the emitted tokens extending
+    the same block-chain the radix index and T2 keys hash — a warm
+    resume recomputes only the chain tail, and a DIFFERENT replica
+    sharing the Redis tier resumes warm too;
+  - the serving route (gofr_tpu/serving.py): cursor lines, the typed
+    mid-stream error line's complete resume token, the continuation
+    admission path, and request-id dedup (idempotent replay);
+  - the gateway's auto-resume: commit point at stream end — a typed
+    engine loss resumes on the SAME replica, a transport loss
+    (``gateway.midstream`` seam) resumes on ANOTHER replica, both
+    spliced with zero duplicate/missing tokens; exhausted resume
+    degrades to the typed line carrying the resume token;
+  - the client half (``service.stream_generate``): transparent
+    auto-resume against a real engine-backed route;
+  - P/D: a decode-worker death mid-stream re-hands the relay off to a
+    restarted decode pool and the stream finishes token-exact.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from gofr_tpu import App, chaos
+from gofr_tpu.config import MapConfig
+from gofr_tpu.datasource.redisclient import RedisClient
+from gofr_tpu.models import LLAMA_CONFIGS, llama
+from gofr_tpu.pd import KVIngestServer, PDPrefill
+from gofr_tpu.serving import GenerateRoute, install_generate, resume_chain
+from gofr_tpu.service import stream_generate
+from gofr_tpu.testutil.redisfake import FakeRedisServer
+from gofr_tpu.tpu import GenerationEngine, GenerationError
+from gofr_tpu.tpu.kvcache import KVCacheOptions, model_fingerprint
+
+TINY = LLAMA_CONFIGS["tiny"]
+BLOCK = 16  # the gateway affinity block (== TPU_GATEWAY_BLOCK below)
+MOD = 997
+
+pytestmark = pytest.mark.chaos  # the kill matrix rides the chaos seams
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init(TINY, jax.random.PRNGKey(1))
+
+
+@pytest.fixture(scope="module")
+def redis_server():
+    srv = FakeRedisServer()
+    yield srv
+    srv.close()
+
+
+def _prompt(n, seed=7):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, TINY.vocab_size, n).tolist()
+
+
+@pytest.fixture(scope="module")
+def cache_eng(params, redis_server):
+    """One contiguous engine with the full tier stack (T0 radix + T1
+    host + T2 redis): the resume matrix's warm-path engine, shared
+    module-wide (each engine costs ~10s of CPU-backend compiles)."""
+    eng = GenerationEngine(
+        TINY, params, slots=2, max_seq=128, prompt_buckets=(16, 32),
+        prefix_cache_slots=2, prefix_store_min=16,
+        kvcache=KVCacheOptions(
+            block=8, host_mb=64,
+            redis=RedisClient(redis_server.host, redis_server.port),
+            epoch_refresh_s=0.0))
+    yield eng
+    eng.close()
+
+
+def _kill_at(eng, prompt, max_new, k, **kw):
+    """Run a stream under a seeded GENERATOR_MIDKILL that fires after
+    exactly ``k`` delivered tokens; return the tokens the consumer got
+    before the typed death."""
+    sched = chaos.ChaosSchedule(seed=0).on(
+        chaos.GENERATOR_MIDKILL, error=RuntimeError, every=k, limit=1)
+    got = []
+    with chaos.scope(sched):
+        st = eng.generate(prompt, max_new_tokens=max_new, **kw)
+        with pytest.raises(GenerationError):
+            for t in st:
+                got.append(int(t))
+    assert len(got) == k, (len(got), k)
+    return got
+
+
+# -- generator continuation: the kill matrix ----------------------------------
+
+def test_contiguous_greedy_kill_resume_token_exact(cache_eng):
+    prompt = _prompt(24, seed=1)
+    ref = cache_eng.generate(prompt, max_new_tokens=12).tokens()
+    for k in (1, 5):
+        got = _kill_at(cache_eng, prompt, 12, k)
+        assert got == ref[:k]
+        cont = cache_eng.generate(prompt, max_new_tokens=12,
+                                  continue_from=(prompt, got))
+        rest = cont.tokens()
+        assert got + rest == ref
+        # the continuation admitted prompt+emitted as one prefill
+        assert cont.prompt_len == len(prompt) + k
+
+
+def test_sampled_kill_resume_exact_same_seed(cache_eng):
+    """Sampled resume is token-exact, not merely distribution-exact:
+    every draw keys off (seed, absolute position), so the continuation
+    draws the identical token at every cursor."""
+    prompt = _prompt(20, seed=3)
+    kw = dict(temperature=0.8, top_k=20, seed=123)
+    ref = cache_eng.generate(prompt, max_new_tokens=10, **kw).tokens()
+    got = _kill_at(cache_eng, prompt, 10, 3, **kw)
+    assert got == ref[:3]
+    cont = cache_eng.generate(prompt, max_new_tokens=10,
+                              continue_from=(prompt, got), **kw)
+    assert got + cont.tokens() == ref
+
+
+def test_auto_seed_surfaced_and_replayable(cache_eng):
+    """An unseeded sampled request picks its own seed and SURFACES it
+    on the stream — the handle a resume token carries so a successor
+    can replay the identical draw stream."""
+    prompt = _prompt(18, seed=5)
+    s1 = cache_eng.generate(prompt, max_new_tokens=6, temperature=0.9,
+                            top_k=10)
+    t1 = s1.tokens()
+    assert s1.seed is not None
+    s2 = cache_eng.generate(prompt, max_new_tokens=6, temperature=0.9,
+                            top_k=10, seed=int(s1.seed))
+    assert s2.tokens() == t1
+    # greedy streams have no seed to surface (nothing is drawn)
+    s3 = cache_eng.generate(prompt, max_new_tokens=2)
+    s3.tokens()
+    assert s3.seed is None
+
+
+def test_warm_resume_recomputes_only_the_chain_tail(cache_eng):
+    """The emitted tokens extend the SAME block chain the radix index
+    hashes: after a full run stored the chain, a kill + resume covers
+    most of prompt+emitted from cache and recomputes only the tail."""
+    prompt = _prompt(32, seed=11)
+    ref = cache_eng.generate(prompt, max_new_tokens=10).tokens()
+    got = _kill_at(cache_eng, prompt, 10, 4)
+    cont = cache_eng.generate(prompt, max_new_tokens=10,
+                              continue_from=(prompt, got))
+    rest = cont.tokens()
+    assert got + rest == ref
+    # prompt(32) + 4 emitted = 36-position prefill; the stored chain
+    # covers >= 24 of them (cache block = 8)
+    assert cont.cache_tokens >= 24, cont.cache_tokens
+    assert cont.prompt_len - cont.cache_tokens <= 16  # tail only
+
+
+def test_t2_cross_replica_resume_is_warm(params, redis_server,
+                                         cache_eng):
+    """The microservice arm: the REPLICA THAT DIED is not the replica
+    that resumes. A second engine sharing only the Redis tier admits
+    the continuation warm via T2 and splices token-exact."""
+    prompt = _prompt(32, seed=9)
+    ref = cache_eng.generate(prompt, max_new_tokens=10).tokens()
+    got = _kill_at(cache_eng, prompt, 10, 5)
+    eng2 = GenerationEngine(
+        TINY, params, slots=2, max_seq=128, prompt_buckets=(16, 32),
+        prefix_cache_slots=2, prefix_store_min=16,
+        kvcache=KVCacheOptions(
+            block=8, host_mb=0,  # no T1: a hit can only be T2
+            redis=RedisClient(redis_server.host, redis_server.port),
+            epoch_refresh_s=0.0))
+    try:
+        cont = eng2.generate(prompt, max_new_tokens=10,
+                             continue_from=(prompt, got))
+        rest = cont.tokens()
+        assert got + rest == ref
+        assert cont.cache_tokens > 0
+        assert eng2.stats()["prefix_cache"]["tiers"]["t2"]["hits"] >= 1
+    finally:
+        eng2.close()
+
+
+def test_paged_kill_resume_token_exact(params):
+    eng = GenerationEngine(TINY, params, slots=2, max_seq=128,
+                           prompt_buckets=(16, 32), paged_blocks=25,
+                           paged_block_size=8)
+    try:
+        prompt = _prompt(20, seed=43)
+        ref = eng.generate(prompt, max_new_tokens=8).tokens()
+        got = _kill_at(eng, prompt, 8, 4)
+        cont = eng.generate(prompt, max_new_tokens=8,
+                            continue_from=(prompt, got))
+        assert got + cont.tokens() == ref
+    finally:
+        eng.close()
+
+
+def test_mesh_tp_kill_resume_token_exact(params):
+    from gofr_tpu.parallel import make_mesh, shard_params
+
+    mesh = make_mesh(tp=2, dp=4)
+    eng = GenerationEngine(TINY, shard_params(params, mesh), slots=2,
+                           max_seq=64, prompt_buckets=(8, 16),
+                           mesh=mesh)
+    try:
+        prompt = _prompt(12, seed=41)
+        ref = eng.generate(prompt, max_new_tokens=8).tokens()
+        got = _kill_at(eng, prompt, 8, 3)
+        cont = eng.generate(prompt, max_new_tokens=8,
+                            continue_from=(prompt, got))
+        assert got + cont.tokens() == ref
+    finally:
+        eng.close()
+
+
+def test_continue_from_exhausted_budget_raises_typed(cache_eng):
+    """max_new counts from the ORIGINAL request: a continuation whose
+    emitted list already spends the whole budget is a typed error, not
+    a zero-token stream."""
+    prompt = _prompt(16, seed=13)
+    ref = cache_eng.generate(prompt, max_new_tokens=4).tokens()
+    with pytest.raises(GenerationError):
+        cache_eng.generate(prompt, max_new_tokens=4,
+                           continue_from=(prompt, ref))
+
+
+# -- the serving route: cursors, typed line, dedup ----------------------------
+
+@pytest.fixture(scope="module")
+def serve_app(cache_eng):
+    app = App(MapConfig({"HTTP_PORT": "0", "METRICS_PORT": "0",
+                         "APP_NAME": "replica", "LOG_LEVEL": "ERROR"}))
+    app.container.tpu = cache_eng
+    route = install_generate(app)
+    app.run(block=False)
+    yield app, route
+    app.container.tpu = None  # the module fixture owns the engine
+    app.stop()
+
+
+def _post(port, body, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            lines = [json.loads(line) for line in
+                     resp.read().decode().splitlines() if line]
+            return resp.status, lines
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_route_streams_cursor_lines(serve_app, cache_eng):
+    app, route = serve_app
+    prompt = _prompt(20, seed=17)
+    ref = cache_eng.generate(prompt, max_new_tokens=6).tokens()
+    status, lines = _post(app.http_port,
+                          {"tokens": prompt, "max_new": 6})
+    assert status == 200
+    assert [ln["token"] for ln in lines] == ref
+    assert [ln["cursor"] for ln in lines] == list(range(6))
+    assert not any("error" in ln for ln in lines)
+    assert route.stats()["live"] == 0
+
+
+def test_route_midstream_typed_line_then_resume_roundtrip(serve_app,
+                                                          cache_eng):
+    """The full wire contract in one round trip: kill after 3 tokens
+    -> typed line with a COMPLETE resume token -> replay the
+    continuation (same request id) -> spliced stream == reference,
+    with the continuation's first line reporting its recompute."""
+    app, route = serve_app
+    prompt = _prompt(20, seed=21)
+    ref = cache_eng.generate(prompt, max_new_tokens=8).tokens()
+    rid = "t-resume-1"
+    sched = chaos.ChaosSchedule(seed=0).on(
+        chaos.GENERATOR_MIDKILL, error=RuntimeError, every=3, limit=1)
+    with chaos.scope(sched):
+        status, lines = _post(app.http_port,
+                              {"tokens": prompt, "max_new": 8,
+                               "request_id": rid})
+    assert status == 200
+    toks = [ln for ln in lines if "token" in ln]
+    assert [t["cursor"] for t in toks] == [0, 1, 2]
+    err = lines[-1]["error"]
+    assert err["status"] == 503 and err["retry_after"] > 0
+    res = err["resume"]
+    emitted = [t["token"] for t in toks]
+    assert res["cursor"] == 3 and res["emitted"] == 3
+    assert res["request_id"] == rid
+    assert res["chain"] == resume_chain(prompt, emitted, BLOCK, 0)
+    # the replay: resume_from/emitted + the SAME request id
+    status2, lines2 = _post(app.http_port,
+                            {"tokens": prompt, "max_new": 8,
+                             "request_id": rid, "resume_from": 3,
+                             "emitted": emitted})
+    assert status2 == 200
+    toks2 = [ln for ln in lines2 if "token" in ln]
+    assert "recompute" in toks2[0]
+    assert [t["cursor"] for t in toks2] == [3, 4, 5, 6, 7]
+    assert emitted + [t["token"] for t in toks2] == ref
+    assert route.stats()["live"] == 0
+
+
+def test_route_resume_cursor_mismatch_is_400(serve_app):
+    app, _ = serve_app
+    status, body = _post(app.http_port,
+                         {"tokens": _prompt(18, seed=23),
+                          "resume_from": 2, "emitted": [5]})
+    assert status == 400
+    assert "resume_from" in json.dumps(body)
+
+
+def test_route_dedup_cancels_the_zombie_stream(cache_eng):
+    route = GenerateRoute(cache_eng)
+
+    class FakeStream:
+        cancelled = False
+
+        def cancel(self):
+            self.cancelled = True
+
+    zombie = FakeStream()
+    route._live["r1"] = zombie
+    route._dedup("r1")
+    assert zombie.cancelled and "r1" not in route._live
+    route._dedup("r1")  # absent id: no-op
+    route._dedup(None)  # anonymous request: no identity to dedup
+    assert route.stats()["live"] == 0
+
+
+def test_stream_generate_client_auto_resumes(serve_app, cache_eng):
+    """The client half over a real engine: a mid-stream kill is
+    invisible — stream_generate replays the resume token and the
+    yielded stream is token-exact."""
+    app, _ = serve_app
+    prompt = _prompt(26, seed=31)
+    ref = cache_eng.generate(prompt, max_new_tokens=9).tokens()
+    sched = chaos.ChaosSchedule(seed=0).on(
+        chaos.GENERATOR_MIDKILL, error=RuntimeError, every=4, limit=1)
+    with chaos.scope(sched):
+        got = list(stream_generate(f"127.0.0.1:{app.http_port}",
+                                   {"tokens": prompt, "max_new": 9}))
+    assert got == ref
+
+
+def test_stream_generate_sampled_adopts_server_seed(serve_app):
+    """An unseeded sampled request killed mid-stream still resumes
+    token-exact: the typed line's resume token carries the seed the
+    server picked and the client adopts it for the replay."""
+    app, _ = serve_app
+    prompt = _prompt(22, seed=33)
+    body = {"tokens": prompt, "max_new": 8, "temperature": 0.7,
+            "top_k": 15}
+    sched = chaos.ChaosSchedule(seed=0).on(
+        chaos.GENERATOR_MIDKILL, error=RuntimeError, every=3, limit=1)
+    with chaos.scope(sched):
+        got = list(stream_generate(f"127.0.0.1:{app.http_port}",
+                                   dict(body)))
+    assert len(got) == 8
+    # replay the whole request unkilled with no pinned seed: a fresh
+    # draw stream — equality with `got` is not required, length is
+    status, lines = _post(app.http_port, dict(body))
+    assert status == 200 and len(lines) == 8
+
+
+# -- the gateway's auto-resume ------------------------------------------------
+
+def expected_tokens(prompt, n):
+    base = int(sum(prompt))
+    return [(base + i) % MOD for i in range(n)]
+
+
+class ResumableReplica:
+    """A real App whose /generate speaks the durable-streams wire
+    contract (cursor lines + continuation admission) without a model:
+    token i = (sum(prompt)+i) % 997. ``die_after=k`` makes the FIRST
+    (non-resume) attempt end after k tokens with the typed error line
+    a real engine emits when its stream dies — the process stays
+    alive, exactly the same-replica-resume case."""
+
+    def __init__(self, name: str, die_after: int | None = None):
+        self.name = name
+        self.die_after = die_after
+        self.hits = 0
+        self.resumed = 0
+        self.bodies: list[dict] = []
+        self.app = App(MapConfig({"HTTP_PORT": "0", "METRICS_PORT": "0",
+                                  "APP_NAME": name,
+                                  "LOG_LEVEL": "ERROR"}))
+
+        @self.app.post("/generate")
+        def generate(ctx):
+            self.hits += 1
+            body = ctx.bind()
+            self.bodies.append(body)
+            toks = body["tokens"]
+            n = int(body.get("max_new_tokens", body.get("max_new", 4)))
+            base = int(body.get("resume_from", 0) or 0)
+            if base:
+                self.resumed += 1
+            seq = expected_tokens(toks, n)
+            die = self.die_after if base == 0 else None
+            rid = body.get("request_id")
+
+            def lines():
+                sent = 0
+                for cur in range(base, n):
+                    if die is not None and sent >= die:
+                        yield (json.dumps({"error": {
+                            "message": f"{self.name}: stream died",
+                            "status": 503, "retry_after": 0.05,
+                            "resume": {"request_id": rid, "cursor": cur,
+                                       "emitted": cur, "chain": ""},
+                        }}) + "\n").encode()
+                        return
+                    obj = {"token": seq[cur], "cursor": cur,
+                           "replica": self.name}
+                    if sent == 0 and base:
+                        obj["recompute"] = len(toks)
+                    yield (json.dumps(obj) + "\n").encode()
+                    sent += 1
+
+            ctx.stream(lines())
+            return None
+
+        self.app.run(block=False)
+        self.port = self.app.http_port
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        if self.app._running.is_set():
+            self.app.stop(0.0)
+
+
+def make_gateway(replicas, **extra) -> App:
+    cfg = {"HTTP_PORT": "0", "METRICS_PORT": "0", "APP_NAME": "gw",
+           "LOG_LEVEL": "ERROR", "TPU_SERVING_ROLE": "gateway",
+           "TPU_GATEWAY_REPLICAS": ",".join(r.address for r in replicas),
+           "TPU_GATEWAY_BLOCK": str(BLOCK),
+           "TPU_GATEWAY_HEALTH_INTERVAL_S": "0.2",
+           "TPU_GATEWAY_CONNECT_TIMEOUT_S": "1.0"}
+    cfg.update({k: str(v) for k, v in extra.items()})
+    gw = App(MapConfig(cfg))
+    gw.run(block=False)
+    return gw
+
+
+def post_generate(port, tokens, max_new=8, extra=None, timeout=20):
+    body = {"tokens": list(map(int, tokens)),
+            "max_new_tokens": max_new, **(extra or {})}
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, [json.loads(line) for line in
+                             resp.read().decode().splitlines() if line]
+
+
+def gw_stats(gw: App) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{gw.http_port}/gateway/stats",
+            timeout=5) as r:
+        return json.loads(r.read())["data"]
+
+
+def test_gateway_resumes_typed_loss_on_same_replica():
+    """An engine-declared death (typed line + resume token) keeps the
+    replica eligible — it is alive and warmest. The gateway replays
+    the continuation onto it and the client sees one clean stream."""
+    rep = ResumableReplica("r0", die_after=3)
+    gw = make_gateway([rep])
+    try:
+        prompt = list(range(1, 33))
+        status, lines = post_generate(gw.http_port, prompt, max_new=8,
+                                      extra={"temperature": 0.7})
+        assert status == 200
+        toks = [ln for ln in lines if "token" in ln]
+        assert [t["token"] for t in toks] == expected_tokens(prompt, 8)
+        assert [t["cursor"] for t in toks] == list(range(8))
+        assert not any("error" in ln for ln in lines)
+        # the continuation's first line carried its recompute through
+        assert any("recompute" in ln for ln in toks)
+        assert rep.hits == 2 and rep.resumed == 1
+        # the gateway stamped identity + seed BEFORE the first forward
+        first, second = rep.bodies[0], rep.bodies[1]
+        assert first["request_id"].startswith("gw-")
+        assert second["request_id"] == first["request_id"]
+        assert second["seed"] == first["seed"] is not None
+        assert second["resume_from"] == 3
+        assert second["emitted"] == [t["token"] for t in toks[:3]]
+        st = gw_stats(gw)
+        assert st["resumes"] == 1
+        assert st["outcomes"].get("midstream", 0) == 0
+    finally:
+        gw.stop()
+        rep.stop()
+
+
+def test_gateway_transport_loss_resumes_on_other_replica():
+    """A severed relay (the gateway.midstream seam standing in for a
+    replica SIGKILL) excludes the dead replica and splices the
+    continuation from a survivor — zero duplicate, zero missing."""
+    reps = [ResumableReplica(f"r{i}") for i in range(2)]
+    gw = make_gateway(reps)
+    try:
+        prompt = list(range(5, 37))
+        sched = chaos.ChaosSchedule(seed=0).on(
+            chaos.GATEWAY_MIDSTREAM, error=RuntimeError, every=4,
+            limit=1)
+        with chaos.scope(sched):
+            status, lines = post_generate(gw.http_port, prompt,
+                                          max_new=8)
+        assert status == 200
+        toks = [ln for ln in lines if "token" in ln]
+        assert [t["token"] for t in toks] == expected_tokens(prompt, 8)
+        assert [t["cursor"] for t in toks] == list(range(8))
+        assert not any("error" in ln for ln in lines)
+        # the splice crossed processes
+        assert len({t["replica"] for t in toks}) == 2
+        assert gw_stats(gw)["resumes"] == 1
+    finally:
+        gw.stop()
+        for r in reps:
+            r.stop()
+
+
+def test_gateway_resume_exhausted_typed_line_carries_resume_token():
+    """One replica, transport loss: nobody left to resume on. The
+    stream ends with the typed line — now carrying the resume token a
+    client can continue from on its own."""
+    rep = ResumableReplica("r0")
+    gw = make_gateway([rep])
+    try:
+        prompt = list(range(2, 18))
+        sched = chaos.ChaosSchedule(seed=0).on(
+            chaos.GATEWAY_MIDSTREAM, error=RuntimeError, every=3,
+            limit=1)
+        with chaos.scope(sched):
+            status, lines = post_generate(gw.http_port, prompt,
+                                          max_new=8)
+        assert status == 200
+        toks = [ln["token"] for ln in lines[:-1]]
+        assert toks == expected_tokens(prompt, 8)[:3]
+        err = lines[-1]["error"]
+        assert err["status"] == 503
+        res = err["resume"]
+        assert res["cursor"] == 3
+        assert res["request_id"].startswith("gw-")
+        assert res["chain"] == resume_chain(prompt, toks, BLOCK, 0)
+        st = gw_stats(gw)
+        assert st["resumes"] == 0
+        assert st["outcomes"]["midstream"] == 1
+    finally:
+        gw.stop()
+        rep.stop()
+
+
+def test_gateway_resume_disabled_restores_legacy_contract():
+    """TPU_RESUME=false: the PR 14 relay verbatim — a post-commit loss
+    is the bare typed 503 line, no resume token, no replay."""
+    rep = ResumableReplica("r0", die_after=2)
+    gw = make_gateway([rep], TPU_RESUME="false")
+    try:
+        status, lines = post_generate(gw.http_port, list(range(24)),
+                                      max_new=8)
+        assert status == 200
+        err = lines[-1]["error"]
+        # the replica's own typed line relays through untouched (the
+        # legacy relay treats ANY line as opaque bytes)
+        assert err["status"] == 503
+        assert rep.hits == 1 and rep.resumed == 0
+        assert gw_stats(gw)["resumes"] == 0
+    finally:
+        gw.stop()
+        rep.stop()
+
+
+# -- P/D re-handoff -----------------------------------------------------------
+
+def test_pd_rehandoff_decode_death_resumes_token_exact(params):
+    """Kill the decode worker mid-stream; the prefill coordinator
+    re-hands the relay off to a restarted decode pool (re-shipping KV
+    for prompt+emitted) and the SAME RelayStream finishes token-exact
+    — the consumer never sees the death."""
+    def _eng():
+        return GenerationEngine(TINY, params, slots=2, max_seq=128,
+                                prompt_buckets=(16, 32))
+
+    fingerprint = model_fingerprint(TINY, params, extra="pd")
+    dec, dec2 = _eng(), _eng()
+    srv = KVIngestServer(dec, fingerprint, "127.0.0.1", 0)
+    srv2 = KVIngestServer(dec2, fingerprint, "127.0.0.1", 0)
+    pre = _eng()
+    pd = PDPrefill(pre, fingerprint, "127.0.0.1", srv.port,
+                   ship_block=16, resume_wait_s=30.0)
+    try:
+        prompt = _prompt(24, seed=51)
+        ref = pd.generate(prompt, max_new_tokens=16).tokens()
+        rs = pd.generate(prompt, max_new_tokens=16)
+        it = iter(rs)
+        got = [next(it) for _ in range(3)]
+        assert got == ref[:3]
+        srv.close()
+        dec.close()           # the decode worker dies mid-stream
+        pd.peer = ("127.0.0.1", srv2.port)  # "restarted" pool
+        pd._reconnect.reset()
+        rest = list(it)       # the re-handoff finishes the stream
+        assert got + rest == ref
+        st = pd.stats()
+        assert st["resumed"] == 1
+        assert st["peer_losses"] == 1
+        assert rs.resumes == 1
+    finally:
+        pd.close()
+        srv.close()
+        srv2.close()
+        pre.close()
+        dec.close()
+        dec2.close()

@@ -1,0 +1,403 @@
+#!/usr/bin/env python
+"""Durable-streams resume benchmark: token-exact recovery from
+replica SIGKILL mid-stream.
+
+REAL processes: N replica Apps (tiny llama engine, prefix cache on,
+the canonical ``gofr_tpu.serving.install_generate`` route) behind a
+gateway App with auto-resume on. The parent streams S concurrent
+sessions through the gateway and SIGKILLs the session-0 affinity
+owner once at least one token of every stream is in flight — the
+in-flight relays lose their sockets mid-stream and the gateway must
+splice continuations from the survivor. CPU-only (JAX_PLATFORMS=cpu);
+the structural gates are the point.
+
+Arms and gates (all STRICT):
+
+  kill rounds   R rounds x S greedy sessions + 1 seeded SAMPLED
+                session, each streaming max_new tokens while the
+                affinity owner is SIGKILLed mid-stream, then
+                respawned: ZERO client-visible errors (no typed error
+                lines, no transport exceptions — the commit point is
+                the stream end now), every stream token-exact vs its
+                uninterrupted direct-to-replica reference (sampled
+                included: resume re-keys the PRNG on absolute
+                position), >= 1 gateway resume observed per round.
+  warm resume   both replicas pre-warmed on every session's chain
+                before each round, so the survivor admits the
+                continuation from its prefix cache: the relayed
+                continuation's ``recompute`` (prompt+emitted
+                positions actually prefilled) <= one cache-block
+                chunk of the chain tail, never the whole prompt.
+
+Output follows the bench stdout contract (tools/README.md): the LAST
+stdout line is the JSON artifact; progress goes to stderr. Full runs
+write RESUME_BENCH.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TPU_TIMELINE", "0")
+
+SEED_VOCAB = 500
+BLOCK = 16
+PROMPT_LEN = 40         # >= TPU_PREFIX_MIN: every session's chain stores
+SAMPLED_SEED = 20180    # the pinned seed of the sampled session
+RECOMPUTE_GATE = 2 * BLOCK  # warm resume recomputes only the chain tail
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# -- child process: one serving replica ---------------------------------------
+
+def run_replica(port: int) -> None:
+    from gofr_tpu import App
+    from gofr_tpu.config import MapConfig
+    from gofr_tpu.serving import install_generate
+
+    app = App(MapConfig({
+        "APP_NAME": f"replica-{port}", "LOG_LEVEL": "ERROR",
+        "HTTP_PORT": str(port), "METRICS_PORT": "0",
+        "TPU_MODEL": "tiny", "TPU_MAX_SEQ": "256", "TPU_SLOTS": "4",
+        "TPU_SEQ_BUCKETS": "32,64,96", "TPU_DECODE_BLOCK": "4",
+        # Enough T0 slots for every session body plus the entries the live
+        # streams store, and a T1 host tier underneath: an entry evicted
+        # between the pre-warm and the kill must still resume WARM (the
+        # warm_recompute_bounded gate is about resume warmth, not about
+        # prefix-cache eviction pressure).
+        "TPU_PREFIX_CACHE": "8", "TPU_PREFIX_MIN": "32",
+        "TPU_KVCACHE_BLOCK": str(BLOCK), "TPU_KVCACHE_HOST_MB": "64",
+        "TPU_WARMUP": "true",
+    }))
+    if app.container.tpu is None:
+        print("ENGINE-FAILED", flush=True)
+        return
+    install_generate(app)
+    app.run(block=False)
+    print(f"READY {app.http_port}", flush=True)
+    try:
+        sys.stdin.read()  # parent closes stdin -> graceful drain
+    except Exception:
+        pass
+    app.stop(grace_s=10.0)
+
+
+class ReplicaProc:
+    """Spawn/respawn handle for one replica child pinned to one port."""
+
+    def __init__(self, port: int):
+        self.port = port
+        self.proc: subprocess.Popen | None = None
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def spawn(self) -> None:
+        env = dict(os.environ, JAX_PLATFORMS="cpu", TPU_TIMELINE="0")
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             "replica", "--port", str(self.port)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env,
+            text=True)
+
+    def wait_ready(self, timeout_s: float = 180.0) -> None:
+        assert self.proc is not None
+        line = self.proc.stdout.readline().strip()
+        if not line.startswith("READY "):
+            raise RuntimeError(f"replica :{self.port} failed: {line!r}")
+        # drain the child's stdout forever (wide events bypass the
+        # log-level gate; an undrained pipe wedges the serving loop —
+        # the gateway_bench lesson)
+        out = self.proc.stdout
+        threading.Thread(target=lambda: [None for _ in out],
+                         name=f"drain-{self.port}", daemon=True).start()
+
+    def drain_stop(self) -> None:
+        if self.proc is not None:
+            try:
+                self.proc.stdin.close()
+                self.proc.wait(timeout=60)
+            except Exception:
+                self.proc.kill()
+            self.proc = None
+
+    def kill(self) -> None:
+        if self.proc is not None:
+            self.proc.kill()
+            self.proc.wait()
+            self.proc = None
+
+
+def free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def build_gateway(replica_addrs: list[str]):
+    from gofr_tpu import App
+    from gofr_tpu.config import MapConfig
+
+    gw = App(MapConfig({
+        "APP_NAME": "resume-bench-gw", "LOG_LEVEL": "ERROR",
+        "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "TPU_SERVING_ROLE": "gateway",
+        "TPU_GATEWAY_REPLICAS": ",".join(replica_addrs),
+        "TPU_GATEWAY_BLOCK": str(BLOCK),
+        "TPU_GATEWAY_HEALTH_INTERVAL_S": "0.5",
+        "TPU_GATEWAY_CONNECT_TIMEOUT_S": "2.0",
+    }))
+    gw.run(block=False)
+    return gw
+
+
+def gw_stats(port: int) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/gateway/stats", timeout=10) as r:
+        return json.loads(r.read())["data"]
+
+
+# -- the client side ----------------------------------------------------------
+
+def session_prompt(s: int) -> list[int]:
+    return [(s * 131 + j) % SEED_VOCAB + 1 for j in range(PROMPT_LEN)]
+
+
+def session_body(s: int, max_new: int, sampled: bool) -> dict:
+    body = {"tokens": session_prompt(s), "max_new": max_new}
+    if sampled:
+        body.update(temperature=0.8, top_k=20, seed=SAMPLED_SEED)
+    return body
+
+
+def post_lines(port: int, body: dict, on_line=None,
+               timeout: float = 120.0) -> list[dict]:
+    """One streaming POST, parsed line by line (``on_line`` fires per
+    parsed line — the kill trigger watches stream progress with it)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    lines = []
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        for raw in resp:
+            raw = raw.strip()
+            if not raw:
+                continue
+            obj = json.loads(raw)
+            lines.append(obj)
+            if on_line is not None:
+                on_line(obj)
+    return lines
+
+
+class StreamRun:
+    """One session's stream through the gateway on its own thread."""
+
+    def __init__(self, gw_port: int, body: dict):
+        self.body = body
+        self.lines: list[dict] = []
+        self.error: str | None = None
+        self.first_token = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        args=(gw_port,), daemon=True)
+
+    def _run(self, gw_port: int) -> None:
+        try:
+            self.lines = post_lines(
+                gw_port, self.body,
+                on_line=lambda obj: ("token" in obj
+                                     and self.first_token.set()))
+        except Exception as e:  # noqa: BLE001 — any escape is a gate fail
+            self.error = repr(e)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def join(self, timeout: float = 180.0) -> None:
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive() and self.error is None:
+            self.error = "stream did not finish"
+
+    @property
+    def tokens(self) -> list[int]:
+        return [ln["token"] for ln in self.lines if "token" in ln]
+
+    @property
+    def error_lines(self) -> list[dict]:
+        return [ln for ln in self.lines if "error" in ln]
+
+    @property
+    def recomputes(self) -> list[int]:
+        return [int(ln["recompute"]) for ln in self.lines
+                if "recompute" in ln]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--worker", choices=["replica"])
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args()
+    if args.worker == "replica":
+        run_replica(args.port)
+        return 0
+
+    smoke = args.smoke
+    rounds = 1 if smoke else 2
+    greedy_sessions = 2 if smoke else 3
+    max_new = 32 if smoke else 48
+
+    payload: dict = {"bench": "resume", "smoke": smoke,
+                     "rounds": rounds,
+                     "sessions": greedy_sessions + 1,
+                     "max_new": max_new,
+                     "recompute_gate": RECOMPUTE_GATE}
+
+    ports = free_ports(2)
+    reps = [ReplicaProc(p) for p in ports]
+    log(f"spawning 2 replicas on {ports}...")
+    for r in reps:
+        r.spawn()
+    for r in reps:
+        r.wait_ready()
+    log("replicas ready")
+
+    gw = build_gateway([r.address for r in reps])
+    gw_port = gw.http_port
+
+    from gofr_tpu.gateway import HashRing
+    from gofr_tpu.tpu.kvcache import first_block_hash
+
+    ring = HashRing([r.address for r in reps])
+
+    bodies = [session_body(s, max_new, sampled=False)
+              for s in range(greedy_sessions)]
+    bodies.append(session_body(greedy_sessions, max_new, sampled=True))
+    owners = [ring.order(first_block_hash(b["tokens"], BLOCK))[0]
+              for b in bodies]
+    # round 0 kills session 0's affinity owner; the last round kills
+    # the SAMPLED session's owner, so the PRNG-re-keyed resume path is
+    # exercised end to end whenever rounds >= 2
+    victim_of_round = [owners[0], owners[-1]]
+
+    try:
+        # -- references: uninterrupted, direct to a replica ------------
+        log("computing direct uninterrupted references...")
+        refs = []
+        for body in bodies:
+            lines = post_lines(reps[0].port, dict(body))
+            assert not any("error" in ln for ln in lines), lines
+            refs.append([ln["token"] for ln in lines if "token" in ln])
+        log(f"references: {len(refs)} streams x {max_new} tokens")
+
+        round_results = []
+        zero_errors = True
+        token_exact = True
+        recomputes_all: list[int] = []
+        resumes_before = gw_stats(gw_port)["resumes"]
+
+        for rnd in range(rounds):
+            victim = victim_of_round[rnd % 2]
+            # pre-warm BOTH replicas on every session chain: the
+            # survivor must admit the continuation warm
+            for body in bodies:
+                for r in reps:
+                    post_lines(r.port, dict(body))
+            log(f"round {rnd}: chains pre-warmed; streaming "
+                f"{len(bodies)} sessions, SIGKILL replica {victim} "
+                "mid-stream...")
+            runs = [StreamRun(gw_port, dict(body)) for body in bodies]
+            for run in runs:
+                run.start()
+            # kill the instant every VICTIM-OWNED stream is committed
+            # (>= 1 token relayed) — waiting on the others would let
+            # fast streams finish before the kill lands mid-stream
+            for i, run in enumerate(runs):
+                if owners[i] != victim:
+                    continue
+                if not run.first_token.wait(timeout=60):
+                    run.error = run.error or "no first token in 60s"
+            reps[victim].kill()
+            log(f"  replica {victim} KILLED")
+            for run in runs:
+                run.join()
+            reps[victim].spawn()
+            reps[victim].wait_ready()
+            log(f"  replica {victim} respawned")
+            time.sleep(1.0)  # the poller re-admits it
+
+            rr = {"victim": victim, "streams": []}
+            for i, run in enumerate(runs):
+                exact = run.tokens == refs[i]
+                errs = bool(run.error_lines) or run.error is not None
+                rr["streams"].append({
+                    "session": i,
+                    "sampled": "seed" in bodies[i],
+                    "tokens": len(run.tokens), "exact": exact,
+                    "error_lines": len(run.error_lines),
+                    "transport_error": run.error,
+                    "recompute": run.recomputes})
+                zero_errors = zero_errors and not errs
+                token_exact = token_exact and exact
+                recomputes_all.extend(run.recomputes)
+            round_results.append(rr)
+            log(f"  round {rnd}: exact={token_exact} "
+                f"errors={not zero_errors} "
+                f"recomputes={recomputes_all}")
+
+        resumes = gw_stats(gw_port)["resumes"] - resumes_before
+        payload["rounds_detail"] = round_results
+        payload["resumes"] = resumes
+        payload["recomputes"] = recomputes_all
+        payload["gateway_stats"] = gw_stats(gw_port)
+    finally:
+        gw.stop()
+        for r in reps:
+            r.drain_stop()
+
+    checks = {
+        # the durable-streams promise: a mid-stream SIGKILL is
+        # invisible — no typed error line, no transport exception
+        "zero_client_errors": zero_errors,
+        # splice exactness, greedy AND seeded-sampled sessions
+        "token_exact": token_exact,
+        # the kill landed mid-stream and the gateway resumed
+        "resumes_observed": resumes >= rounds,
+        # warm resume recomputes only the chain tail, never the prompt
+        "warm_recompute_bounded":
+            len(recomputes_all) >= 1
+            and max(recomputes_all) <= RECOMPUTE_GATE,
+    }
+    payload["checks"] = checks
+    payload["ok"] = all(checks.values())
+    print(json.dumps(payload), flush=True)
+    return 0 if payload["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
