@@ -37,6 +37,7 @@ from .http.middleware import (
     metrics_middleware,
     oauth_middleware,
     slo_class_middleware,
+    tenant_middleware,
     tracer_middleware,
     JWKSKeyProvider,
 )
@@ -123,6 +124,13 @@ class App:
         self.router.use(logging_middleware(self.logger))
         self.router.use(deadline_middleware())
         self.router.use(slo_class_middleware())
+        # tenant scope AFTER the slo scope: a tenant's registry-default
+        # class must see the request's explicit X-SLO-Class first. The
+        # plane resolver is lazy — the engine is wired after this chain
+        # is built, and tenancy may be off entirely.
+        self.router.use(tenant_middleware(
+            lambda: getattr(self.container.tpu, "tenancy", None),
+            header=self.config.get("TPU_TENANT_HEADER") or "X-Tenant-Id"))
         self.router.use(cors_middleware())
         self.router.use(metrics_middleware(self.container.metrics))
 

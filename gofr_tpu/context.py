@@ -90,6 +90,19 @@ class Context:
 
         return current_slo_class()
 
+    @property
+    def tenant(self) -> str:
+        """The request's tenant id (``default`` when untagged), parsed
+        from ``X-Tenant-Id`` / gRPC ``x-tenant-id`` by the transport and
+        canonicalized through the tenant registry when one is
+        configured. Ambient like the deadline and SLO class:
+        ``ctx.tpu.predict``/``generate`` enforce the tenant's quota,
+        fair-share weight and cache budget automatically
+        (docs/advanced-guide/multi-tenancy.md)."""
+        from .tenancy.registry import current_tenant
+
+        return current_tenant()
+
     # -- streaming (no reference equivalent: the reference has no HTTP
     # streaming path; needed for token streaming over chunked responses) ----
     def stream(self, chunks, content_type: str = "application/x-ndjson") -> None:

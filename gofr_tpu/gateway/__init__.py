@@ -216,7 +216,8 @@ class Gateway:
                 pass
 
     # -- the forwarded-request context ---------------------------------------
-    def _affinity_key(self, body: bytes) -> tuple[bytes | None, int]:
+    def _affinity_key(self, body: bytes,
+                      tenant: str = "") -> tuple[bytes | None, int]:
         try:
             payload = json.loads(body)
             tokens = payload["tokens"]
@@ -230,10 +231,17 @@ class Gateway:
         from ..tpu.kvcache import first_block_hash
 
         try:
-            return first_block_hash(tokens, self.block, adapter), plen
+            key = first_block_hash(tokens, self.block, adapter)
         except Exception as e:  # noqa: BLE001 — non-numeric tokens
             raise BadRequest("gateway: 'tokens' must be an array of "
                              "integers") from e
+        if tenant:
+            # tenants partition the fleet's prefix caches: the same
+            # prompt prefix from two tenants lands on (usually)
+            # different replicas, so one tenant's working set never
+            # thrashes another's T0 rows fleet-wide
+            key = key + b"|" + tenant.encode("utf-8", "replace")
+        return key, plen
 
     def _forward_headers(self, client_headers: dict) -> tuple[dict, float]:
         """The replica-hop headers + the tightened read timeout. Client
@@ -361,7 +369,8 @@ class Gateway:
 
     def _relay_attempts(self, ctx, st: dict):
         body = ctx.request.body or b""
-        key, plen = self._affinity_key(body)
+        key, plen = self._affinity_key(
+            body, tenant=ctx.header("X-Tenant-Id").strip())
         rctx = self._resume_ctx(ctx, body, key, plen)
         if rctx is not None:
             body = rctx.body()

@@ -721,14 +721,30 @@ class GRPCServer:
         # the device sees it and ``slo-class: throughput`` metadata
         # routes the request through the batch-traffic line
         slo_class = parse_slo_class(metadata.get("slo-class"))
+        # x-tenant-id metadata is the gRPC face of the HTTP
+        # X-Tenant-Id header: same ambient scope, same registry
+        # canonicalization downstream (tenancy/registry.py)
+        tenant = (metadata.get("x-tenant-id") or "").strip() or None
+        if tenant is not None:
+            plane = getattr(self.container.tpu, "tenancy", None)
+            if plane is not None:
+                try:
+                    tenant = plane.resolve(tenant).tenant_id
+                except Exception:
+                    pass
         rpc_span = tracing.current_span()
         if rpc_span is not None:
             # the RPC root span carries the class so the tail sampler's
             # per-class slow-tail p99 judges grpc traffic correctly
             rpc_span.set_attribute("slo_class", slo_class)
+            if tenant is not None:
+                rpc_span.set_attribute("tenant", tenant)
+        from ..tenancy.registry import tenant_scope
+
         with deadline_scope(Deadline(deadline) if deadline is not None
                             else None), \
-                slo_scope(slo_class):
+                slo_scope(slo_class), \
+                tenant_scope(tenant):
             if method.client_streaming:
                 # handler receives a lazy iterator over the request
                 # stream; it ends at the client's half-close

@@ -169,6 +169,41 @@ def slo_class_middleware(header: str = "X-SLO-Class") -> Middleware:
     return mw
 
 
+def tenant_middleware(resolver: Callable[[], object] | None = None,
+                      header: str = "X-Tenant-Id") -> Middleware:
+    """Parse the request's tenant header into the AMBIENT tenant
+    (tenancy.tenant_scope) for the handler's thread — the HTTP mirror
+    of gRPC's ``x-tenant-id`` metadata. ``resolver`` is a LAZY callable
+    returning the engine's TenantPlane (or None): the middleware chain
+    is built before the container wires the engine, and tenancy may be
+    off entirely. With a plane installed the raw header canonicalizes
+    through the registry (unknown ids collapse to the default spec, so
+    one id per CONFIGURED tenant bounds label cardinality downstream);
+    without one the header still scopes — wide events and traces carry
+    it — but no quota/weight/cache policy applies
+    (docs/advanced-guide/multi-tenancy.md)."""
+    from .. import tracing
+    from ..tenancy.registry import tenant_scope
+
+    def mw(next_h: Handler) -> Handler:
+        def wrapped(req: Request, w: ResponseWriter) -> None:
+            raw = (req.header(header) or "").strip()
+            plane = resolver() if resolver is not None else None
+            tid = raw
+            if plane is not None and raw:
+                try:
+                    tid = plane.resolve(raw).tenant_id
+                except Exception:
+                    tid = raw
+            with tenant_scope(tid or None) as tenant:
+                span = tracing.current_span()
+                if span is not None:
+                    span.set_attribute("tenant", tenant)
+                next_h(req, w)
+        return wrapped
+    return mw
+
+
 def drain_middleware(is_draining: Callable[[], bool],
                      retry_after: Callable[[], float | None]) -> Middleware:
     """Readiness gate for graceful shutdown: once the app starts

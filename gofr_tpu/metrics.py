@@ -586,6 +586,23 @@ def register_framework_metrics(m: Manager) -> None:
                     "histogram face of the wide event's breakdown, "
                     "exemplar-linked to the trace",
                     TTFT_BUCKETS)
+    # multi-tenant serving plane (gofr_tpu/tenancy,
+    # docs/advanced-guide/multi-tenancy.md): per-tenant admission and
+    # cache-footprint faces; shed/TTFT/queue-depth/cache-hit series
+    # additionally grow a tenant label when a plane is installed
+    m.new_gauge("app_tpu_tenant_admitted",
+                "requests admitted through the tenant quota book, "
+                "by tenant (cumulative)")
+    m.new_gauge("app_tpu_tenant_shed",
+                "requests shed with reason=tenant_quota, by tenant "
+                "(cumulative)")
+    m.new_gauge("app_tpu_tenant_cache_bytes",
+                "prefix-cache T0 bytes resident per tenant (the "
+                "cache-share arbiter lease evicts the over-budget "
+                "tenant's rows first)")
+    m.new_counter("app_tpu_async_jobs_total",
+                  "async inference lane jobs by outcome (done / dedup "
+                  "/ interrupted / backpressured)")
 
 
 def update_system_metrics(m: Manager) -> None:

@@ -325,12 +325,15 @@ class AdmissionGate:
 
     # -- admission side -------------------------------------------------------
     def admit(self, depth: int, program: str = "",
-              slo_class: str = SLO_LATENCY) -> None:
+              slo_class: str = SLO_LATENCY, tenant: str = "") -> None:
         """Admit or raise ``TooManyRequests``. ``depth`` is the queue's
         CURRENT depth (the caller reads it lock-free; an off-by-a-few
         race only moves the shed boundary by that much).
         Throughput-class requests are judged against bounds scaled by
-        ``throughput_factor`` — they shed FIRST as load rises."""
+        ``throughput_factor`` — they shed FIRST as load rises.
+        ``tenant`` only labels the shed telemetry (pass it when a
+        tenancy plane is installed); global pressure bounds stay
+        tenant-blind."""
         if not self.enabled:
             return
         f = (self.throughput_factor if slo_class == SLO_THROUGHPUT else 1.0)
@@ -341,10 +344,33 @@ class AdmissionGate:
                       and wait > self.max_queue_delay * f)
         if not (over_depth or over_delay):
             return
-        self._shed(depth, wait, program, slo_class)
+        self._shed(depth, wait, program, slo_class, tenant=tenant)
+
+    def admit_tenant(self, spec, quotas, program: str = "",
+                     slo_class: str = SLO_LATENCY) -> None:
+        """Per-tenant quota admission (rps token bucket + concurrency),
+        routed through the gate's one shed-bookkeeping path. Over-quota
+        raises ``TooManyRequests`` with ``reason=tenant_quota`` — a 429
+        scoped to THIS tenant while everyone else keeps flowing, which
+        is the opposite failure shape from a global queue shed. On
+        success the quota is CONSUMED; the caller must release the
+        concurrency slot at the request's terminal
+        (``quotas.release(tenant_id)``)."""
+        why, retry_after = quotas.check(spec)
+        if why is None:
+            return
+        tid = spec.tenant_id
+        self._record_shed(program, slo_class,
+                          {"reason": "tenant_quota", "quota": why},
+                          tenant=tid)
+        raise TooManyRequests(
+            f"{self.name or 'admission'}: tenant {tid!r} over {why} "
+            f"quota — shed ({slo_class})",
+            retry_after=max(0.05, retry_after), reason="tenant_quota")
 
     def _record_shed(self, program: str, slo_class: str,
-                     attributes: dict, trace_id: str = "") -> None:
+                     attributes: dict, trace_id: str = "",
+                     tenant: str = "") -> None:
         """The one shed-bookkeeping path (queue pressure AND memory
         pressure): counters, the ``app_tpu_shed_total`` increment
         exemplar'd by the request's trace, and the zero-length
@@ -364,30 +390,41 @@ class AdmissionGate:
             trace_id = span.trace_id if span is not None else ""
         if self.metrics is not None:
             try:
+                # the tenant label exists only on tenancy-enabled
+                # deployments — without a plane the series names stay
+                # bit-identical to pre-tenancy builds
+                labels = {"program": program or self.name,
+                          "slo_class": slo_class}
+                if tenant:
+                    labels["tenant"] = tenant
                 self.metrics.increment_counter(
                     "app_tpu_shed_total", exemplar=trace_id or None,
-                    program=program or self.name, slo_class=slo_class)
+                    **labels)
             except Exception:
                 pass
         if self.tracer is not None:
             try:
                 # zero-length marker span: the request's trace shows
                 # WHERE it died and WHY (queue state or memory reason)
+                attrs = {**attributes,
+                         "program": program or self.name,
+                         "slo_class": slo_class}
+                if tenant:
+                    attrs.setdefault("tenant", tenant)
                 self.tracer.record_span(
                     "tpu.shed", now, now, trace_id=trace_id or None,
-                    attributes={**attributes,
-                                "program": program or self.name,
-                                "slo_class": slo_class})
+                    attributes=attrs)
             except Exception:
                 pass
 
     def _shed(self, depth: int, wait: float, program: str,
-              slo_class: str = SLO_LATENCY) -> None:
+              slo_class: str = SLO_LATENCY, tenant: str = "") -> None:
         # honest Retry-After: the current wait estimate, floored so a
         # zero-estimate early shed doesn't invite an instant retry storm
         self._record_shed(program, slo_class,
                           {"queue_depth": depth,
-                           "wait_ewma_ms": round(wait * 1e3, 3)})
+                           "wait_ewma_ms": round(wait * 1e3, 3)},
+                          tenant=tenant)
         raise TooManyRequests(
             f"{self.name or 'admission'}: queue depth {depth}, "
             f"estimated wait {wait * 1e3:.0f}ms — shed ({slo_class})",

@@ -49,7 +49,8 @@ import threading
 from .errors import BadRequest, HTTPError, status_from_error
 from .wire import WAKE
 
-__all__ = ["GenerateRoute", "install_generate", "resume_chain"]
+__all__ = ["EmbeddingsRoute", "GenerateRoute", "install_embeddings",
+           "install_generate", "resume_chain"]
 
 
 def resume_chain(tokens, emitted, block: int = 16, adapter: int = 0) -> str:
@@ -257,6 +258,65 @@ class GenerateRoute:
     def stats(self) -> dict:
         with self._lock:
             return {"live": len(self._live)}
+
+
+class EmbeddingsRoute:
+    """POST /v1/embeddings over the bert family's ``embed`` program —
+    the multi-tenant plane's second serving surface (per-tenant quotas,
+    fair batching and metrics apply to predict() traffic exactly as to
+    generate()). The wire shape follows the OpenAI embeddings response
+    so existing clients can point at a replica unchanged, except input
+    is pre-tokenized id lists (this framework serves tokens, not text):
+
+        {"input": [[101, 2023, ...], ...]}   # or one flat id list
+
+    Image embeddings over the vit family are future work: vit's program
+    is ``classify`` (softmax over classes, not a pooled vector), so an
+    embeddings surface needs a projection-head program first.
+    """
+
+    def __init__(self, engine, *, logger=None):
+        self.engine = engine
+        self.logger = logger
+
+    def handle(self, ctx):
+        body = ctx.bind()
+        if not isinstance(body, dict):
+            raise BadRequest("embeddings: body must be a JSON object "
+                             "with an 'input' array")
+        raw = body.get("input")
+        if not isinstance(raw, list) or not raw:
+            raise BadRequest("embeddings: 'input' must be a non-empty "
+                             "array of token-id lists")
+        if "embed" not in getattr(self.engine, "_programs", {}):
+            raise BadRequest(
+                "embeddings: this replica serves no 'embed' program — "
+                "run a bert-family model (TPU_MODEL=bert)")
+        try:
+            items = ([[int(t) for t in raw]]
+                     if raw and not isinstance(raw[0], list)
+                     else [[int(t) for t in row] for row in raw])
+        except (TypeError, ValueError) as e:
+            raise BadRequest(
+                f"embeddings: malformed token id: {e}") from e
+        data = []
+        for i, tokens in enumerate(items):
+            if not tokens:
+                raise BadRequest(f"embeddings: input[{i}] is empty")
+            vec = self.engine.predict("embed", tokens)
+            data.append({"object": "embedding", "index": i,
+                         "embedding": [float(x) for x in vec]})
+        return {"object": "list", "data": data,
+                "model": getattr(self.engine, "model_name", "bert"),
+                "meta": {"tenant": ctx.tenant,
+                         "slo_class": ctx.slo_class}}
+
+
+def install_embeddings(app, path: str = "/v1/embeddings") -> EmbeddingsRoute:
+    """Register the canonical /v1/embeddings on an App (bert family)."""
+    route = EmbeddingsRoute(app.container.tpu, logger=app.logger)
+    app.post(path, route.handle)
+    return route
 
 
 def install_generate(app, path: str = "/generate") -> GenerateRoute:

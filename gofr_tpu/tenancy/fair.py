@@ -1,0 +1,152 @@
+"""Deficit-round-robin line for per-tenant weighted fair scheduling.
+
+``WeightedFairLine`` is a drop-in for the plain ``collections.deque``
+each SLO class keeps inside the generator's ``_ClassPending``: same
+``append`` / ``appendleft`` / ``popleft`` / ``__len__`` surface, but
+internally one FIFO per tenant served deficit-round-robin over the
+tenant's registry weight. Weights 2:1:1 under saturation pop
+A, A, B, C, A, A, B, C, ... — deterministic, O(1) amortized, and a
+tenant that isn't queued costs nothing (work-conserving: its unused
+share flows to whoever is).
+
+Cost is 1 per request (fairness over ADMISSION slots; decode-token
+share then tracks queue weight because the batcher drains this line).
+Quantum per round is the tenant's weight.
+
+Locking: none here. The single owner (``_ClassPending``) already
+serializes ``put`` / ``put_front`` / ``get_nowait`` under its own lock,
+and the lock-free readers it exposes (``qsize`` et al.) only read
+``len`` — ``_len`` is a plain int updated last, so those stay safe.
+
+``appendleft`` exists for exactly one caller pattern: the batcher pops
+a request, fails to place it (pool full / step budget), and pushes it
+back to the FRONT. That undo must restore the pre-pop scheduler state
+— same tenant at the head of the round with its pre-serve deficit —
+or the retry loop would rotate the ring and break both fairness and
+the FIFO-per-tenant ordering guarantee. We snapshot (tenant, deficit)
+at each pop to make the undo exact.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+__all__ = ["WeightedFairLine"]
+
+_DEFAULT = "default"
+
+
+def _tenant_of(req) -> str:
+    # Requests predating tenancy (tests build them with object.__new__)
+    # carry no tenant attribute: they all share the default line, which
+    # collapses the scheduler to plain FIFO.
+    return getattr(req, "tenant", None) or _DEFAULT
+
+
+def _weight_of(req) -> int:
+    try:
+        return max(1, int(getattr(req, "tenant_weight", 1)))
+    except (TypeError, ValueError):
+        return 1
+
+
+class WeightedFairLine:
+    __slots__ = ("_lines", "_weight", "_deficit", "_order", "_len",
+                 "_last")
+
+    def __init__(self):
+        self._lines: dict[str, deque] = {}
+        self._weight: dict[str, int] = {}
+        self._deficit: dict[str, float] = {}
+        self._order: deque = deque()  # active tenants, round-robin ring
+        self._len = 0
+        self._last: tuple[str, float] | None = None  # pop undo snapshot
+
+    # -- deque surface -------------------------------------------------------
+    def append(self, req) -> None:
+        tid = _tenant_of(req)
+        self._weight[tid] = _weight_of(req)
+        line = self._lines.get(tid)
+        if line is None:
+            line = self._lines[tid] = deque()
+            self._order.append(tid)
+            # a fresh arrival starts with one full quantum so it is
+            # servable immediately and the first round already runs at
+            # the configured ratio (2:1:1 pops A,A,B,C from pop one)
+            self._deficit[tid] = self._weight[tid]
+        line.append(req)
+        self._len += 1
+
+    def popleft(self):
+        if self._len == 0:
+            raise IndexError("pop from an empty WeightedFairLine")
+        while True:
+            tid = self._order[0]
+            line = self._lines.get(tid)
+            if not line:
+                # stale head (emptied via an exceptional path): drop it
+                self._order.popleft()
+                self._lines.pop(tid, None)
+                self._deficit.pop(tid, None)
+                continue
+            d = self._deficit[tid]
+            if d < 1:
+                d += self._weight.get(tid, 1)
+                if d < 1:
+                    # can't serve this round even after a refill (only
+                    # possible with exotic weights); send to the back
+                    self._deficit[tid] = d
+                    self._order.rotate(-1)
+                    continue
+                self._deficit[tid] = d
+            self._last = (tid, self._deficit[tid])
+            self._deficit[tid] = d = self._deficit[tid] - 1
+            req = line.popleft()
+            self._len -= 1
+            if not line:
+                self._order.popleft()
+                self._lines.pop(tid, None)
+                self._deficit.pop(tid, None)
+            elif d < 1:
+                self._order.rotate(-1)
+            return req
+
+    def appendleft(self, req) -> None:
+        """Front-of-line undo for the single-consumer pop/put_front
+        contract: restores the request AND the scheduler position so
+        the next popleft re-serves it from the same round state."""
+        tid = _tenant_of(req)
+        line = self._lines.get(tid)
+        if line is None:
+            line = self._lines[tid] = deque()
+            self._deficit[tid] = self._weight.get(tid, 1)
+        line.appendleft(req)
+        self._len += 1
+        self._weight.setdefault(tid, _weight_of(req))
+        last = self._last
+        if last is not None and last[0] == tid:
+            # exact undo of the matching popleft: head of ring,
+            # pre-serve deficit
+            if self._order and self._order[0] == tid:
+                pass
+            elif tid in self._order:
+                # popleft rotated us to the back; bring us home
+                while self._order[0] != tid:
+                    self._order.rotate(1)
+            else:
+                self._order.appendleft(tid)
+            self._deficit[tid] = last[1]
+            self._last = None
+        elif tid not in self._order:
+            self._order.appendleft(tid)
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __bool__(self) -> bool:
+        return self._len > 0
+
+    # -- introspection -------------------------------------------------------
+    def by_tenant(self) -> dict[str, int]:
+        return {tid: len(line) for tid, line in self._lines.items()
+                if line}

@@ -187,6 +187,27 @@ Config keys (reference config style, pkg/gofr/config/config.go:3):
                       the peer drains (typed 502 when a wedged peer
                       stalls past the request deadline)
   TPU_WARMUP          "true" to precompile all buckets at startup
+  TPU_TENANTS         multi-tenant serving plane (gofr_tpu/tenancy,
+                      docs/advanced-guide/multi-tenancy.md): path to a
+                      hot-reloadable JSON tenant registry mapping
+                      tenant id -> LoRA adapter, SLO-class default,
+                      fair-share queue weight, rps/concurrency quota
+                      and cache-budget share. Unset AND no
+                      TPU_TENANTS_INLINE = tenancy off (anonymous
+                      single-tenant serving, zero overhead)
+  TPU_TENANTS_INLINE  the same registry as a literal JSON string (for
+                      tests/static fleets; TPU_TENANTS wins when both
+                      are set)
+  TPU_TENANTS_RELOAD_S  registry-file mtime poll throttle in seconds
+                      (default 0.5)
+  TPU_TENANT_HEADER   HTTP header carrying the tenant id (default
+                      X-Tenant-Id; gRPC always reads x-tenant-id
+                      metadata)
+  TPU_TENANT_TOPIC    pub/sub topic the async inference lane consumes
+                      (default inference-jobs); the lane is installed
+                      by tenancy.install_async_lane(app)
+  TPU_TENANT_CHECKPOINT_EVERY  async-lane resume-checkpoint cadence in
+                      tokens (default 8)
 """
 
 from __future__ import annotations
@@ -394,6 +415,18 @@ def new_engine_from_config(cfg, logger=None, metrics=None,
         seq_b = tuple(b for b in seq_buckets if b <= max_seq) or (max_seq,)
         engine.register("score", score_fn, params, kind="tokens",
                         batch_buckets=batch_buckets, seq_buckets=seq_b)
+
+    # multi-tenant plane: registry + quotas + fair-share weights
+    # (gofr_tpu/tenancy). Installed on the engine AND pushed into the
+    # generator so the pending line fans into per-tenant DRR queues and
+    # the kv cache learns its per-tenant budget shares.
+    from ..tenancy import plane_from_config
+
+    plane = plane_from_config(cfg, metrics=metrics, logger=logger)
+    if plane is not None:
+        engine.tenancy = plane
+        if engine.generator is not None:
+            engine.generator.install_tenancy(plane)
 
     role_key = cfg.get("TPU_SERVING_ROLE")
     if role_key:

@@ -122,6 +122,9 @@ class TPUEngine:
         self.serving_role = "fused"
         self.pd_prefill = None
         self.pd_ingest = None
+        # tenancy.TenantPlane, set by the config wiring when TPU_TENANTS
+        # is configured; None = anonymous single-tenant serving
+        self.tenancy = None
         self._closed = False
         if metrics is not None:
             # device-byte + arbiter gauges/counters (app_tpu_device_
@@ -288,15 +291,38 @@ class TPUEngine:
 
         span = tracing.current_span()
         trace_id = span.trace_id if span else ""
-        if gate is not None:
+        tenant_spec = None
+        if self.tenancy is not None:
+            # same edge contract as generate(): resolve the ambient
+            # tenant, apply its class default, consume its quota for
+            # the duration of the call
+            from ..tenancy.registry import current_tenant
+
+            tenant_spec = self.tenancy.resolve(current_tenant())
+            slo_class = self.tenancy.effective_class(tenant_spec, slo_class)
             try:
-                gate.admit(batcher.queue_depth(), program=program,
-                           slo_class=slo_class)
+                self.tenancy.admit(tenant_spec, program=program,
+                                   slo_class=slo_class, gate=gate)
             except BaseException:
                 if self._tl is not None:
                     self._tl.shed(program, slo_class, trace_id)
                 raise
-        self._validate_item(self._programs[program], item)
+        try:
+            if gate is not None:
+                try:
+                    gate.admit(batcher.queue_depth(), program=program,
+                               slo_class=slo_class,
+                               tenant=tenant_spec.tenant_id
+                               if tenant_spec is not None else "")
+                except BaseException:
+                    if self._tl is not None:
+                        self._tl.shed(program, slo_class, trace_id)
+                    raise
+            self._validate_item(self._programs[program], item)
+        except BaseException:
+            if tenant_spec is not None:
+                self.tenancy.release(tenant_spec.tenant_id)
+            raise
         t0 = time.monotonic()
         entry = None
         if self.observe is not None:
@@ -310,6 +336,8 @@ class TPUEngine:
             failed = e
             raise
         finally:
+            if tenant_spec is not None:
+                self.tenancy.release(tenant_spec.tenant_id)
             dur = time.monotonic() - t0
             if self.observe is not None:
                 self.observe.requests.remove(entry)
@@ -453,6 +481,8 @@ class TPUEngine:
                     details["hbm_arbiter"][k] = arb[k]
         if self.generator is not None:
             details["generator"] = self.generator.stats()
+        if self.tenancy is not None:
+            details["tenancy"] = self.tenancy.stats()
         if self.serving_role != "fused":
             # role-aware health (disaggregated-serving.md): a decode
             # worker reports its ingest listener, a prefill worker its

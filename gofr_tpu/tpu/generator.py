@@ -46,6 +46,8 @@ from ..models import llama
 from ..models.common import ModelConfig
 from ..resilience import (SLO_LATENCY, SLO_THROUGHPUT, DecodePipelinePolicy,
                           current_deadline, current_slo_class)
+from ..tenancy.fair import WeightedFairLine
+from ..tenancy.registry import current_tenant
 from ..wire import PushStream
 from . import hbm
 from .batcher import pad_bucket
@@ -64,7 +66,14 @@ class _ClassPending:
 
     Thread model: any thread puts (``generate()``); ONLY the serving
     loop pops — the same single-consumer contract the old queue.Queue
-    carried, which is what makes pop-then-push-front requeues exact."""
+    carried, which is what makes pop-then-push-front requeues exact.
+
+    Each class line is a ``WeightedFairLine``: inside a class, tenants
+    are served deficit-round-robin over their registry queue weight
+    (2:1:1 weights pop A,A,B,C under saturation). Requests without a
+    tenant all ride the default line, which collapses each class back
+    to the plain FIFO this started as — the latency/throughput split
+    and anti-starvation streak above are unchanged."""
 
     def __init__(self, throughput_share: float = 0.25):
         share = min(max(float(throughput_share), 0.0), 1.0)
@@ -74,8 +83,8 @@ class _ClassPending:
         # toward throughput-first). None disables the guarantee
         # (throughput then drains only when the latency line is empty).
         self._weight = (int((1.0 - share) / share) if share > 0 else None)
-        self._lat: "deque[_Request]" = deque()
-        self._thr: "deque[_Request]" = deque()
+        self._lat = WeightedFairLine()
+        self._thr = WeightedFairLine()
         self._lock = threading.Lock()
         self._lat_streak = 0
         self._prev_streak = 0  # streak before the most recent pop
@@ -124,6 +133,16 @@ class _ClassPending:
 
     def qsize_class(self, slo_class: str) -> int:
         return len(self._thr if slo_class == SLO_THROUGHPUT else self._lat)
+
+    def qsize_by_tenant(self) -> dict[str, int]:
+        """Queued requests per tenant across both class lines (the
+        per-tenant queue-depth gauge; snapshot under the put lock so a
+        concurrent put can't double-count a request mid-move)."""
+        with self._lock:
+            out = dict(self._lat.by_tenant())
+            for tid, n in self._thr.by_tenant().items():
+                out[tid] = out.get(tid, 0) + n
+            return out
 
     def empty(self) -> bool:
         return not (self._lat or self._thr)
@@ -274,6 +293,12 @@ class GenStream(PushStream):
         # re-keys the PRNG identically (None for greedy requests)
         self.cursor_base = 0
         self.seed: int | None = None
+        # tenancy: the resolved (canonical) tenant id for wide events
+        # and per-tenant metric labels; ``_tenant_held`` marks a live
+        # concurrency-quota slot that must be released exactly once at
+        # the stream's terminal (whatever that terminal is)
+        self.tenant: str = "default"
+        self._tenant_held = False
 
     def tokens(self) -> list[int]:
         """Drain the whole stream (blocking) into a list of ids
@@ -288,7 +313,8 @@ class _Request:
     __slots__ = ("stream", "prompt", "max_new", "temperature", "top_k",
                  "eos_id", "adapter", "enqueued_at", "lattice_peek",
                  "kv_match", "deadline", "slo_class", "kv_sink",
-                 "kv_shipped", "ingest", "seed", "pos_base")
+                 "kv_shipped", "ingest", "seed", "pos_base", "tenant",
+                 "tenant_weight")
 
     @property
     def logprobs(self) -> bool:
@@ -334,6 +360,10 @@ class _Request:
         # stream consumes the key token P of the original would have
         self.seed = 0
         self.pos_base = 0
+        # tenancy: the fair line's scheduling key and DRR quantum (the
+        # registry queue weight, snapshotted at admission)
+        self.tenant = "default"
+        self.tenant_weight = 1
 
 
 class _Inflight:
@@ -905,6 +935,12 @@ class GenerationEngine:
         self._admitting = 0
         self.total_tokens = 0
         self.total_requests = 0
+        # tenancy plane (gofr_tpu/tenancy/): installed post-construction
+        # by install_tenancy(); None means every request is the
+        # anonymous default tenant and nothing tenant-shaped runs
+        self.tenancy = None
+        self._tenant_leased: set[str] = set()   # live tenant:{id} leases
+        self._gauge_tenants: set[str] = set()   # tenants ever gauged
 
         self._chunk_mid = functools.partial(self._chunk_fn, sample=False)
         self._chunk_final = functools.partial(self._chunk_fn, sample=True)
@@ -921,6 +957,18 @@ class GenerationEngine:
         self._thread = threading.Thread(target=self._loop, name="gofr-tpu-gen",
                                         daemon=True)
         self._thread.start()
+
+    def install_tenancy(self, plane) -> None:
+        """Attach the multi-tenant serving plane (tenancy.TenantPlane).
+        From here on generate() resolves the ambient tenant against the
+        registry: quota admission, weighted fair queueing, per-tenant
+        cache budgets, and tenant-labeled telemetry all switch on."""
+        self.tenancy = plane
+        if plane is not None and self._kvc is not None:
+            row_bytes = 0
+            if self._pool is not None and self._kvc.slots > 0:
+                row_bytes = hbm.tree_nbytes(self._pool) // self._kvc.slots
+            self._kvc.set_tenancy(plane.cache_shares, row_bytes=row_bytes)
 
     def _alloc_scratch(self) -> None:
         """Allocate the dense single-slot scratch row (paged chunk
@@ -1619,39 +1667,72 @@ class GenerationEngine:
             slo_class = current_slo_class()
         elif slo_class not in (SLO_LATENCY, SLO_THROUGHPUT):
             raise GenerationError(f"unknown slo_class {slo_class!r}")
+        tenant_spec = None
+        tenant = None
+        if self.tenancy is not None:
+            # resolve the ambient tenant (stamped by the transport's
+            # tenant_scope) against the registry: canonical id, class
+            # default for untagged traffic, registry-routed LoRA
+            tenant_spec = self.tenancy.resolve(current_tenant())
+            tenant = tenant_spec.tenant_id
+            slo_class = self.tenancy.effective_class(tenant_spec, slo_class)
+            adapter = self.tenancy.effective_adapter(tenant_spec,
+                                                     int(adapter))
         if deadline is not None and deadline.expired():
             self._count_expired(where="post-handoff" if ingest is not None
                                 else "pre-queue")
             raise DeadlineExceeded("deadline expired before generate() "
                                    "was queued")
-        if self.gate is not None:
+        if tenant_spec is not None:
             try:
-                self.gate.admit(self._pending.qsize(), program="generate",
-                                slo_class=slo_class)
+                # per-tenant quota FIRST: an over-quota tenant sheds on
+                # its own 429 (reason=tenant_quota) without consuming
+                # the shared gate's judgment of global pressure
+                self.tenancy.admit(tenant_spec, program="generate",
+                                   slo_class=slo_class, gate=self.gate)
             except BaseException:
-                # shed: the request dies HERE, before a stream exists —
-                # its canonical wide event and timeline marker are the
-                # only record that it ever arrived
-                self._wide_shed(slo_class)
+                self._wide_shed(slo_class, tenant=tenant)
                 raise
-            max_new_tokens = self.gate.cap_tokens(max_new_tokens,
-                                                  slo_class=slo_class)
-        if eos_id is not None and not isinstance(eos_id, (int, np.integer)):
-            eos_id = frozenset(int(t) for t in eos_id) or None
-        elif isinstance(eos_id, np.integer):
-            eos_id = int(eos_id)
-        if adapter and not 0 <= adapter < max(self._n_adapters, 1):
-            raise GenerationError(
-                f"adapter {adapter} out of range (engine has "
-                f"{self._n_adapters} LoRA adapter slots)")
-        if seed is not None:
-            seed = int(seed) & 0x7FFFFFFF
-        elif temperature > 0:
-            # deterministic per-engine auto-seed: same engine seed +
-            # same submission order -> same streams, and the value is
-            # surfaced on the stream so a resume token can replay it
-            seed = (self._seed * 1000003 + next(self._auto_seed)) \
-                & 0x7FFFFFFF
+        try:
+            # from here to enqueue, the tenant holds a live concurrency
+            # slot: EVERY early raise must give it back (the stream's
+            # terminal releases it otherwise)
+            if self.gate is not None:
+                try:
+                    self.gate.admit(self._pending.qsize(),
+                                    program="generate",
+                                    slo_class=slo_class,
+                                    tenant=tenant or "")
+                except BaseException:
+                    # shed: the request dies HERE, before a stream
+                    # exists — its canonical wide event and timeline
+                    # marker are the only record that it ever arrived
+                    self._wide_shed(slo_class, tenant=tenant)
+                    raise
+                max_new_tokens = self.gate.cap_tokens(max_new_tokens,
+                                                      slo_class=slo_class)
+            if eos_id is not None and not isinstance(eos_id,
+                                                     (int, np.integer)):
+                eos_id = frozenset(int(t) for t in eos_id) or None
+            elif isinstance(eos_id, np.integer):
+                eos_id = int(eos_id)
+            if adapter and not 0 <= adapter < max(self._n_adapters, 1):
+                raise GenerationError(
+                    f"adapter {adapter} out of range (engine has "
+                    f"{self._n_adapters} LoRA adapter slots)")
+            if seed is not None:
+                seed = int(seed) & 0x7FFFFFFF
+            elif temperature > 0:
+                # deterministic per-engine auto-seed: same engine seed +
+                # same submission order -> same streams, and the value
+                # is surfaced on the stream so a resume token can
+                # replay it
+                seed = (self._seed * 1000003 + next(self._auto_seed)) \
+                    & 0x7FFFFFFF
+        except BaseException:
+            if tenant_spec is not None:
+                self.tenancy.release(tenant)
+            raise
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         stream = GenStream(next(_REQ_IDS), self, logprobs=logprobs)
         stream.trace["submit"] = time.monotonic()
@@ -1659,9 +1740,12 @@ class GenerationEngine:
         stream.slo_class = slo_class
         stream.cursor_base = pos_base
         stream.seed = seed
+        stream.tenant = tenant or "default"
+        stream._tenant_held = tenant_spec is not None
         if len(prompt) == 0:
             stream._q.put(GenerationError("empty prompt"))
             stream._q.put(None)
+            self._release_tenant(stream)
             return stream
         # Prompts longer than the largest bucket run through chunked
         # prefill at admission (see _start; paged engines chunk into a
@@ -1673,6 +1757,7 @@ class GenerationEngine:
             stream._q.put(GenerationError(
                 f"prompt length {len(prompt)} exceeds serving limit {limit}"))
             stream._q.put(None)
+            self._release_tenant(stream)
             return stream
         if self._paged:
             # fail-fast when the POOL can never hold this prompt — a
@@ -1686,6 +1771,7 @@ class GenerationEngine:
                     f"{usable} (raise TPU_PAGED_BLOCKS or "
                     "TPU_PAGED_BLOCK)"))
                 stream._q.put(None)
+                self._release_tenant(stream)
                 return stream
         if traceparent:
             # explicit cross-process context (the P/D ingest path): the
@@ -1742,6 +1828,9 @@ class GenerationEngine:
                 req.ingest = ingest
                 req.seed = 0 if seed is None else seed
                 req.pos_base = pos_base
+                if tenant_spec is not None:
+                    req.tenant = tenant
+                    req.tenant_weight = tenant_spec.weight
                 self._pending.put(req)
         except BaseException:
             self._obs_end(stream, "failed", error="rejected at admission")
@@ -1772,6 +1861,10 @@ class GenerationEngine:
                 "pipeline": self._pipeline_stats(),
             },
         }
+        if self.tenancy is not None:
+            out["scheduler"]["queued_by_tenant"] = \
+                self._pending.qsize_by_tenant()
+            out["tenancy"] = self.tenancy.stats()
         if self.mesh is not None:
             out["mesh"] = {
                 "devices": int(self.mesh.devices.size),
@@ -3065,7 +3158,9 @@ class GenerationEngine:
         self.cache = self._pool_load_jit(self.cache, self._pool,
                                          jnp.int32(idx), jnp.int32(row))
         restore_s = time.monotonic() - t_start
-        self._kvc.accept(mt, restore_s)
+        self._kvc.accept(mt, restore_s,
+                         tenant=req.tenant if self.tenancy is not None
+                         else None)
         req.stream.cache_tier = mt.tier
         req.stream.cache_tokens = m_eff
         if self._tl is not None:
@@ -3104,10 +3199,14 @@ class GenerationEngine:
         if self._kvc is None or len(prompt) < self._store_min \
                 or self._kvc.covered(prompt, req.adapter):
             return
-        row, victim = self._kvc.store(prompt, req.adapter)
+        row, victim = self._kvc.store(prompt, req.adapter,
+                                      tenant=req.tenant
+                                      if self.tenancy is not None else None)
         self._offload_victim(victim)
         self._pool = self._pool_store_jit(self._pool, self.cache,
                                           jnp.int32(row), jnp.int32(idx))
+        if self.tenancy is not None:
+            self._tenant_cache_sync()
         if self._kvc.shares:
             # write-through: a device_get of the slot's fresh KV is the
             # price of warming every replica — but only through the
@@ -3268,6 +3367,52 @@ class GenerationEngine:
                                   "by hbm arbiter reclaim"})
         return 0
 
+    def _tenant_cache_sync(self) -> None:
+        """Reconcile per-tenant arbiter leases with the cache ledger.
+        A tenant holding more T0 rows than its cache-share budget gets
+        a zero-byte ``tenant:{id}`` lease at PRI_SCRATCH whose reclaim
+        callback evicts THAT tenant's rows — so arbiter pressure asks
+        the over-budget tenant to give back its own blocks before the
+        PRI_CACHE pool shrink flushes everyone's. Back under budget,
+        the lease releases. Zero-byte because the pool's own lease
+        already accounts the bytes (the paged-index precedent); this
+        lease exists purely for its reclaim ordering."""
+        kvc = self._kvc
+        if kvc is None or self.tenancy is None:
+            return
+        try:
+            over = set()
+            for tid, rows in kvc.tenant_rows().items():
+                budget = kvc.tenant_budget(tid)
+                if budget is not None and rows > budget:
+                    over.add(tid)
+            for tid in over - self._tenant_leased:
+                hbm.tenant_lease(
+                    "kvcache-t0", 0, tenant=tid, owner=self,
+                    priority=hbm.PRI_SCRATCH,
+                    reclaim=lambda ask, t=tid: self._tenant_cache_evict(t))
+                self._tenant_leased.add(tid)
+            for tid in self._tenant_leased - over:
+                hbm.release("kvcache-t0", owner=self, tag=f"tenant:{tid}")
+                self._tenant_leased.discard(tid)
+        except Exception:
+            pass  # quota leases are best-effort; serving must not stall
+
+    def _tenant_cache_evict(self, tenant: str) -> int:
+        """Arbiter reclaim callback for a tenant's cache-quota lease:
+        evict the over-budget tenant's own T0 rows (LRU-first, down to
+        its budget), spilling each to the host tier exactly like a
+        store-path victim — warm state degrades to T1, other tenants'
+        rows are untouched. Reports 0 toward a byte deficit (the pool
+        lease accounts the bytes) but still frees the contended rows."""
+        if self._kvc is None:
+            return 0
+        with self._device_lock:
+            for victim in self._kvc.evict_tenant(tenant):
+                self._offload_victim(victim)
+        self._tenant_cache_sync()
+        return 0
+
     def _count_expired(self, where: str = "queue",
                        request_id=None) -> None:
         if self._tl is not None:
@@ -3279,11 +3424,27 @@ class GenerationEngine:
             except Exception:
                 pass
 
+    def _release_tenant(self, stream: GenStream) -> None:
+        """Give back the stream's tenant concurrency-quota slot, exactly
+        once, at whatever terminal the stream reaches (finish, failure,
+        cancel, early-return error stream)."""
+        if not stream._tenant_held:
+            return
+        stream._tenant_held = False
+        if self.tenancy is not None:
+            try:
+                self.tenancy.release(stream.tenant)
+            except Exception:
+                pass  # quota bookkeeping must never take the loop down
+
     # -- flight-recorder plumbing (all no-ops without an Observe bundle) -----
     def _obs_end(self, stream: GenStream, event: str, **fields) -> None:
         """Remove the request's registry entry, record its terminal
         lifecycle event (finished/failed/cancelled), and emit the
-        request's canonical WIDE event."""
+        request's canonical WIDE event. Every stream's one terminal
+        passes through here, which is what makes it the tenant
+        quota-release point."""
+        self._release_tenant(stream)
         if self._observe is not None:
             self._observe.requests.remove(stream.obs_entry)
             self._observe.recorder.record(event, request_id=stream.request_id,
@@ -3291,12 +3452,17 @@ class GenerationEngine:
         self._wide_event(stream, event, fields)
 
     def _wide_fields(self, outcome: str, trace_id: str,
-                     slo_class: str) -> dict:
+                     slo_class: str, tenant: str | None = None) -> dict:
         """The canonical wide-event skeleton: key order is part of the
         contract (one grep on ``"event": "request"`` reconstructs any
-        request; dashboards and scripts rely on stable field names)."""
-        return {"event": "request", "outcome": outcome,
-                "trace_id": trace_id, "slo_class": slo_class}
+        request; dashboards and scripts rely on stable field names).
+        ``tenant`` appears only on tenancy-enabled engines — events from
+        planeless deployments are byte-stable against older tooling."""
+        out = {"event": "request", "outcome": outcome,
+               "trace_id": trace_id, "slo_class": slo_class}
+        if tenant is not None:
+            out["tenant"] = tenant
+        return out
 
     def _wide_event(self, stream: GenStream, outcome: str,
                     fields: dict) -> None:
@@ -3313,7 +3479,9 @@ class GenerationEngine:
             # of an interrupted stream — surface it as its own outcome
             # so dashboards can count resumes without joining on fields
             outcome = "resumed"
-        wide = self._wide_fields(outcome, stream.trace_id, stream.slo_class)
+        wide = self._wide_fields(
+            outcome, stream.trace_id, stream.slo_class,
+            tenant=stream.tenant if self.tenancy is not None else None)
         wide.update({
             "request_id": stream.request_id,
             "prompt_len": stream.prompt_len,
@@ -3390,7 +3558,7 @@ class GenerationEngine:
             except Exception:
                 pass  # telemetry must never take the serving loop down
 
-    def _wide_shed(self, slo_class: str) -> None:
+    def _wide_shed(self, slo_class: str, tenant: str | None = None) -> None:
         """Wide event + timeline marker for a request shed at the gate
         (no stream exists yet; the ambient span is the only trace
         context the request ever had)."""
@@ -3403,7 +3571,7 @@ class GenerationEngine:
                 trace_id = span.trace_id
         if self._tl is not None:
             self._tl.shed("generate", slo_class, trace_id)
-        wide = self._wide_fields("shed", trace_id, slo_class)
+        wide = self._wide_fields("shed", trace_id, slo_class, tenant=tenant)
         wide["sheds"] = 1
         if self._observe is not None:
             self._observe.recorder.record(
@@ -3469,6 +3637,15 @@ class GenerationEngine:
             self.metrics.set_gauge("app_tpu_queue_depth",
                                    float(self._pending.qsize_class(cls)),
                                    program="generate", slo_class=cls)
+        if self.tenancy is not None:
+            # per-tenant wait lines; a tenant that drained must zero
+            # (not freeze) its gauge, so remember everyone ever seen
+            by_tenant = self._pending.qsize_by_tenant()
+            self._gauge_tenants.update(by_tenant)
+            for tid in self._gauge_tenants:
+                self.metrics.set_gauge("app_tpu_queue_depth",
+                                       float(by_tenant.get(tid, 0)),
+                                       program="generate", tenant=tid)
 
     def _start(self, idx: int, slot: _Slot, req: _Request,
                blocks: "tuple | None" = None) -> None:
@@ -3636,11 +3813,17 @@ class GenerationEngine:
             if self.metrics is not None:
                 # the exemplar makes a dashboard's p99 TTFT bucket
                 # resolve to the exact trace that populated it
-                self.metrics.record_histogram("app_tpu_ttft_duration", ttft,
-                                              exemplar=req.stream.trace_id
-                                              or None,
-                                              program="generate",
-                                              slo_class=req.slo_class)
+                if self.tenancy is not None:
+                    self.metrics.record_histogram(
+                        "app_tpu_ttft_duration", ttft,
+                        exemplar=req.stream.trace_id or None,
+                        program="generate", slo_class=req.slo_class,
+                        tenant=req.tenant)
+                else:
+                    self.metrics.record_histogram(
+                        "app_tpu_ttft_duration", ttft,
+                        exemplar=req.stream.trace_id or None,
+                        program="generate", slo_class=req.slo_class)
             self._obs_stage(req.stream, "decode")
             if self._observe is not None:
                 self._observe.recorder.record(

@@ -488,6 +488,24 @@ class _Registry:
     def _in_use_locked(self) -> int:
         return sum(self._entries.values())
 
+    def tenant_lease(self, subsystem: str, nbytes: int, *, tenant: str,
+                     owner: Any = None, priority: int = PRI_SCRATCH,
+                     reclaim: Callable[[int], int] | None = None,
+                     device: str = "") -> int:
+        """A per-tenant cache-quota lease: :func:`lease` with the tag
+        fixed to ``tenant:{id}`` so the tenant's footprint is visible
+        in ``snapshot()``/``check()`` under its own key. Registered at
+        PRI_SCRATCH (most-reclaimable) with a reclaim callback that
+        evicts THAT tenant's cache blocks — under memory pressure the
+        arbiter asks the over-budget tenant to give back its own rows
+        BEFORE the PRI_CACHE pool shrink flushes everyone's. Usually
+        zero-byte: the pool's own lease already accounts the bytes;
+        this one exists for its reclaim ordering (the same convention
+        as the paged pool's zero-byte reclaim hooks)."""
+        return self.lease(subsystem, int(nbytes), owner=owner,
+                          tag=f"tenant:{tenant}", priority=priority,
+                          reclaim=reclaim, device=device)
+
     def lease(self, subsystem: str, nbytes: int, *, owner: Any = None,
               tag: str = "", priority: int = PRI_CACHE,
               reclaim: Callable[[int], int] | None = None,
@@ -1066,3 +1084,4 @@ set_device_budget = _registry.set_device_budget
 set_metrics = _registry.set_metrics
 set_timeline = _registry.set_timeline
 snapshot = _registry.snapshot
+tenant_lease = _registry.tenant_lease

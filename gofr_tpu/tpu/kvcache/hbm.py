@@ -45,17 +45,29 @@ class HBMTier:
         _, m = self.index.match(prompt, adapter)
         return m >= len(prompt)
 
-    def store(self, key: np.ndarray, adapter: int = 0
-              ) -> tuple[int, Entry | None]:
+    def store(self, key: np.ndarray, adapter: int = 0,
+              prefer=None) -> tuple[int, Entry | None]:
         """Claim a row for a new entry: a free row, else the LRU
         victim's. Returns (row, victim) with the victim ALREADY
         unindexed but its key/payload intact — the caller must read the
         victim's pool row (for host-tier spill) BEFORE dispatching the
-        store that overwrites it."""
+        store that overwrites it.
+
+        ``prefer``: optional set of entry ids (``Entry.eid``) to
+        victimize FIRST — the cache manager passes the over-budget
+        tenants' entries here so a tenant past its share evicts its own
+        blocks before touching anyone else's. LRU order applies within
+        the preferred set; an empty/absent set is plain global LRU."""
         victim = None
         row = next((i for i, e in enumerate(self._rows) if e is None), None)
         if row is None:
-            row = min(range(self.slots), key=lambda i: self._rows[i].tick)
+            candidates = None
+            if prefer:
+                candidates = [i for i in range(self.slots)
+                              if self._rows[i].eid in prefer]
+            if not candidates:
+                candidates = range(self.slots)
+            row = min(candidates, key=lambda i: self._rows[i].tick)
             victim = self._rows[row]
             self.index.remove(victim)
             self.evictions += 1
@@ -64,6 +76,21 @@ class HBMTier:
         self._rows[row] = entry
         self.touch(entry)
         return row, victim
+
+    def entry_at(self, row: int) -> Entry | None:
+        return self._rows[row] if 0 <= row < self.slots else None
+
+    def evict(self, entry: Entry) -> bool:
+        """Targeted eviction: unindex ``entry`` and free its row (the
+        caller spills the row's KV first, exactly like a store-path
+        victim). Used by the per-tenant cache-quota reclaim."""
+        row = entry.payload
+        if not (0 <= row < self.slots) or self._rows[row] is not entry:
+            return False
+        self.index.remove(entry)
+        self._rows[row] = None
+        self.evictions += 1
+        return True
 
     def entries(self) -> list[Entry]:
         """The live entries (arbitrary order) — the arbiter's pool

@@ -76,7 +76,7 @@ _ALLOC_SUBSTR = ("init_cache", "init_paged_cache", "init_lora")
 # generic a method name to bless bare (the paged engine's block
 # allocator is literally self._alloc.alloc)
 _ACCOUNT_FNS = {"account"}
-_ARBITER_FNS = {"alloc", "lease", "alloc_sharded"}
+_ARBITER_FNS = {"alloc", "lease", "alloc_sharded", "tenant_lease"}
 
 
 def _is_account_call(func) -> bool:

@@ -1,0 +1,493 @@
+#!/usr/bin/env python
+"""Multi-tenant serving plane benchmark: weighted fair share, quota
+shed isolation, per-tenant cache budgets, and the async lane's
+kill/resume — the four contracts of gofr_tpu/tenancy under real load.
+
+CPU-only (JAX_PLATFORMS=cpu, tiny model, no chip lock): every check is
+a RATIO or an exactness claim on identical hardware, never an absolute
+chip number. Four arms, one process, one run:
+
+ARM 1 — weighted fair share at ~2x saturation:
+  three tenants (weights 2:1:1) each keep enough closed-loop drivers
+  alive that the pending line always holds every tenant (~2x the slot
+  count outstanding). The DRR line must hand tenant A twice the decode
+  tokens of B or C: each tenant's steady-state token share must land
+  within +/-15% (relative) of its weight share.
+
+ARM 2 — quota shed isolation:
+  tenants A and B run an uncontended open-loop phase (the reference
+  tail), then re-run at the same rates while tenant "capped" (rps
+  quota far below its offered rate) hammers the same engine. The
+  quota must shed ONLY the capped tenant (typed 429,
+  reason=tenant_quota, Retry-After set), A/B must shed zero, and
+  their TTFT tail must hold: p95 within max(1.3x, +50 ms noise
+  floor) of uncontended (the same CPU-jitter rationale as
+  slo_bench's overload gate; the raw ratio is recorded).
+
+ARM 3 — per-tenant cache budgets:
+  tenants A and B each hold a 0.5 share of a small T0 prefix pool.
+  Both warm their budgets; then A floods with new prefixes. Every
+  eviction must come out of A's own rows — B's resident rows and its
+  re-query hit must survive untouched.
+
+ARM 4 — async lane kill/resume:
+  a bulk job dies mid-run after 3 tokens (worker crash), leaving a
+  Redis checkpoint; the redelivered job must resume via
+  continue_from and finish TOKEN-EXACT against the uninterrupted
+  greedy reference.
+
+Output follows the bench stdout contract (tools/README.md): the LAST
+stdout line is the JSON artifact; progress goes to stderr. Full runs
+write TENANT_BENCH.json on a green run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from gofr_tpu.errors import TooManyRequests  # noqa: E402
+from gofr_tpu.models import LLAMA_CONFIGS, llama  # noqa: E402
+from gofr_tpu.tenancy import (AsyncLane, TenantPlane,  # noqa: E402
+                              TenantRegistry, tenant_scope)
+from gofr_tpu.tpu import GenerationEngine  # noqa: E402
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def pctl(vals, p):
+    if not vals:
+        return None
+    vs = sorted(vals)
+    return vs[min(len(vs) - 1, int(p / 100.0 * len(vs)))]
+
+
+BUCKETS = (8, 16, 32)
+MAX_SEQ = 256
+SLOTS = 4
+
+
+class Harness:
+    def __init__(self):
+        self.cfg = dataclasses.replace(LLAMA_CONFIGS["tiny"],
+                                       max_seq=MAX_SEQ)
+        self.params = llama.init(self.cfg, jax.random.PRNGKey(1))
+        self.rng = np.random.default_rng(42)
+
+    def engine(self, doc=None, **kw) -> GenerationEngine:
+        kw.setdefault("slots", SLOTS)
+        kw.setdefault("max_seq", MAX_SEQ)
+        kw.setdefault("prompt_buckets", BUCKETS)
+        kw.setdefault("decode_block", 2)
+        eng = GenerationEngine(self.cfg, self.params, **kw)
+        if doc is not None:
+            eng.install_tenancy(TenantPlane(TenantRegistry.from_json(doc)))
+        eng.warmup()
+        return eng
+
+    def prompt(self, n: int):
+        return self.rng.integers(1, self.cfg.vocab_size, n).tolist()
+
+
+# -- ARM 1: weighted fair share ----------------------------------------------
+
+FAIR_DOC = {"tenants": [{"id": "A", "weight": 2},
+                        {"id": "B", "weight": 1},
+                        {"id": "C", "weight": 1}]}
+
+
+def run_fairness(h: Harness, duration: float) -> dict:
+    log("tenant_bench: fairness: building engine")
+    eng = h.engine(FAIR_DOC)
+    tokens = {"A": 0, "B": 0, "C": 0}
+    lock = threading.Lock()
+    stop = threading.Event()
+    warm = threading.Event()  # count only steady-state tokens
+
+    def drive(tenant: str) -> None:
+        while not stop.is_set():
+            try:
+                with tenant_scope(tenant):
+                    stream = eng.generate(h.prompt(16), max_new_tokens=8)
+                n = len(stream.tokens())
+            except Exception:
+                time.sleep(0.01)
+                continue
+            if warm.is_set():
+                with lock:
+                    tokens[tenant] += n
+
+    # 3 drivers per tenant vs 4 slots: the pending line always holds
+    # every tenant (~2x saturation) so DRR — not arrival luck — picks
+    threads = [threading.Thread(target=drive, args=(t,), daemon=True)
+               for t in ("A", "B", "C") for _ in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(min(2.0, duration / 4))  # warmup: fill the line
+        warm.set()
+        time.sleep(duration)
+        stop.set()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+        eng.close()
+    total = sum(tokens.values()) or 1
+    weights = {"A": 2, "B": 1, "C": 1}
+    wsum = sum(weights.values())
+    shares, errs = {}, {}
+    for t, w in weights.items():
+        shares[t] = tokens[t] / total
+        expect = w / wsum
+        errs[t] = abs(shares[t] / expect - 1.0)
+    out = {
+        "tokens": tokens,
+        "shares": {t: round(s, 4) for t, s in shares.items()},
+        "expected": {t: round(w / wsum, 4) for t, w in weights.items()},
+        "rel_err": {t: round(e, 4) for t, e in errs.items()},
+        "within_15pct": bool(max(errs.values()) <= 0.15),
+    }
+    log(f"tenant_bench: fairness: {out}")
+    return out
+
+
+# -- ARM 2: quota shed isolation ----------------------------------------------
+
+QUOTA_DOC = {"tenants": [{"id": "A", "weight": 1},
+                         {"id": "B", "weight": 1},
+                         {"id": "capped", "weight": 1, "rps": 2.0}]}
+
+
+class Phase:
+    """Open-loop per-tenant load from a fixed worker pool (the
+    slo_bench Phase pattern: pool, not thread-per-request, so spawn
+    jitter stays out of the tails)."""
+
+    WORKERS = 24
+
+    def __init__(self, h: Harness, eng, rates: dict, duration: float):
+        self.h = h
+        self.eng = eng
+        self.rates = rates
+        self.duration = duration
+        self.lock = threading.Lock()
+        self.ttft = {t: [] for t in rates}
+        self.sheds = {t: 0 for t in rates}
+        self.mistyped = 0  # tenant sheds missing the reason/Retry-After
+        self.errors: list[str] = []
+
+    def _one(self, tenant: str) -> None:
+        try:
+            with tenant_scope(tenant):
+                stream = self.eng.generate(self.h.prompt(6),
+                                           max_new_tokens=4)
+            stream.tokens()
+            t = stream.trace["first_put"] - stream.trace["submit"]
+        except TooManyRequests as e:
+            with self.lock:
+                self.sheds[tenant] += 1
+                if getattr(e, "reason", None) != "tenant_quota" \
+                        or not getattr(e, "retry_after", None):
+                    self.mistyped += 1
+            return
+        except Exception as e:  # noqa: BLE001 — tally, judge later
+            with self.lock:
+                self.errors.append(repr(e))
+            return
+        with self.lock:
+            self.ttft[tenant].append(t)
+
+    def run(self) -> dict:
+        arrivals = []
+        for tenant, rate in self.rates.items():
+            if rate <= 0:
+                continue
+            n = max(1, int(rate * self.duration))
+            arrivals += [(i / rate, tenant) for i in range(n)]
+        arrivals.sort()
+        cursor = [0]
+        t0 = time.monotonic()
+
+        def worker() -> None:
+            while True:
+                with self.lock:
+                    i = cursor[0]
+                    if i >= len(arrivals):
+                        return
+                    cursor[0] = i + 1
+                offset, tenant = arrivals[i]
+                pause = t0 + offset - time.monotonic()
+                if pause > 0:
+                    time.sleep(pause)
+                self._one(tenant)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(min(self.WORKERS, len(arrivals)))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.duration + 60.0)
+        out = {"offered": len(arrivals), "errors": len(self.errors),
+               "mistyped_sheds": self.mistyped}
+        for tenant in self.rates:
+            out[tenant] = {
+                "completed": len(self.ttft[tenant]),
+                "sheds": self.sheds[tenant],
+                "ttft_p50_ms": round((pctl(self.ttft[tenant], 50) or 0)
+                                     * 1e3, 2),
+                "ttft_p95_ms": round((pctl(self.ttft[tenant], 95) or 0)
+                                     * 1e3, 2),
+                "ttft_p99_ms": round((pctl(self.ttft[tenant], 99) or 0)
+                                     * 1e3, 2),
+            }
+        return out
+
+
+def run_quota(h: Harness, duration: float) -> dict:
+    log("tenant_bench: quota: building engine")
+    eng = h.engine(QUOTA_DOC)
+    try:
+        base_rate = 2.0  # well inside a 4-slot tiny engine's capacity
+        uncontended = Phase(h, eng, {"A": base_rate, "B": base_rate},
+                            duration).run()
+        contended = Phase(h, eng, {"A": base_rate, "B": base_rate,
+                                   "capped": 15.0}, duration).run()
+        plane_stats = eng.tenancy.stats()["tenants"]
+    finally:
+        eng.close()
+    unc_p95 = max(uncontended[t]["ttft_p95_ms"] for t in ("A", "B"))
+    over_p95 = max(contended[t]["ttft_p95_ms"] for t in ("A", "B"))
+    bound_ms = max(1.3 * unc_p95, unc_p95 + 50.0) if unc_p95 else None
+    out = {
+        "uncontended": uncontended,
+        "contended": contended,
+        "capped_plane_sheds": plane_stats["capped"]["shed"],
+        "checks": {
+            "capped_shed": bool(contended["capped"]["sheds"] > 0),
+            "sheds_typed_tenant_quota": contended["mistyped_sheds"] == 0,
+            "others_never_shed": (contended["A"]["sheds"] == 0
+                                  and contended["B"]["sheds"] == 0),
+            "tail_gate": "p95 vs max(1.3x, +50ms floor)",
+            "others_p95_ms": over_p95,
+            "others_p95_bound_ms": (round(bound_ms, 2)
+                                    if bound_ms else None),
+            "others_tail_holds": bool(bound_ms is not None
+                                      and over_p95 <= bound_ms),
+            "p95_ratio": (round(over_p95 / unc_p95, 3)
+                          if unc_p95 else None),
+        },
+    }
+    log(f"tenant_bench: quota: {out['checks']}")
+    return out
+
+
+# -- ARM 3: per-tenant cache budgets ------------------------------------------
+
+CACHE_DOC = {"tenants": [{"id": "A", "weight": 1, "cache_share": 0.5},
+                         {"id": "B", "weight": 1, "cache_share": 0.5}]}
+
+
+def run_cache(h: Harness) -> dict:
+    log("tenant_bench: cache: building engine")
+    eng = h.engine(CACHE_DOC, prefix_cache_slots=4, prefix_store_min=8)
+    rng = np.random.default_rng(7)
+
+    def gen(tenant, prompt):
+        with tenant_scope(tenant):
+            stream = eng.generate(prompt, max_new_tokens=2)
+        stream.tokens()
+        return stream
+
+    try:
+        b_prompts = [rng.integers(1, h.cfg.vocab_size, 16).tolist()
+                     for _ in range(2)]
+        for p in b_prompts:
+            gen("B", p)  # B warms its full budget (2 rows)
+        rows_after_warm = dict(eng._kvc.tenant_rows())
+        evictions_before = eng._kvc.t0.evictions
+        # A floods: 4 distinct prefixes through a 2-row budget
+        for _ in range(4):
+            gen("A", rng.integers(1, h.cfg.vocab_size, 16).tolist())
+        rows_after_flood = dict(eng._kvc.tenant_rows())
+        evictions = eng._kvc.t0.evictions - evictions_before
+        # B's working set must still be warm: a re-query hits T0
+        hits_before = eng._kvc.hits
+        s = gen("B", b_prompts[0])
+        b_hit = eng._kvc.hits > hits_before and s.cache_tokens > 0
+        budget = eng._kvc.tenant_budget("A")
+    finally:
+        eng.close()
+    out = {
+        "t0_slots": 4,
+        "budget_rows": budget,
+        "rows_after_warm": rows_after_warm,
+        "rows_after_flood": rows_after_flood,
+        "a_evictions": evictions,
+        "b_requery_hit": bool(b_hit),
+        "checks": {
+            "a_stays_at_budget": rows_after_flood.get("A", 0) <= budget,
+            "b_rows_untouched": (rows_after_flood.get("B", 0)
+                                 == rows_after_warm.get("B", 0)),
+            "a_evicted_itself": evictions >= 2,
+            "b_requery_hit": bool(b_hit),
+        },
+    }
+    log(f"tenant_bench: cache: {out['checks']}")
+    return out
+
+
+# -- ARM 4: async lane kill/resume --------------------------------------------
+
+class _Store:
+    def __init__(self):
+        self.kv = {}
+
+    def get(self, key):
+        return self.kv.get(key)
+
+    def set(self, key, value, ex=None):
+        self.kv[key] = value
+        return True
+
+
+class _Ctx:
+    def __init__(self, payload, tpu, redis):
+        self._payload = payload
+        self.tpu = tpu
+        self.redis = redis
+
+    def bind(self):
+        return self._payload
+
+
+class _KillAfter:
+    def __init__(self, engine, n):
+        self.engine = engine
+        self.n = n
+
+    def generate(self, *a, **kw):
+        stream = self.engine.generate(*a, **kw)
+
+        def die():
+            for i, item in enumerate(stream):
+                if i >= self.n:
+                    stream.cancel()
+                    raise RuntimeError("worker died mid-run")
+                yield item
+        return die()
+
+
+def run_lane(h: Harness) -> dict:
+    log("tenant_bench: lane: building engine")
+    eng = h.engine({"tenants": [{"id": "bulk", "weight": 1}]})
+    store = _Store()
+    prompt = h.prompt(8)
+    job = {"job_id": "bench", "tokens": prompt, "max_new": 8,
+           "tenant": "bulk"}
+    try:
+        ref = eng.generate(prompt, max_new_tokens=8).tokens()
+        lane = AsyncLane(checkpoint_every=2)
+        died = False
+        try:
+            lane.handle(_Ctx(job, _KillAfter(eng, 3), store))
+        except RuntimeError:
+            died = True
+        ckpt = json.loads(store.kv["async:bench"])
+        lane.handle(_Ctx(job, eng, store))  # the redelivery
+        doc = json.loads(store.kv["async:bench"])
+    finally:
+        eng.close()
+    out = {
+        "reference_tokens": len(ref),
+        "died_mid_run": died,
+        "checkpoint_tokens": len(ckpt.get("tokens", ())),
+        "checkpoint_status": ckpt.get("status"),
+        "final_status": doc.get("status"),
+        "lane": lane.stats(),
+        "checks": {
+            "killed_after_checkpoint": bool(
+                died and ckpt.get("status") == "running"
+                and ckpt.get("tokens") == [int(t) for t in ref[:3]]),
+            "resume_token_exact": doc.get("tokens")
+            == [int(t) for t in ref],
+            "marked_done": doc.get("status") == "done",
+            "counted_resumed": lane.stats()["resumed"] == 1,
+        },
+    }
+    log(f"tenant_bench: lane: {out['checks']}")
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fairness-s", type=float, default=12.0)
+    ap.add_argument("--quota-s", type=float, default=8.0)
+    ap.add_argument("--out", default=str(Path(__file__).resolve()
+                                         .parent.parent
+                                         / "TENANT_BENCH.json"))
+    ap.add_argument("--smoke", action="store_true",
+                    help="reduced CI run: no artifact file")
+    args = ap.parse_args()
+    if args.smoke:
+        args.fairness_s, args.quota_s = 6.0, 5.0
+
+    h = Harness()
+    result = {"bench": "tenant_plane", "smoke": bool(args.smoke),
+              "slots": SLOTS, "buckets": list(BUCKETS)}
+
+    result["fairness"] = run_fairness(h, args.fairness_s)
+    print(json.dumps({"partial": "quota pending", **result}), flush=True)
+    result["quota"] = run_quota(h, args.quota_s)
+    print(json.dumps({"partial": "cache pending", **result}), flush=True)
+    result["cache"] = run_cache(h)
+    result["lane"] = run_lane(h)
+
+    invariants = []
+    if sum(result["fairness"]["tokens"].values()) == 0:
+        invariants.append("fairness: no tokens decoded")
+    for phase in ("uncontended", "contended"):
+        if result["quota"][phase]["errors"]:
+            invariants.append(
+                f"quota/{phase}: {result['quota'][phase]['errors']} "
+                "errors")
+    if result["quota"]["uncontended"]["A"]["sheds"] \
+            or result["quota"]["uncontended"]["B"]["sheds"]:
+        invariants.append("quota: uncontended phase shed traffic")
+    result["invariants_failed"] = invariants
+
+    checks_ok = all((
+        result["fairness"]["within_15pct"],
+        result["quota"]["checks"]["capped_shed"],
+        result["quota"]["checks"]["sheds_typed_tenant_quota"],
+        result["quota"]["checks"]["others_never_shed"],
+        result["quota"]["checks"]["others_tail_holds"],
+        all(result["cache"]["checks"].values()),
+        all(result["lane"]["checks"].values()),
+    ))
+    ok = not invariants and checks_ok
+    result["ok"] = ok
+    if not args.smoke and ok:
+        Path(args.out).write_text(json.dumps(result, indent=1) + "\n")
+        log(f"wrote {args.out}")
+    print(json.dumps(result), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
