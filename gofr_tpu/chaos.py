@@ -60,6 +60,12 @@ Seams (grep for ``chaos.fire``):
                       process keeps serving. ``every=N`` kills
                       allocation N deterministically
   HTTP_REQUEST        http/server._handle, before routing
+  PD_INGEST           pd/ingest._on_kv, before each received KV frame
+                      is validated/assembled — an injected error is
+                      THAT transfer's fault: the ingest server rejects
+                      the one request typed (502 KVTransferError over
+                      the wire) and the reader loop keeps serving
+                      every other stream on the connection
   SERVICE_REQUEST     service/client._do, before the network hop —
                       feeds the retry/breaker composition tests
   ==================  =====================================================
@@ -83,7 +89,8 @@ __all__ = [
     "BATCHER_DISPATCH", "GATEWAY_MIDSTREAM", "GATEWAY_PICK",
     "GATEWAY_RELAY", "GENERATOR_CHUNK", "GENERATOR_MIDKILL",
     "GENERATOR_PREFILL", "GENERATOR_STEP",
-    "GRPC_STREAM", "HBM_ALLOC", "HTTP_REQUEST", "SERVICE_REQUEST", "SEAMS",
+    "GRPC_STREAM", "HBM_ALLOC", "HTTP_REQUEST", "PD_INGEST",
+    "SERVICE_REQUEST", "SEAMS",
     "ChaosSchedule", "DeviceLost", "ResourceExhausted", "Rule",
     "active", "fire", "install", "scope", "slow_h2_preface", "slow_loris",
     "uninstall",
@@ -100,12 +107,13 @@ GENERATOR_STEP = "generator.step"
 GRPC_STREAM = "grpc.stream"
 HBM_ALLOC = "hbm.alloc"
 HTTP_REQUEST = "http.request"
+PD_INGEST = "pd.ingest"
 SERVICE_REQUEST = "service.request"
 
 SEAMS = (BATCHER_DISPATCH, GATEWAY_MIDSTREAM, GATEWAY_PICK, GATEWAY_RELAY,
          GENERATOR_CHUNK, GENERATOR_MIDKILL, GENERATOR_PREFILL,
          GENERATOR_STEP, GRPC_STREAM, HBM_ALLOC,
-         HTTP_REQUEST, SERVICE_REQUEST)
+         HTTP_REQUEST, PD_INGEST, SERVICE_REQUEST)
 
 
 class DeviceLost(RuntimeError):

@@ -12,6 +12,7 @@ import contextlib
 from typing import Any
 
 from .container import Container
+from .errors import InternalServerError
 
 
 class Context:
@@ -116,7 +117,8 @@ class Context:
         thread — the same first-token latency fix as the gRPC
         ``ServerStream`` path."""
         if self._responder is None:
-            raise RuntimeError("streaming is only available on HTTP requests")
+            raise InternalServerError(
+                "streaming is only available on HTTP requests")
         w = self._responder.writer
         w.set_header("Content-Type", content_type)
         if hasattr(chunks, "set_sink"):

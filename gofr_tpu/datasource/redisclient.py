@@ -27,6 +27,12 @@ class RedisError(Exception):
     """Server-side error reply (RESP '-ERR ...')."""
 
 
+class RedisConnectionLost(ConnectionError):
+    """The server closed the connection mid-reply. Subclasses
+    ConnectionError so command()'s retry/reconnect arms keep catching
+    it, while giving the failure a typed name the wire can map."""
+
+
 def encode_command(*args: Any) -> bytes:
     """RESP2 array-of-bulk-strings request framing."""
     out = [f"*{len(args)}\r\n".encode()]
@@ -49,7 +55,7 @@ class _Reader:
         while b"\r\n" not in self._buf:
             chunk = self._sock.recv(65536)
             if not chunk:
-                raise ConnectionError("redis connection closed")
+                raise RedisConnectionLost("redis connection closed")
             self._buf += chunk
         line, self._buf = self._buf.split(b"\r\n", 1)
         return line
@@ -58,7 +64,7 @@ class _Reader:
         while len(self._buf) < n + 2:
             chunk = self._sock.recv(65536)
             if not chunk:
-                raise ConnectionError("redis connection closed")
+                raise RedisConnectionLost("redis connection closed")
             self._buf += chunk
         data, self._buf = self._buf[:n], self._buf[n + 2:]  # strip \r\n
         return data
@@ -115,7 +121,7 @@ class RedisClient:
         self.logger = logger
         self.metrics = metrics
         self.timeout = timeout
-        self._lock = threading.Lock()
+        self._io_lock = threading.Lock()
         self._sock: socket.socket | None = None
         self._reader: _Reader | None = None
         self._connect()
@@ -142,7 +148,7 @@ class RedisClient:
         label = str(args[0]).upper() if args else ""
         start = time.perf_counter()
         payload = encode_command(*args)
-        with self._lock:
+        with self._io_lock:
             try:
                 self._sock.sendall(payload)
             except (ConnectionError, OSError, AttributeError):
@@ -166,7 +172,7 @@ class RedisClient:
             return []
         start = time.perf_counter()
         payload = b"".join(encode_command(*c) for c in cmds)
-        with self._lock:
+        with self._io_lock:
             try:
                 self._sock.sendall(payload)
                 replies = []
@@ -293,7 +299,7 @@ class RedisClient:
                 "host": f"{self.host}:{self.port}", "error": repr(e)})
 
     def close(self) -> None:
-        with self._lock:
+        with self._io_lock:
             if self._sock is not None:
                 try:
                     self._sock.close()

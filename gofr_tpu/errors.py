@@ -76,6 +76,22 @@ class EntityNotFound(NotFound):
         self.value = value
 
 
+class ProgramNotFound(NotFound, KeyError):
+    """An inference request named a TPU program the engine never
+    registered -> 404 with the known-program list, instead of the raw
+    500 a bare KeyError becomes. Subclasses KeyError so callers doing
+    dict-style lookup-miss handling keep working."""
+
+    def __init__(self, program: str, registered: list[str] | None = None):
+        known = f"; registered: {sorted(registered)}" if registered else ""
+        super().__init__(f"no TPU program {program!r}{known}")
+        self.program = program
+
+    # KeyError.__str__ repr()s the message (dict-miss convention);
+    # wire errors must render the plain text
+    __str__ = Exception.__str__
+
+
 class InvalidParameter(BadRequest):
     def __init__(self, *params: str):
         super().__init__(f"Invalid parameter(s): {', '.join(params)}")
@@ -150,6 +166,19 @@ class DeadlineExceeded(HTTPError):
     status_code = 504
 
     def __init__(self, message: str = "deadline exceeded"):
+        super().__init__(message)
+
+
+class ConnectionLost(HTTPError, EOFError):
+    """A transport peer vanished mid-exchange — socket closed, GOAWAY,
+    half-read frame. 502 on HTTP (the upstream died, not us).
+    Subclasses EOFError because EOFError is this repo's long-standing
+    transport-loss sentinel: every ``except (EOFError, OSError)`` arm
+    in wire/grpcx/pd keeps catching it unchanged."""
+
+    status_code = 502
+
+    def __init__(self, message: str = "connection lost"):
         super().__init__(message)
 
 

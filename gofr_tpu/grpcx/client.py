@@ -14,6 +14,7 @@ import socket
 import threading
 import urllib.parse
 
+from ..errors import ConnectionLost
 from . import http2 as h2
 from . import service as svc
 from .hpack import Decoder, Encoder
@@ -141,7 +142,7 @@ class GRPCChannel:
                                          f"stream reset (http2 code {code})"))
                 call.q.put(None)
         elif f.type == h2.GOAWAY:
-            raise EOFError("server sent GOAWAY")
+            raise ConnectionLost("server sent GOAWAY")
 
     def _pop_call(self, sid: int) -> _Call | None:
         with self._lock:

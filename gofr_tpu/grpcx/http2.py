@@ -17,6 +17,7 @@ import socket
 import struct
 import threading
 
+from ..errors import ConnectionLost
 from ..wire import SocketWriter
 
 # frame types (§6)
@@ -177,7 +178,7 @@ class FrameIO:
         while len(self._rbuf) < n:
             chunk = self.sock.recv(65536)
             if not chunk:
-                raise EOFError("peer closed connection")
+                raise ConnectionLost("peer closed connection")
             self._rbuf += chunk
         out, self._rbuf = self._rbuf[:n], self._rbuf[n:]
         return out
@@ -302,7 +303,7 @@ class FlowWindow:
                 if not self._cond.wait(timeout):
                     raise TimeoutError("flow-control window starved")
             if self._dead:
-                raise EOFError("stream/connection closed")
+                raise ConnectionLost("stream/connection closed")
             take = min(want, self.value)
             self.value -= take
             return take

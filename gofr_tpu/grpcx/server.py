@@ -125,8 +125,8 @@ class _Connection:
         try:
             payload = struct.pack(">II", self._last_stream, code) + msg.encode()[:128]
             self.io.send_frame(h2.GOAWAY, 0, 0, payload)
-        except (EOFError, OSError):
-            pass
+        except (EOFError, OSError):  # noqa: GL303 — best-effort GOAWAY:
+            pass  # the peer this goodbye is FOR is the thing that died
 
     # -- frame dispatch ------------------------------------------------------
     def _dispatch(self, f: h2.Frame) -> None:

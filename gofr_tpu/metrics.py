@@ -357,6 +357,10 @@ ITL_BUCKETS = (0.0005, 0.001, 0.002, 0.004, 0.008, 0.015, 0.03, 0.05, 0.1,
 # interleaves). The first bucket splits "pipelined" from "not":
 GAP_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.002, 0.004, 0.008, 0.015,
                0.03, 0.06, 0.12, 0.25, 0.5, 1, 3)
+# Resume recompute cost is measured in TOKENS re-prefilled, not
+# seconds: powers-of-two up through a long context's worth
+RECOMPUTE_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+                     2048, 4096, 8192)
 
 
 def register_framework_metrics(m: Manager) -> None:
@@ -532,6 +536,21 @@ def register_framework_metrics(m: Manager) -> None:
     m.new_gauge("app_tpu_gateway_pressure",
                 "per-replica memory-pressure score (decaying; fed by "
                 "429 X-Shed-Reason: hbm responses)")
+    # durable streams (docs/advanced-guide/durable-streams.md): how
+    # often replica death forced a token-exact continuation, and what
+    # each one cost in re-prefilled tokens
+    m.new_counter("app_tpu_gateway_resumes_total",
+                  "committed relays continued on another replica after "
+                  "mid-stream loss (the durable-streams save; pairs "
+                  "with app_tpu_gateway_midstream_total as the "
+                  "could-not-resume remainder)")
+    m.new_histogram("app_tpu_resume_recompute_tokens",
+                    "tokens re-prefilled to rebuild generation state "
+                    "for one resumed stream", RECOMPUTE_BUCKETS)
+    m.new_counter("app_tpu_pd_resumes_total",
+                  "decode streams resumed by the P/D coordinator after "
+                  "a decode-replica loss (KV re-shipped, stream "
+                  "continued token-exact)")
 
     # tracing export health (tracing.ZipkinExporter): spans dropped
     # because the pending buffer hit its bound while the collector was

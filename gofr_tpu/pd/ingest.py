@@ -39,6 +39,7 @@ import time
 
 import numpy as np
 
+from .. import chaos
 from ..resilience import Deadline
 from ..tpu.kvcache import KVLayout
 from ..tpu.kvcache.quant import concat_blocks, decode_block
@@ -256,6 +257,14 @@ class KVIngestServer:
         asm = pending.get(req_id)
         if asm is None:
             return  # already failed/cancelled: drain silently
+        try:
+            chaos.fire(chaos.PD_INGEST)
+        except Exception as e:
+            # an injected fault is THIS transfer's fault: typed 502 to
+            # the prefill peer, the reader loop keeps serving
+            self._reject(conn, req_id, pending,
+                         f"injected ingest fault: {e}")
+            return
         start, frame = p.unpack_kv(payload)
         kv = decode_block(frame, self.layout)
         if kv is None:

@@ -28,7 +28,7 @@ import socket
 import threading
 import time
 
-from ..errors import DeadlineExceeded, GofrError
+from ..errors import ConnectionLost, DeadlineExceeded, GofrError
 from ..resilience import current_deadline, current_slo_class
 from ..service.reconnect import ReconnectBackoff
 from ..tpu.kvcache.quant import concat_blocks, encode_block
@@ -282,7 +282,7 @@ class PDPrefill:
                 msg = p.read_msg(sock)
                 t3 = time.time()
                 if msg is None:
-                    raise EOFError("peer closed during hello")
+                    raise ConnectionLost("peer closed during hello")
                 mtype, _, payload = msg
                 if mtype == p.ERR:
                     err = p.error_from_wire(json.loads(bytes(payload)))

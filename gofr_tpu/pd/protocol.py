@@ -63,8 +63,9 @@ import struct
 import threading
 import time
 
-from ..errors import (DeadlineExceeded, HTTPError, ServiceUnavailable,
-                      TooManyRequests, format_retry_after)
+from ..errors import (ConnectionLost, DeadlineExceeded, HTTPError,
+                      ServiceUnavailable, TooManyRequests,
+                      format_retry_after)
 from ..wire import Outbox, SocketWriter
 
 PD_VERSION = 2  # v2: TOK carries the resume cursor; REQ carries
@@ -234,7 +235,7 @@ class Conn:
             # marked closed — the prefill side maps it to the typed
             # 503 shed instead of leaking a raw OSError to the client
             self.closed = True
-            raise EOFError(f"pd connection lost: {e!r}") from e
+            raise ConnectionLost(f"pd connection lost: {e!r}") from e
         finally:
             # parked-in-backlog bytes still count as pending until a
             # later drain flushes them — backlog_bytes tracks that side
@@ -250,7 +251,7 @@ class Conn:
 
     def send(self, msg: bytes, block: bool = False) -> None:
         if self.closed:
-            raise EOFError("pd connection closed")
+            raise ConnectionLost("pd connection closed")
         with self._plock:
             self._pending += len(msg)
         self.outbox.append(msg)
@@ -264,7 +265,7 @@ class Conn:
         t_end = time.monotonic() + max(deadline_s, 0.05)
         while self.pending_bytes() + len(msg) > self.window:
             if self.closed:
-                raise EOFError("pd connection closed")
+                raise ConnectionLost("pd connection closed")
             if time.monotonic() >= t_end:
                 raise KVTransferError(
                     f"kv ship window stalled: {self.pending_bytes()} bytes "

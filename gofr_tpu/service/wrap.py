@@ -115,4 +115,5 @@ def _dispatch(svc, method: str, path: str, params, body, headers):
         return svc.patch_with_headers(path, params, body, headers)
     if m == "DELETE":
         return svc.delete_with_headers(path, body, headers)
-    raise ValueError(f"unsupported method {method!r}")
+    from ..errors import BadRequest
+    raise BadRequest(f"unsupported method {method!r}")

@@ -1,0 +1,167 @@
+"""chaoswatch: the chaos-seam coverage harness — the runtime complement
+of the gofrlint dist pass (GL301-GL304), the way lockwatch backs the
+locks pass and hbmwatch backs the resources pass.
+
+The static passes prove the code at each seam HANDLES failure; this
+plugin proves the failure is still being REHEARSED. ``chaos.SEAMS``
+declares every point where the fault harness can inject — and a seam
+nobody drives in tests is a resilience claim that silently stopped
+being checked (the declared seam outlives the test that exercised it,
+or a new seam ships with no test at all).
+
+Mechanism: wraps ``ChaosSchedule.fire`` for the session — the one
+choke point every injection passes through, whether production code
+called module-level ``chaos.fire(SEAM)`` with a schedule installed or
+a test drove ``schedule.fire`` directly. Per seam it counts:
+
+  fires       calls that reached the seam under an active schedule
+  armed       fires where the schedule had a rule FOR that seam (the
+              seam was actually a candidate for injection, not just
+              traversed)
+  injections  fires that raised an injected error
+
+``pytest --chaoswatch`` (tests/conftest.py, or standalone
+``-p gofr_tpu.testutil.chaoswatch``) prints the per-seam table at
+session finish and FAILS the session if any seam declared in
+``chaos.SEAMS`` recorded zero fires — coverage is judged against the
+DECLARED set, so adding a seam to chaos.py without a test driving it
+breaks the gate by construction.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..chaos import SEAMS, ChaosSchedule
+
+__all__ = ["SeamCoverageError", "SeamWatch"]
+
+
+class SeamCoverageError(AssertionError):
+    """Raised by the session gate: a declared seam never fired."""
+
+
+class SeamWatch:
+    """Counts ChaosSchedule.fire traffic per seam for a session.
+
+    install() monkeypatches the unbound ``ChaosSchedule.fire`` (so
+    every schedule instance — installed or driven directly — is
+    observed); uninstall() restores it. Reentrant-safe: a second
+    install() is a no-op."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.fires: dict[str, int] = {}
+        self.armed: dict[str, int] = {}
+        self.injections: dict[str, int] = {}
+        self._orig = None
+
+    def install(self) -> None:
+        if self._orig is not None:
+            return
+        orig = ChaosSchedule.fire
+        watch = self
+
+        def fire(sched: ChaosSchedule, seam: str) -> None:
+            with watch._lock:
+                watch.fires[seam] = watch.fires.get(seam, 0) + 1
+                if seam in sched._rules:
+                    watch.armed[seam] = watch.armed.get(seam, 0) + 1
+            try:
+                orig(sched, seam)
+            except BaseException:
+                with watch._lock:
+                    watch.injections[seam] = \
+                        watch.injections.get(seam, 0) + 1
+                raise
+
+        self._orig = orig
+        ChaosSchedule.fire = fire
+
+    def uninstall(self) -> None:
+        if self._orig is not None:
+            ChaosSchedule.fire = self._orig
+            self._orig = None
+
+    def uncovered(self) -> list[str]:
+        """Declared seams with zero fires this session."""
+        with self._lock:
+            return [s for s in SEAMS if not self.fires.get(s)]
+
+    def table(self) -> list[tuple[str, int, int, int]]:
+        """(seam, fires, armed, injections) over the union of declared
+        and observed seams — a fired seam that is NOT declared still
+        prints (it is a seam chaos.SEAMS forgot)."""
+        with self._lock:
+            seams = sorted(set(SEAMS) | set(self.fires))
+            return [(s, self.fires.get(s, 0), self.armed.get(s, 0),
+                     self.injections.get(s, 0)) for s in seams]
+
+
+# -- pytest session mode ------------------------------------------------------
+# Registered by tests/conftest.py under --chaoswatch, or standalone via
+# `pytest -p gofr_tpu.testutil.chaoswatch --chaoswatch` (what the
+# seeded-gap self-test uses, where no repo conftest is in scope).
+
+try:
+    import pytest
+except ImportError:  # pragma: no cover — production import path
+    pytest = None
+
+
+if pytest is not None:
+    class SessionWatchPlugin:
+        def __init__(self) -> None:
+            self.watch = SeamWatch()
+
+        def pytest_sessionstart(self, session):
+            self.watch.install()
+
+        def pytest_sessionfinish(self, session, exitstatus):
+            self.watch.uninstall()
+            rows = self.watch.table()
+            width = max(len(s) for s, *_ in rows)
+            print(f"\nchaoswatch: seam coverage over "  # noqa: T201
+                  f"{len(SEAMS)} declared seam(s)")
+            print(f"  {'seam':<{width}}  {'fires':>7}  "  # noqa: T201
+                  f"{'armed':>7}  {'injected':>8}")
+            for seam, fires, armed, injected in rows:
+                mark = "" if fires else "  <- NEVER FIRED"
+                extra = "" if seam in SEAMS else "  <- NOT DECLARED"
+                print(f"  {seam:<{width}}  {fires:>7}  "  # noqa: T201
+                      f"{armed:>7}  {injected:>8}{mark}{extra}")
+            missing = self.watch.uncovered()
+            if missing:
+                raise SeamCoverageError(
+                    "chaoswatch: declared seam(s) with ZERO coverage "
+                    "this session — a resilience claim is no longer "
+                    "rehearsed: " + ", ".join(missing))
+
+    def pytest_addoption(parser):  # standalone -p loading
+        try:
+            parser.addoption(
+                "--chaoswatch", action="store_true", default=False,
+                help="count ChaosSchedule.fire traffic per declared "
+                     "seam; print the fire/injection table and FAIL "
+                     "the session if any chaos.SEAMS entry never "
+                     "fired — the fault-injection sibling of "
+                     "--lockwatch/--hbmwatch")
+        except ValueError:
+            pass  # tests/conftest.py already registered it
+
+    def pytest_configure(config):
+        install_session_watch(config)
+
+    def install_session_watch(config) -> None:
+        """Idempotent: register the session plugin when --chaoswatch
+        is on (called from the standalone plugin hook AND from
+        tests/conftest.py)."""
+        try:
+            enabled = config.getoption("--chaoswatch")
+        except ValueError:
+            enabled = False
+        if enabled and not config.pluginmanager.has_plugin(
+                "chaoswatch-session"):
+            plugin = SessionWatchPlugin()
+            config._chaoswatch = plugin
+            config.pluginmanager.register(plugin, "chaoswatch-session")

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..datasource import Health, STATUS_DEGRADED, STATUS_DOWN, STATUS_UP
-from ..errors import DeadlineExceeded
+from ..errors import DeadlineExceeded, ProgramNotFound, ServiceUnavailable
 from ..resilience import current_deadline, current_slo_class
 from . import hbm
 from .batcher import ClassPolicy, CoalescingBatcher, pad_bucket
@@ -271,11 +271,10 @@ class TPUEngine:
         gate degrades throughput-class first, and with a class policy
         configured the batcher schedules the classes separately."""
         if self._closed:
-            raise RuntimeError("TPU engine is closed")
+            raise ServiceUnavailable("TPU engine is closed")
         batcher = self._batchers.get(program)
         if batcher is None:
-            raise KeyError(f"no TPU program {program!r}; registered: "
-                           f"{sorted(self._programs)}")
+            raise ProgramNotFound(program, list(self._programs))
         if deadline is None:
             deadline = current_deadline()
         if slo_class is None:
@@ -361,7 +360,7 @@ class TPUEngine:
         subscribers that already hold a natural batch)."""
         prog = self._programs.get(program)
         if prog is None:
-            raise KeyError(f"no TPU program {program!r}")
+            raise ProgramNotFound(program)
         for it in items:
             self._validate_item(prog, it)
         out = []
@@ -403,8 +402,9 @@ class TPUEngine:
         if self.pd_prefill is not None:
             return self.pd_prefill.generate(*args, **kw)
         if self.generator is None:
-            raise RuntimeError("no decoder model configured (TPU_MODEL must "
-                               "be a llama-family model for generate)")
+            raise ServiceUnavailable(
+                "no decoder model configured (TPU_MODEL must be a "
+                "llama-family model for generate)")
         return self.generator.generate(*args, **kw)
 
     # -- warmup (compile-cache priming; BASELINE TTFT target needs this) -----

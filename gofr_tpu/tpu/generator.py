@@ -3812,18 +3812,16 @@ class GenerationEngine:
             ttft = now - req.stream.trace["submit"]
             if self.metrics is not None:
                 # the exemplar makes a dashboard's p99 TTFT bucket
-                # resolve to the exact trace that populated it
-                if self.tenancy is not None:
-                    self.metrics.record_histogram(
-                        "app_tpu_ttft_duration", ttft,
-                        exemplar=req.stream.trace_id or None,
-                        program="generate", slo_class=req.slo_class,
-                        tenant=req.tenant)
-                else:
-                    self.metrics.record_histogram(
-                        "app_tpu_ttft_duration", ttft,
-                        exemplar=req.stream.trace_id or None,
-                        program="generate", slo_class=req.slo_class)
+                # resolve to the exact trace that populated it; the
+                # label-key set is ONE set whether or not tenancy is
+                # on (tenant="" = untenanted) so the series never
+                # splits on deployment mode
+                self.metrics.record_histogram(
+                    "app_tpu_ttft_duration", ttft,
+                    exemplar=req.stream.trace_id or None,
+                    program="generate", slo_class=req.slo_class,
+                    tenant=(req.tenant or ""
+                            if self.tenancy is not None else ""))
             self._obs_stage(req.stream, "decode")
             if self._observe is not None:
                 self._observe.recorder.record(

@@ -36,6 +36,8 @@ import socket
 import threading
 import time
 
+from .errors import ConnectionLost
+
 # sendmsg buffer-list cap per syscall — far below any platform IOV_MAX
 # (Linux: 1024) while keeping per-call bookkeeping bounded
 _IOV_CAP = 64
@@ -71,7 +73,7 @@ class SocketWriter:
         """Swap out the backlog and append ``bufs`` — the commit point."""
         with self._blk:
             if self._closed:
-                raise EOFError("connection closed")
+                raise ConnectionLost("connection closed")
             views: list[memoryview] = []
             if self._backlog:
                 views.append(memoryview(bytes(self._backlog)))
@@ -134,7 +136,7 @@ class SocketWriter:
             # is preserved. The next write on the connection flushes.
             with self._blk:
                 if self._closed:
-                    raise EOFError("connection closed")
+                    raise ConnectionLost("connection closed")
                 for b in bufs:
                     self._backlog += b
                 self.deferred += 1

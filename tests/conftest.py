@@ -68,6 +68,13 @@ def pytest_addoption(parser):
              "hbm accounting registry); print per-test leak deltas "
              "and fail the session on retained growth — the memory "
              "sibling of --lockwatch")
+    parser.addoption(
+        "--chaoswatch", action="store_true", default=False,
+        help="count ChaosSchedule.fire traffic per declared chaos "
+             "seam (gofr_tpu.testutil.chaoswatch); print the per-seam "
+             "fire/injection table and fail the session if any "
+             "chaos.SEAMS entry never fired — the fault-injection "
+             "sibling of --lockwatch/--hbmwatch")
 
 
 def pytest_configure(config):
@@ -77,9 +84,11 @@ def pytest_configure(config):
         watch = LockWatch(name="pytest-session")
         watch.install()
         config._lockwatch = watch
+    from gofr_tpu.testutil import chaoswatch as chaoswatch_mod
     from gofr_tpu.testutil import hbmwatch as hbmwatch_mod
 
     hbmwatch_mod.install_session_watch(config)
+    chaoswatch_mod.install_session_watch(config)
 
 
 @pytest.fixture

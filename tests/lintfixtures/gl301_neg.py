@@ -1,0 +1,32 @@
+"""GL301 negative: the idioms the rule must NOT flag — a write-side
+connection lock held across sends (serialize-the-writers), a device
+lock held across the sync it exists to order, a bounded queue wait,
+and put() on an unbounded queue (which never blocks)."""
+import socket
+import threading
+from queue import Queue
+
+import jax
+
+
+class Writer:
+    def __init__(self):
+        self._send_lock = threading.Lock()
+        self._device_lock = threading.Lock()
+        self._mu = threading.Lock()
+        self._sock = socket.socket()
+        self._q = Queue()
+
+    def send(self, payload):
+        with self._send_lock:
+            self._sock.sendall(payload)
+
+    def dispatch(self, x):
+        with self._device_lock:
+            return jax.block_until_ready(x)
+
+    def drain(self):
+        with self._mu:
+            item = self._q.get(timeout=0.1)
+            self._q.put(item)
+            return item

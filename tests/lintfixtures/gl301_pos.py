@@ -1,0 +1,20 @@
+"""Seeded GL301: blocking calls made while a lock is held — every
+other waiter on ``self._lock`` stalls behind the sleep, the send and
+the unbounded queue wait."""
+import socket
+import threading
+import time
+from queue import Queue
+
+
+class Relay:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sock = socket.socket()
+        self._q = Queue()
+
+    def handle(self, payload):
+        with self._lock:
+            time.sleep(0.05)  # EXPECT: GL301
+            self._sock.sendall(payload)  # EXPECT: GL301
+            return self._q.get()  # EXPECT: GL301

@@ -1,0 +1,41 @@
+"""GL302 negative: owned thread lifecycles — joined from close(),
+declared daemon=True, or a pool joined through a local alias in
+shutdown()."""
+import threading
+
+
+class Poller:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        self._t.join()
+
+
+class Background:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        pass
+
+
+class Pool:
+    def __init__(self):
+        self._threads = []
+        for _ in range(2):
+            t = threading.Thread(target=self._run)
+            t.start()
+            self._threads.append(t)
+
+    def _run(self):
+        pass
+
+    def shutdown(self):
+        for t in self._threads:
+            t.join()

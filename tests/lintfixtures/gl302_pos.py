@@ -1,0 +1,24 @@
+"""Seeded GL302: thread-lifecycle leaks — a stored non-daemon thread
+the teardown path never joins, and a started thread dropped on the
+floor (neither stored, joined, nor daemonized)."""
+import threading
+
+
+class Poller:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run)  # EXPECT: GL302
+        self._t.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class Kicker:
+    def kick(self):
+        threading.Thread(target=self._work).start()  # EXPECT: GL302
+
+    def _work(self):
+        pass

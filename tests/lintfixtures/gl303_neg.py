@@ -1,0 +1,41 @@
+"""GL303 negative: mapped failure paths — typed raises, handlers that
+convert or route the failure, teardown-finally regions, and cold
+functions doing best-effort cleanup."""
+
+
+class WireTornError(Exception):
+    status_code = 502
+
+
+class Transport:
+    def handle(self, conn):
+        data = conn.recv(16)
+        if not data:
+            raise WireTornError("peer closed")
+        return data
+
+    def relay(self, upstream):
+        out = b""
+        try:
+            out = upstream.recv(16)
+        except OSError as e:
+            self._reject(e)
+        return out
+
+    def stream(self, conn):
+        try:
+            while True:
+                conn.send(b"x")
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def _reject(self, err):
+        self.failed = str(err)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,20 @@
+"""Seeded GL303: unmapped failure paths — a request-path function
+raising a builtin (the peer sees a raw 500), and a handler that
+swallows transport loss and falls through as if the peer were still
+there."""
+
+
+class Transport:
+    def handle(self, conn):
+        data = conn.recv(16)
+        if not data:
+            raise RuntimeError("peer closed")  # EXPECT: GL303
+        return data
+
+    def relay(self, upstream):
+        out = b""
+        try:
+            out = upstream.recv(16)
+        except OSError:  # EXPECT: GL303
+            pass
+        return out

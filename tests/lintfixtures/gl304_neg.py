@@ -1,0 +1,22 @@
+"""GL304 negative: disciplined emits — registered literal names, a
+module-constant name, a forwarding helper whose name is a parameter,
+locals provably bound to literals, and one consistent label set."""
+
+GAUGE = "app_fx_depth"
+
+
+class Handler:
+    def __init__(self, metrics):
+        self.metrics = metrics
+        self.metrics.new_counter("app_fx_hits_total", "cache hits")
+        self.metrics.new_counter("app_fx_misses_total", "cache misses")
+        self.metrics.new_gauge("app_fx_depth", "queue depth")
+
+    def handle(self, hit):
+        name = ("app_fx_hits_total" if hit
+                else "app_fx_misses_total")
+        self.metrics.increment_counter(name, tier="t0")
+        self.metrics.set_gauge(GAUGE, 3.0)
+
+    def bump(self, name, **labels):
+        self.metrics.increment_counter(name, **labels)

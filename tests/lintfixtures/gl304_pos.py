@@ -1,0 +1,17 @@
+"""Seeded GL304: metric discipline — an emit nothing registers, a
+dynamic (non-literal) name, and a label-key set that diverges from
+the majority at this metric's other sites."""
+
+
+class Handler:
+    def __init__(self, metrics):
+        self.metrics = metrics
+        self.metrics.new_counter("app_fx_requests_total", "requests")
+        self.metrics.new_counter("app_fx_hits_total", "cache hits")
+
+    def handle(self, name):
+        self.metrics.increment_counter("app_fx_ghost_total")  # EXPECT: GL304
+        self.metrics.increment_counter("app_fx_" + name)  # EXPECT: GL304
+        self.metrics.increment_counter("app_fx_hits_total", tier="t0")
+        self.metrics.increment_counter("app_fx_hits_total")  # EXPECT: GL304
+        self.metrics.increment_counter("app_fx_hits_total", tier="t1")

@@ -1,0 +1,134 @@
+"""chaoswatch harness tests.
+
+The acceptance gate for the chaos-seam coverage satellite: a session
+whose tests drive every seam declared in ``chaos.SEAMS`` must pass
+``pytest --chaoswatch``, and a session missing exactly one seam must
+FAIL with that seam NAMED. The sessions run in subprocesses with the
+standalone plugin (``-p gofr_tpu.testutil.chaoswatch``) against a
+scaffolded test file, mirroring test_hbmwatch.py. Unit layers below
+cover the SeamWatch counting primitives the session mode is built
+from.
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from gofr_tpu import chaos
+from gofr_tpu.testutil.chaoswatch import SeamWatch
+
+REPO = Path(__file__).resolve().parent.parent
+
+FULL = """
+from gofr_tpu import chaos
+
+
+def test_every_declared_seam_fires():
+    chaos.install(chaos.ChaosSchedule(seed=0))
+    try:
+        for seam in chaos.SEAMS:
+            chaos.fire(seam)
+    finally:
+        chaos.uninstall()
+"""
+
+# identical, except the pd.ingest seam is never driven — the shape of
+# a seam shipped (or left behind) with no test exercising it
+GAPPED = """
+from gofr_tpu import chaos
+
+
+def test_all_but_one_seam_fires():
+    chaos.install(chaos.ChaosSchedule(seed=0))
+    try:
+        for seam in chaos.SEAMS:
+            if seam != chaos.PD_INGEST:
+                chaos.fire(seam)
+    finally:
+        chaos.uninstall()
+"""
+
+
+def run_chaoswatch_session(tmp_path: Path, source: str
+                           ) -> subprocess.CompletedProcess:
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    test_file = tmp_path / "test_scaffold.py"
+    test_file.write_text(source)
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO)})
+    return subprocess.run(
+        [sys.executable, "-m", "pytest", str(test_file), "-q",
+         "-p", "gofr_tpu.testutil.chaoswatch", "--chaoswatch",
+         "-p", "no:cacheprovider"],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+        timeout=300)
+
+
+def test_session_fails_on_uncovered_seam_and_passes_when_full(tmp_path):
+    gapped = run_chaoswatch_session(tmp_path / "gapped", source=GAPPED)
+    out = gapped.stdout + gapped.stderr
+    assert gapped.returncode != 0, out
+    assert "chaoswatch" in out and "ZERO coverage" in out
+    assert "pd.ingest" in out        # the silent seam is NAMED
+    assert "NEVER FIRED" in out      # ...and marked in the table
+
+    full = run_chaoswatch_session(tmp_path / "full", source=FULL)
+    out = full.stdout + full.stderr
+    assert full.returncode == 0, out
+    # the table still prints (observability is not gated on failure)
+    assert "chaoswatch: seam coverage" in out
+
+
+# -- unit layer ---------------------------------------------------------------
+
+def test_seamwatch_counts_fires_armed_and_injections():
+    w = SeamWatch()
+    w.install()
+    try:
+        sched = chaos.ChaosSchedule(seed=0).on(
+            chaos.BATCHER_DISPATCH, error=OSError, every=1)
+        sched.fire(chaos.HTTP_REQUEST)  # no rule: traversed, not armed
+        with pytest.raises(OSError):
+            sched.fire(chaos.BATCHER_DISPATCH)
+    finally:
+        w.uninstall()
+    assert w.fires[chaos.HTTP_REQUEST] == 1
+    assert chaos.HTTP_REQUEST not in w.armed
+    assert chaos.HTTP_REQUEST not in w.injections
+    assert w.fires[chaos.BATCHER_DISPATCH] == 1
+    assert w.armed[chaos.BATCHER_DISPATCH] == 1
+    assert w.injections[chaos.BATCHER_DISPATCH] == 1
+
+
+def test_uncovered_is_declared_minus_fired_and_table_is_the_union():
+    w = SeamWatch()
+    w.install()
+    try:
+        sched = chaos.ChaosSchedule(seed=1)
+        sched.fire(chaos.SEAMS[0])
+        sched.fire("private.seam")  # undeclared: observed, not required
+    finally:
+        w.uninstall()
+    missing = w.uncovered()
+    assert chaos.SEAMS[0] not in missing
+    assert set(missing) == set(chaos.SEAMS[1:])
+    rows = {s: (f, a, i) for s, f, a, i in w.table()}
+    assert rows["private.seam"] == (1, 0, 0)  # forgot-to-declare shows
+    assert set(chaos.SEAMS) <= set(rows)
+
+
+def test_install_is_idempotent_and_uninstall_restores():
+    before = chaos.ChaosSchedule.fire
+    w = SeamWatch()
+    w.install()
+    w.install()  # no double-wrap
+    try:
+        assert chaos.ChaosSchedule.fire is not before
+    finally:
+        w.uninstall()
+    assert chaos.ChaosSchedule.fire is before
+    w.uninstall()  # no-op
+    assert chaos.ChaosSchedule.fire is before

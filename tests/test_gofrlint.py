@@ -42,7 +42,8 @@ NEGATIVES = [p for p in ALL_FIXTURES if p.name.endswith("_neg.py")]
 ALL_CODES = {"F401", "F811", "E501", "E711", "E722", "B006", "B011",
              "F601", "F541", "W291", "W191", "T201", "E999",
              "GL001", "GL002", "GL101", "GL102", "GL103",
-             "GL201", "GL202", "GL203", "GL204"}
+             "GL201", "GL202", "GL203", "GL204",
+             "GL301", "GL302", "GL303", "GL304"}
 
 # Fixtures whose finding line cannot carry an inline `# EXPECT:` marker:
 # a comment would remove the trailing whitespace (W291), sit on a
@@ -257,7 +258,7 @@ def test_stats_last_line_json_contract(tmp_path):
     # output names the regressing pass — and a pass silently dropping
     # out of the run is itself visible
     assert set(obj["by_pass"]) == {"style", "locks", "hotpath",
-                                   "resources"}
+                                   "resources", "dist"}
     assert obj["by_pass"]["style"] == {"findings": 1, "new": 1}
     assert obj["by_pass"]["resources"] == {"findings": 0, "new": 0}
 
@@ -276,6 +277,17 @@ def test_stats_by_pass_attributes_resource_findings(tmp_path):
     assert obj["by_code"] == {"GL201": 1}
     assert obj["by_pass"]["resources"] == {"findings": 1, "new": 1}
     assert obj["by_pass"]["style"] == {"findings": 0, "new": 0}
+
+
+def test_stats_by_pass_attributes_dist_findings(tmp_path):
+    dst = scaffold(tmp_path, "gl302_pos.py",
+                   fixture=FIXTURES / "gl302_pos.py")
+    p = run_cli(str(dst), "--stats")
+    assert p.returncode == 1
+    obj = json.loads(p.stdout.strip().splitlines()[-1])
+    assert obj["by_code"] == {"GL302": 2}
+    assert obj["by_pass"]["dist"] == {"findings": 2, "new": 2}
+    assert obj["by_pass"]["locks"] == {"findings": 0, "new": 0}
 
 
 def test_select_filters_by_prefix(tmp_path):
@@ -515,6 +527,12 @@ FIXED_MODULES = [
     "gofr_tpu/tpu/engine.py",         # GL203: register/gate growth triaged
     "gofr_tpu/tpu/hbm.py",            # the GL202 accounting API itself
     "gofr_tpu/testutil/hbmwatch.py",  # the GL2xx runtime harness
+    "gofr_tpu/datasource/redisclient.py",  # GL301: _io_lock held across
+                                           # the wire is the named idiom
+    "gofr_tpu/pd/ingest.py",          # GL303: every reader-loop failure
+                                      # routes through _reject, typed
+    "gofr_tpu/grpcx/server.py",       # GL303: best-effort GOAWAY triaged
+    "gofr_tpu/testutil/chaoswatch.py",  # the GL3xx runtime harness
 ]
 
 

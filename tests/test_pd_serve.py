@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from gofr_tpu import chaos
 from gofr_tpu.errors import DeadlineExceeded, TooManyRequests
 from gofr_tpu.glog import Logger, LogLevel
 from gofr_tpu.metrics import Manager, register_framework_metrics
@@ -424,6 +425,28 @@ def test_socket_end_to_end_token_exact(pd_pair, refs):
         out = pd.generate(_prompt(n), max_new_tokens=MAX_NEW).tokens()
         assert out == refs[n], (n, out, refs[n])
     assert pd.stats()["relayed"] == 3
+
+
+def test_chaos_ingest_fault_rejects_typed_then_recovers(pd_pair, refs):
+    """The pd.ingest seam (chaos.PD_INGEST): an injected fault at the
+    decode worker's kv-frame boundary fails THAT transfer with the
+    typed 502 reject — never the worker — and the same pair serves
+    token-exact once the injection budget is spent. This is the test
+    the --chaoswatch gate holds the pd modules accountable for."""
+    pd, _, srv, _ = pd_pair
+    chaos.install(chaos.ChaosSchedule(seed=0).on(
+        chaos.PD_INGEST, error=lambda: OSError("chaos: ingest torn"),
+        every=1, limit=1))
+    try:
+        rs = pd.generate(_prompt(40), max_new_tokens=MAX_NEW)
+        with pytest.raises(KVTransferError, match="injected ingest fault"):
+            rs.tokens()
+        assert srv.frame_rejects >= 1
+        # injection budget spent (limit=1): the pair recovers in place
+        out = pd.generate(_prompt(40), max_new_tokens=MAX_NEW).tokens()
+        assert out == refs[40]
+    finally:
+        chaos.uninstall()
 
 
 def test_relay_stream_supports_transport_sinks(pd_pair, refs):

@@ -125,7 +125,7 @@ def test_health_down():
     c.host, c.port, c.logger, c.metrics = "127.0.0.1", 1, None, None
     c.timeout = 0.2
     import threading
-    c._lock = threading.Lock()
+    c._io_lock = threading.Lock()
     c._sock = None
     assert c.health_check().status == "DOWN"
 

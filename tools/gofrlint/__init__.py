@@ -14,6 +14,11 @@ linter into three passes:
   resources — GL201 use-after-donate, GL202 unaccounted device
               allocations, GL203 unbounded request-path container
               growth, GL204 fail-open OOM handling
+  dist      — GL301 blocking calls under a held lock, GL302
+              thread-lifecycle leaks (no close-path join), GL303
+              unmapped wire failure paths (raw-500 class), GL304
+              metric discipline (unregistered/dynamic names,
+              inconsistent label keys)
 
 Every rule honors `# noqa` / `# noqa: CODE` line suppression (applied
 centrally). Accepted findings live in tools/gofrlint_baseline.json; CI
@@ -32,7 +37,7 @@ from __future__ import annotations
 
 from pathlib import Path
 
-from . import hotpath, locks, resources, style
+from . import dist, hotpath, locks, resources, style
 from .base import Finding, SourceFile, collect_files
 
 __all__ = ["Finding", "SourceFile", "collect_files", "pass_of", "run"]
@@ -40,7 +45,7 @@ __all__ = ["Finding", "SourceFile", "collect_files", "pass_of", "run"]
 # code -> pass, for the per-pass --stats breakdown (CI must see WHICH
 # pass regressed, not one aggregate bucket)
 _PASS_PREFIXES = (("GL0", "locks"), ("GL1", "hotpath"),
-                  ("GL2", "resources"))
+                  ("GL2", "resources"), ("GL3", "dist"))
 
 
 def pass_of(code: str) -> str:
@@ -71,6 +76,7 @@ def run(roots: list[Path], select: set[str] | None = None
     lock_pass = locks.LockPass()
     hot_pass = hotpath.HotPathPass()
     res_pass = resources.ResourcePass()
+    dist_pass = dist.DistPass()
     findings: list[Finding] = []
     sources: dict[str, SourceFile] = {}
     for path in files:
@@ -80,9 +86,12 @@ def run(roots: list[Path], select: set[str] | None = None
         lock_pass.feed(sf)
         hot_pass.feed(sf)
         res_pass.feed(sf)
+        dist_pass.feed(sf)
     findings.extend(lock_pass.finish())
     findings.extend(hot_pass.findings)
     findings.extend(res_pass.findings)
+    # dist consumes the lock pass's post-fixpoint state: must run after
+    findings.extend(dist_pass.finish(lock_pass))
     findings = [f for f in findings
                 if f.path not in sources
                 or not sources[f.path].suppressed(f)]

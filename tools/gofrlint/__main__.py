@@ -24,7 +24,7 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.gofrlint",
         description="multi-pass static analyzer (style + lock discipline "
-                    "+ TPU hot-path)")
+                    "+ TPU hot-path + resources + distributed safety)")
     ap.add_argument("paths", nargs="*", type=Path,
                     help="files/dirs to analyze (default: the repo)")
     ap.add_argument("--select", action="append", default=None,
@@ -91,7 +91,8 @@ def main(argv: list[str] | None = None) -> int:
         # style fix). Every pass always appears, zero or not, so a
         # pass silently dropping from the run is itself visible.
         by_pass = {name: {"findings": 0, "new": 0}
-                   for name in ("style", "locks", "hotpath", "resources")}
+                   for name in ("style", "locks", "hotpath", "resources",
+                                "dist")}
         for f in findings:
             by_pass[pass_of(f.code)]["findings"] += 1
         for f in new:

@@ -1,0 +1,769 @@
+"""Distributed-safety pass: GL301-GL304 over the framework's
+concurrency and wire idioms.
+
+The PR 12-19 surface (pd/ wire protocol, gateway relay, tenancy,
+durable resume) multiplied threads, sockets and failure paths — and
+every serious bug in it was caught by review, not tooling: socket
+errors surfacing as raw 500s instead of typed sheds, an accept loop
+that never woke on close, flush threads outliving shutdown. This pass
+encodes those review findings as rules:
+
+GL301 — blocking call under a held lock. Reuses the lock pass's
+guard-region inference AND its call-graph lock-inheritance fixpoint
+(a private method called only under L is analyzed as holding L), so
+`self._send_locked()` bodies and try-acquire early-outs inherit the
+same exemptions GL001 grants. Blocking shapes: socket
+send/recv/connect/accept, `queue.get/put` with no timeout,
+`Thread.join`, `time.sleep`, `Event.wait`, `jax.device_get` /
+`block_until_ready`, and subprocess waits. Two idiom exemptions keep
+the rule honest: a lock whose NAME says it serializes device work
+(`*device*`/`*dispatch*`) may be held across device syncs — that is
+its job — and a lock named for the write side of a connection
+(`*send*`/`*write*`/`*tx*`/`*conn*`/`*sock*`/`*out*`) may be held
+across socket sends, the serialize-the-writers idiom. Waiting on a
+Condition releases only ITS lock: `cond.wait()` while holding a
+second lock is still flagged.
+
+GL302 — thread-lifecycle leak. A non-daemon `threading.Thread`
+started from a class must be `join()`ed from that class's teardown
+path — `close()`/`shutdown()`/`stop()`/`__exit__`/... or a method
+they call — and a started thread dropped on the floor (neither
+stored, joined, nor daemonized) is flagged at the construction site.
+`daemon=True` is the declared justification (the thread must then
+survive being abandoned); the join scan follows `self._t.join()`,
+`for t in self._threads: t.join()`, and local aliases.
+
+GL303 — unmapped failure path, the raw-500 bug class. (a) A
+request-path function (handle/serve/relay/stream/recv/... naming) in
+framework code raising a BUILTIN exception — peer loss and bad input
+must surface as typed `errors.py` classes so the wire maps them to
+429/502/503/504 instead of a raw 500. (b) An `except` arm catching
+`OSError`/`EOFError`/socket errors that neither re-raises, converts
+to a typed `*Error` class, exits the loop/function, nor routes to a
+reject/close path — i.e. it swallows transport loss and falls
+through as if the peer were still there. Teardown/cold functions
+(`close`, `shutdown`, `warmup`, `__init__`, ...) are exempt from (b):
+best-effort cleanup legitimately ignores socket errors.
+
+GL304 — metric discipline. Emitting a literal metric name that no
+`new_counter`/`new_histogram`/`new_gauge`/`new_updown_counter` call
+ever registers (the emit silently no-ops or explodes depending on
+backend); a NON-literal metric name (unbounded series cardinality) —
+except the forwarding-helper idiom where the name is a parameter of
+the enclosing function, and locals provably bound only to string
+literals; and label-key sets inconsistent across the emit sites of
+one counter/histogram (`exemplar`/`value` are API kwargs, not
+labels; `**labels` forwarding sites are skipped).
+
+GL301/GL302 consume the lock pass's per-class state after its
+fixpoint, so this pass's finish() must run after LockPass.finish().
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .base import Finding, SourceFile, _self_attr, in_framework
+from .hotpath import _callee_last, _callee_root
+from .locks import LockPass, _Class, _Method, _ctor_name
+
+# -- GL301 tables -------------------------------------------------------------
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+                "JoinableQueue"}
+_SOCK_METHODS = {"sendall", "recv", "recv_into", "recvfrom", "accept",
+                 "connect"}
+_SOCK_HINT = re.compile(r"sock|conn|peer|listener", re.I)
+_QUEUE_HINT = re.compile(r"(^|_)(q|queue|inbox|outbox|jobs|backlog|fifo)"
+                         r"\d*$|queue", re.I)
+_THREAD_HINT = re.compile(r"thread|worker|reaper|poller|waiter", re.I)
+_PROC_HINT = re.compile(r"proc|popen|child", re.I)
+# a lock that EXISTS to serialize device dispatch may be held across
+# device syncs; a write-side connection lock may be held across sends
+_DEVICE_LOCK = re.compile(r"device|dispatch", re.I)
+_IO_LOCK = re.compile(r"send|write|tx|out|conn|sock|io|wlock", re.I)
+
+# -- GL302 tables -------------------------------------------------------------
+_TEARDOWN_RE = re.compile(
+    r"^(close|shutdown|stop|terminate|teardown|drain|uninstall"
+    r"|disconnect|join|finish|release|cancel|wait_closed|aclose"
+    r"|__exit__|__del__)($|_)")
+
+# -- GL303 tables -------------------------------------------------------------
+_BUILTIN_EXC = {"Exception", "BaseException", "RuntimeError", "ValueError",
+                "TypeError", "KeyError", "IndexError", "LookupError",
+                "OSError", "IOError", "EOFError", "ConnectionError",
+                "ConnectionResetError", "ConnectionAbortedError",
+                "BrokenPipeError", "TimeoutError", "ArithmeticError"}
+_WIRE_EXC = {"OSError", "IOError", "EOFError", "ConnectionError",
+             "ConnectionResetError", "ConnectionAbortedError",
+             "BrokenPipeError", "TimeoutError", "InterruptedError",
+             "herror", "gaierror", "timeout", "error"}
+# "timeout"/"error"/"herror"/"gaierror" only count when socket-qualified
+_WIRE_EXC_BARE = _WIRE_EXC - {"timeout", "error", "herror", "gaierror"}
+_REQ_PATH_RE = re.compile(
+    r"^(handle|serve|do|call|request|invoke|dispatch|relay|forward"
+    r"|stream|recv|send|read|write|submit|ingest|fetch|route|generate"
+    r"|predict|reply|respond|pick|push|pull|poll|accept)($|_)")
+# matched against the name with leading underscores stripped, so
+# dunders appear as their cores (init/del/exit)
+_COLD_RE = re.compile(
+    r"^(close|shutdown|stop|drain|uninstall|terminate|teardown|cleanup"
+    r"|reset|warmup|health|probe|poke|cancel|abort|init|del|exit)($|_)")
+# a handler body call whose name routes the failure somewhere typed:
+# reject/fail/abort/shed paths, or the wire's error_to_wire converter
+_ROUTE_RE = re.compile(
+    r"^_{0,2}(reject|fail|abort|shed|drop|error_to_wire|on_error"
+    r"|record_failure|mark_down|mark_dead|quarantine|(re)?connect"
+    r"|retry)($|_)")
+# OSError around FILE I/O is not transport loss: a handler whose try
+# body opens/stats paths is doing config/procfs reads, not wire reads
+_FILE_IO = {"open", "read_text", "read_bytes", "write_text", "stat",
+            "unlink", "mkdir", "makedirs", "listdir", "glob", "remove",
+            "rename", "replace", "exists", "getmtime", "isfile",
+            "isdir", "CDLL"}
+_TYPED_EXC_RE = re.compile(r"(Error|Exception|Exhausted|Lost|Expired"
+                           r"|Timeout|Refused|Open)$")
+
+# -- GL304 tables -------------------------------------------------------------
+_REG_VERBS = {"new_counter", "new_histogram", "new_gauge",
+              "new_updown_counter"}
+_EMIT_VERBS = {"increment_counter", "record_histogram", "set_gauge",
+               "delta_updown_counter"}
+_CONSISTENCY_VERBS = {"increment_counter", "record_histogram"}
+_NON_LABEL_KWARGS = {"exemplar", "value", "delta"}
+
+
+def _recv_name(expr: ast.expr) -> str | None:
+    """Best-effort NAME of a call receiver, through subscripts:
+    ``self._sock`` -> ``_sock``, ``conns[i]`` -> ``conns``."""
+    while isinstance(expr, ast.Subscript):
+        expr = expr.value
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _recv_self_attr(expr: ast.expr) -> str | None:
+    while isinstance(expr, ast.Subscript):
+        expr = expr.value
+    return _self_attr(expr)
+
+
+def _const_false(node: ast.expr | None) -> bool:
+    return isinstance(node, ast.Constant) and node.value is False
+
+
+def _has_real_timeout(call: ast.Call) -> bool:
+    """A ``timeout=`` kwarg that is not literally None bounds the wait."""
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None)
+    return False
+
+
+class _ClassInfo:
+    """Per-class facts GL301/GL302 need beyond what LockPass keeps:
+    which self attrs hold which constructor type, and which hold
+    threads (with their daemon-ness and construction site)."""
+
+    def __init__(self, cls: _Class):
+        self.attr_ctor: dict[str, str] = {}
+        # queue attrs constructed with a nonzero maxsize: put() BLOCKS
+        # on these when full; put() on an unbounded queue never does
+        self.bounded_queues: set[str] = set()
+        # attr -> [ctor lineno, daemon, started]; covers both
+        # `self._t = Thread(...)` and `self._ts.append(Thread(...))`
+        self.threads: dict[str, list] = {}
+        # (lineno, method) of started non-daemon threads with no owner
+        self.dropped: list[tuple[int, str]] = []
+        for meth in cls.methods.values():
+            self._scan(meth)
+
+    def _scan(self, meth: _Method) -> None:
+        # (lineno, daemon, started, escaped) per local thread name
+        local: dict[str, list] = {}
+        for node in ast.walk(meth.node):
+            if isinstance(node, ast.Assign):
+                ctor = _ctor_name(node.value)
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is not None and ctor is not None:
+                        self.attr_ctor.setdefault(attr, ctor)
+                        if ctor in _QUEUE_CTORS and \
+                                _queue_bounded(node.value):
+                            self.bounded_queues.add(attr)
+                        if ctor == "Thread":
+                            self.threads.setdefault(attr, [
+                                node.value.lineno,
+                                _thread_daemon(node.value), False])
+                    if attr is not None and isinstance(node.value,
+                                                       ast.Name) and \
+                            node.value.id in local:
+                        # `self._t = t` adopts the local thread
+                        rec = local[node.value.id]
+                        rec[3] = True
+                        self.threads.setdefault(attr, rec[:3])
+                    # `self._t.daemon = True` / `t.daemon = True`
+                    if isinstance(t, ast.Attribute) and \
+                            t.attr == "daemon" and \
+                            isinstance(node.value, ast.Constant) and \
+                            node.value.value is True:
+                        owner = _recv_self_attr(t.value)
+                        if owner in self.threads:
+                            self.threads[owner][1] = True
+                        elif isinstance(t.value, ast.Name) and \
+                                t.value.id in local:
+                            local[t.value.id][1] = True
+                    if isinstance(t, ast.Name) and ctor == "Thread":
+                        local[t.id] = [node.value.lineno,
+                                       _thread_daemon(node.value),
+                                       False, False]
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            recv, verb = node.func.value, node.func.attr
+            if verb == "append" and node.args:
+                attr = _recv_self_attr(recv)
+                arg = node.args[0]
+                if attr is not None:
+                    if _ctor_name(arg) == "Thread":
+                        self.threads.setdefault(attr, [
+                            arg.lineno, _thread_daemon(arg), False])
+                    elif isinstance(arg, ast.Name) and arg.id in local:
+                        rec = local[arg.id]
+                        rec[3] = True
+                        self.threads.setdefault(attr, rec[:3])
+            elif verb == "start":
+                attr = _recv_self_attr(recv)
+                if attr in self.threads:
+                    self.threads[attr][2] = True
+                elif isinstance(recv, ast.Name) and recv.id in local:
+                    local[recv.id][2] = True
+                elif _ctor_name(recv) == "Thread":
+                    # Thread(...).start(): inline fire-and-forget
+                    local[f"<inline:{recv.lineno}>"] = [
+                        recv.lineno, _thread_daemon(recv), True, False]
+            elif verb == "join":
+                if isinstance(recv, ast.Name) and recv.id in local:
+                    local[recv.id][3] = True  # joined locally: owned
+        # a started, non-daemon local thread that neither escaped to an
+        # attribute nor was joined in-method is dropped on the floor
+        for rec in local.values():
+            lineno, daemon, started, owned = rec
+            if started and not daemon and not owned:
+                self.dropped.append((lineno, meth.name))
+
+
+def _queue_bounded(call: ast.expr) -> bool:
+    """Queue(N)/Queue(maxsize=N) with N != 0 (or non-constant) blocks
+    producers when full; a bare Queue() never blocks put()."""
+    if not isinstance(call, ast.Call):
+        return False
+    if _ctor_name(call) == "SimpleQueue":
+        return False
+    cap = call.args[0] if call.args else None
+    for kw in call.keywords:
+        if kw.arg == "maxsize":
+            cap = kw.value
+    if cap is None:
+        return False
+    return not (isinstance(cap, ast.Constant) and not cap.value)
+
+
+def _thread_daemon(call: ast.expr) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    for kw in call.keywords:
+        if kw.arg == "daemon":
+            return isinstance(kw.value, ast.Constant) and \
+                kw.value.value is True
+    return False
+
+
+class _Emit:
+    __slots__ = ("rel", "line", "verb", "names", "literal", "labels",
+                 "starstar")
+
+    def __init__(self, rel, line, verb, names, literal, labels, starstar):
+        self.rel, self.line, self.verb = rel, line, verb
+        self.names, self.literal = names, literal
+        self.labels, self.starstar = labels, starstar
+
+
+class DistPass:
+    """Whole-run distributed-safety analysis. feed() per file;
+    finish(lock_pass) AFTER LockPass.finish() — GL301/GL302 read the
+    post-fixpoint per-class state."""
+
+    def __init__(self):
+        self.findings: list[Finding] = []
+        self._registered: set[str] = set()
+        self._emits: list[_Emit] = []
+
+    # -- per-file ----------------------------------------------------------
+    def feed(self, sf: SourceFile) -> None:
+        if sf.tree is None:
+            return
+        self._collect_registrations(sf)
+        if not in_framework(sf.path):
+            return
+        self._feed_gl303(sf)
+        if not sf.rel.endswith("gofr_tpu/metrics.py"):
+            # the Manager's own emit methods forward by construction
+            self._collect_emits(sf)
+
+    # -- GL303 -------------------------------------------------------------
+    def _feed_gl303(self, sf: SourceFile) -> None:
+        def visit(node: ast.AST, fname: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    visit(child, child.name)
+                    continue
+                if isinstance(child, ast.ClassDef):
+                    visit(child, fname)
+                    continue
+                if isinstance(child, ast.Raise):
+                    self._check_raise(sf, child, fname)
+                elif isinstance(child, ast.Try):
+                    for h in child.handlers:
+                        self._check_handler(sf, child, h, fname)
+                visit(child, fname)
+
+        visit(sf.tree, "<module>")
+
+    def _check_raise(self, sf: SourceFile, node: ast.Raise,
+                     fname: str) -> None:
+        core = fname.lstrip("_")
+        if not (_REQ_PATH_RE.match(core) or fname == "__call__"):
+            return
+        exc = node.exc
+        if isinstance(exc, ast.Call):
+            exc = exc.func
+        name = _callee_last(exc) if exc is not None else None
+        if name in _BUILTIN_EXC:
+            self.findings.append(Finding(
+                sf.rel, node.lineno, "GL303",
+                f"request-path {fname}() raises builtin {name} — raise "
+                f"a typed errors.py class so the wire maps it (peer "
+                f"sees 4xx/5xx with a reason, not a raw 500)"))
+
+    def _check_handler(self, sf: SourceFile, try_node: ast.Try,
+                       h: ast.ExceptHandler, fname: str) -> None:
+        core = fname.lstrip("_")
+        if _COLD_RE.match(core) or fname == "<module>":
+            return
+        if not self._catches_wire_errors(h.type):
+            return
+        if self._handler_routes(h.body):
+            return
+        if h.name is not None and any(
+                isinstance(n, ast.Name) and n.id == h.name
+                for n in ast.walk(ast.Module(body=list(h.body),
+                                             type_ignores=[]))):
+            return  # the body USES the exception: converting/recording
+        if self._teardown_in(try_node.finalbody):
+            return  # `finally: self.close()` — the failure ENDS the
+            # connection; falling out of the handler is not success
+        for node in ast.walk(ast.Module(body=list(try_node.body),
+                                        type_ignores=[])):
+            if isinstance(node, ast.Call) and \
+                    _callee_last(node.func) in _FILE_IO:
+                return  # file I/O, not wire: missing files are normal
+        self.findings.append(Finding(
+            sf.rel, h.lineno, "GL303",
+            f"handler in {fname}() swallows a transport error "
+            f"(OSError family) without re-raising, converting to a "
+            f"typed errors.py class, or exiting the request — peer "
+            f"loss falls through as success"))
+
+    def _catches_wire_errors(self, t: ast.expr | None) -> bool:
+        if t is None:
+            return False  # bare except: E722's finding
+        types = t.elts if isinstance(t, ast.Tuple) else [t]
+        for e in types:
+            name = _callee_last(e)
+            if name in _WIRE_EXC_BARE:
+                return True
+            if name in ("timeout", "error", "herror", "gaierror") and \
+                    _callee_root(e) == "socket":
+                return True
+        return False
+
+    def _teardown_in(self, body: list[ast.stmt]) -> bool:
+        for node in ast.walk(ast.Module(body=list(body), type_ignores=[])):
+            if isinstance(node, ast.Call):
+                name = _callee_last(node.func)
+                if name is not None and (
+                        _TEARDOWN_RE.match(name.lstrip("_"))
+                        or _ROUTE_RE.match(name)):
+                    return True
+        return False
+
+    def _handler_routes(self, body: list[ast.stmt]) -> bool:
+        for node in ast.walk(ast.Module(body=list(body), type_ignores=[])):
+            if isinstance(node, (ast.Raise, ast.Return, ast.Break,
+                                 ast.Continue)):
+                return True
+            if isinstance(node, ast.Call):
+                name = _callee_last(node.func)
+                if name is None:
+                    continue
+                if _ROUTE_RE.match(name):
+                    return True
+                if name not in _BUILTIN_EXC and _TYPED_EXC_RE.search(name):
+                    return True  # constructs a typed error class
+        return False
+
+    # -- GL304 -------------------------------------------------------------
+    def _collect_registrations(self, sf: SourceFile) -> None:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _REG_VERBS and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                self._registered.add(node.args[0].value)
+
+    def _collect_emits(self, sf: SourceFile) -> None:
+        # module-level UPPER_CASE = "literal" metric-name constants
+        # (hbm.py's GAUGE/BUDGET_GAUGE idiom) resolve as literals
+        consts: dict[str, str] = {}
+        for stmt in sf.tree.body:
+            if isinstance(stmt, ast.Assign) and \
+                    len(stmt.targets) == 1 and \
+                    isinstance(stmt.targets[0], ast.Name) and \
+                    stmt.targets[0].id.isupper() and \
+                    isinstance(stmt.value, ast.Constant) and \
+                    isinstance(stmt.value.value, str):
+                consts[stmt.targets[0].id] = stmt.value.value
+
+        def visit(node: ast.AST, fn) -> None:
+            for child in ast.iter_child_nodes(node):
+                nxt = fn
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    nxt = child
+                if isinstance(child, ast.Call) and \
+                        isinstance(child.func, ast.Attribute) and \
+                        child.func.attr in _EMIT_VERBS:
+                    self._record_emit(sf, child, fn, consts)
+                visit(child, nxt)
+
+        visit(sf.tree, None)
+
+    def _record_emit(self, sf: SourceFile, call: ast.Call, fn,
+                     consts: dict[str, str]) -> None:
+        verb = call.func.attr
+        name_expr = call.args[0] if call.args else None
+        if name_expr is None:
+            for kw in call.keywords:
+                if kw.arg == "name":
+                    name_expr = kw.value
+        labels = frozenset(kw.arg for kw in call.keywords
+                           if kw.arg is not None
+                           and kw.arg not in _NON_LABEL_KWARGS)
+        starstar = any(kw.arg is None for kw in call.keywords)
+        if isinstance(name_expr, ast.Constant) and \
+                isinstance(name_expr.value, str):
+            self._emits.append(_Emit(sf.rel, call.lineno, verb,
+                                     {name_expr.value}, True, labels,
+                                     starstar))
+            return
+        if isinstance(name_expr, ast.Name):
+            if fn is not None and name_expr.id in _param_names(fn):
+                return  # forwarding helper: callers own the name
+            if name_expr.id in consts:
+                self._emits.append(_Emit(sf.rel, call.lineno, verb,
+                                         {consts[name_expr.id]}, True,
+                                         labels, starstar))
+                return
+            names = _literal_bindings(fn, name_expr.id) \
+                if fn is not None else None
+            if names:
+                self._emits.append(_Emit(sf.rel, call.lineno, verb,
+                                         names, True, labels, starstar))
+                return
+        self.findings.append(Finding(
+            sf.rel, call.lineno, "GL304",
+            f"{verb}() with a non-literal metric name — dynamic names "
+            f"are unbounded series cardinality; use a literal name "
+            f"with labels, or forward through a helper whose name is "
+            f"a parameter"))
+
+    # -- whole-run ---------------------------------------------------------
+    def finish(self, lock_pass: LockPass) -> list[Finding]:
+        for cls in lock_pass.classes:
+            rel = lock_pass._class_file[id(cls)]
+            info = _ClassInfo(cls)
+            self._check_gl301(cls, info, rel)
+            self._check_gl302(cls, info, rel)
+        self._check_gl304()
+        self.findings.sort(key=lambda f: (f.path, f.line, f.code))
+        return self.findings
+
+    # -- GL301 -------------------------------------------------------------
+    def _check_gl301(self, cls: _Class, info: _ClassInfo,
+                     rel: str) -> None:
+        seen: set[tuple[int, str]] = set()
+        for m in cls.methods.values():
+            if m.exempt:
+                continue
+            for call, held in m.calls:
+                eff = frozenset(held | m.inherited)
+                if not eff:
+                    continue
+                desc = self._blocking(cls, info, call, eff)
+                if desc is None:
+                    continue
+                blocking, under = desc
+                key = (call.lineno, blocking)
+                if key in seen:
+                    continue
+                seen.add(key)
+                self.findings.append(Finding(
+                    rel, call.lineno, "GL301",
+                    f"{blocking} while holding "
+                    f"{'/'.join(sorted(under))} in {cls.name}.{m.name} "
+                    f"— every other waiter on the lock stalls behind "
+                    f"this call; move it outside the region or use a "
+                    f"timeout/nowait form"))
+
+    def _blocking(self, cls: _Class, info: _ClassInfo, call: ast.Call,
+                  eff: frozenset[str]):
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id == "sleep":
+                return ("time.sleep()", eff)
+            if f.id in ("device_get", "block_until_ready"):
+                rem = {lk for lk in eff if not _DEVICE_LOCK.search(lk)}
+                return (f"device sync {f.id}()", rem) if rem else None
+            if f.id == "create_connection":
+                rem = {lk for lk in eff if not _IO_LOCK.search(lk)}
+                return ("socket connect (create_connection)", rem) \
+                    if rem else None
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        verb, recv = f.attr, f.value
+        root = _callee_root(f)
+        a = _recv_self_attr(recv)
+        rname = _recv_name(recv) or ""
+        if a is not None and a in cls.locks:
+            # lock/condition receivers: .acquire is GL002's business;
+            # cond.wait RELEASES its own lock — but no other held one
+            if verb in ("wait", "wait_for"):
+                rem = eff - {cls.locks[a]}
+                return (f"Condition self.{a}.{verb}() (releases only "
+                        f"its own lock)", rem) if rem else None
+            return None
+        ctor = info.attr_ctor.get(a) if a is not None else None
+        if verb == "sleep" and root == "time":
+            return ("time.sleep()", eff)
+        if verb in ("device_get", "block_until_ready"):
+            rem = {lk for lk in eff if not _DEVICE_LOCK.search(lk)}
+            return (f"device sync {verb}()", rem) if rem else None
+        if (verb in _SOCK_METHODS or verb == "send") and \
+                (_SOCK_HINT.search(rname) or ctor == "socket"):
+            # the NAME must say socket: bare .accept()/.recv() also
+            # live on prefix indexes, kv caches, channels...
+            rem = {lk for lk in eff if not _IO_LOCK.search(lk)}
+            return (f"socket {rname or '<sock>'}.{verb}()", rem) \
+                if rem else None
+        if verb == "create_connection" and root == "socket":
+            rem = {lk for lk in eff if not _IO_LOCK.search(lk)}
+            return ("socket connect (create_connection)", rem) \
+                if rem else None
+        if verb in ("get", "put") and \
+                (ctor in _QUEUE_CTORS or _QUEUE_HINT.search(rname)):
+            if ctor is not None and ctor not in _QUEUE_CTORS:
+                return None  # known non-queue attr (e.g. a dict)
+            if verb == "put" and a not in info.bounded_queues:
+                # put() only blocks when the queue has a maxsize; an
+                # unbounded (or unknowable) queue's put never waits
+                return None
+            if _has_real_timeout(call):
+                return None
+            if any(kw.arg == "block" and _const_false(kw.value)
+                   for kw in call.keywords):
+                return None
+            pos = 0 if verb == "get" else 1
+            if len(call.args) > pos and _const_false(call.args[pos]):
+                return None
+            return (f"queue {rname}.{verb}() with no timeout", eff)
+        if verb == "join":
+            if ctor == "Thread" or a in info.threads or \
+                    (ctor is None and _THREAD_HINT.search(rname)):
+                return (f"Thread {rname}.join()", eff)
+            if ctor in _QUEUE_CTORS:
+                return (f"queue {rname}.join()", eff)
+            return None
+        if verb == "wait":
+            if ctor == "Event":
+                return (f"Event self.{a}.wait()", eff)
+            if ctor is None and _PROC_HINT.search(rname):
+                return (f"process {rname}.wait()", eff)
+            return None
+        if verb == "communicate":
+            return (f"process {rname}.communicate()", eff)
+        if verb in ("run", "check_call", "check_output", "call") and \
+                root == "subprocess":
+            return (f"subprocess.{verb}()", eff)
+        return None
+
+    # -- GL302 -------------------------------------------------------------
+    def _check_gl302(self, cls: _Class, info: _ClassInfo,
+                     rel: str) -> None:
+        for lineno, mname in getattr(info, "dropped", []):
+            self.findings.append(Finding(
+                rel, lineno, "GL302",
+                f"non-daemon thread started in {cls.name}.{mname} is "
+                f"neither stored, joined, nor daemon=True — it "
+                f"outlives the request with no owner and no stop path"))
+        leaked = {attr: rec for attr, rec in info.threads.items()
+                  if rec[2] and not rec[1]}  # started, not daemon
+        if not leaked:
+            return
+        joined = self._teardown_joined(cls)
+        for attr, (lineno, _, _) in sorted(leaked.items()):
+            if attr in joined:
+                continue
+            self.findings.append(Finding(
+                rel, lineno, "GL302",
+                f"thread self.{attr} started in {cls.name} is never "
+                f"join()ed from a teardown path (close/shutdown/stop/"
+                f"__exit__ or a method they call) — it outlives the "
+                f"owner; join it on close or declare daemon=True with "
+                f"a wake mechanism"))
+
+    def _teardown_joined(self, cls: _Class) -> set[str]:
+        """Self attrs join()ed from any method reachable from a
+        teardown-named method via self-calls."""
+        reach = {n for n in cls.methods if _TEARDOWN_RE.match(n)}
+        frontier = list(reach)
+        while frontier:
+            m = cls.methods.get(frontier.pop())
+            if m is None:
+                continue
+            for callee, _, _ in m.self_calls:
+                if callee in cls.methods and callee not in reach:
+                    reach.add(callee)
+                    frontier.append(callee)
+        joined: set[str] = set()
+        for n in reach:
+            joined |= self._joined_attrs(cls.methods[n].node)
+        return joined
+
+    def _joined_attrs(self, node: ast.AST) -> set[str]:
+        out: set[str] = set()
+        # local-name -> self attrs it may refer to (for-loop targets
+        # over self._threads, `t = self._thread` aliases, .pop() pulls)
+        aliases: dict[str, set[str]] = {}
+        for n in ast.walk(node):
+            if isinstance(n, (ast.For, ast.AsyncFor)) and \
+                    isinstance(n.target, ast.Name):
+                attrs = {a for sub in ast.walk(n.iter)
+                         if (a := _self_attr(sub)) is not None}
+                if attrs:
+                    aliases.setdefault(n.target.id, set()).update(attrs)
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name):
+                attrs = {a for sub in ast.walk(n.value)
+                         if (a := _self_attr(sub)) is not None}
+                if attrs:
+                    aliases.setdefault(n.targets[0].id,
+                                       set()).update(attrs)
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr == "join":
+                recv = n.func.value
+                a = _recv_self_attr(recv)
+                if a is not None:
+                    out.add(a)
+                elif isinstance(recv, ast.Name):
+                    out |= aliases.get(recv.id, set())
+        return out
+
+    # -- GL304 finish ------------------------------------------------------
+    def _check_gl304(self) -> None:
+        # "unregistered" is only decidable when the run INCLUDES a
+        # registration surface (metrics.py): a single-module run sees
+        # no new_* calls at all, and flagging every emit there would
+        # be noise, not analysis
+        for e in self._emits if self._registered else ():
+            for name in sorted(e.names - self._registered):
+                self.findings.append(Finding(
+                    e.rel, e.line, "GL304",
+                    f"metric '{name}' is emitted here but never "
+                    f"registered (no new_counter/new_histogram/"
+                    f"new_gauge/new_updown_counter anywhere in the "
+                    f"run) — register it in "
+                    f"metrics.register_framework_metrics or delete "
+                    f"the emit"))
+        by_name: dict[str, list[_Emit]] = {}
+        for e in self._emits:
+            if e.verb in _CONSISTENCY_VERBS and not e.starstar and \
+                    len(e.names) == 1:
+                by_name.setdefault(next(iter(e.names)), []).append(e)
+        for name, sites in sorted(by_name.items()):
+            variants = {}
+            for e in sites:
+                variants.setdefault(e.labels, []).append(e)
+            if len(variants) < 2:
+                continue
+            # majority label set wins; every divergent site is flagged
+            best = sorted(variants.items(),
+                          key=lambda kv: (-len(kv[1]),
+                                          sorted(kv[0])))[0][0]
+            n_best = len(variants[best])
+            for labels, es in sorted(variants.items(),
+                                     key=lambda kv: sorted(kv[0])):
+                if labels == best:
+                    continue
+                for e in es:
+                    self.findings.append(Finding(
+                        e.rel, e.line, "GL304",
+                        f"metric '{name}' emitted with label keys "
+                        f"{{{', '.join(sorted(labels)) or ''}}} here "
+                        f"but {{{', '.join(sorted(best))}}} at "
+                        f"{n_best} other site(s) — per-metric label "
+                        f"keys must be one consistent set"))
+
+
+def _param_names(fn) -> set[str]:
+    a = fn.args
+    names = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg is not None:
+        names.add(a.vararg.arg)
+    if a.kwarg is not None:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def _literal_bindings(fn, name: str) -> set[str] | None:
+    """The literal strings ``name`` may hold inside ``fn``, or None if
+    any binding is unresolvable (a computed name)."""
+    out: set[str] = set()
+    found = False
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == name
+                   for t in node.targets):
+            continue
+        found = True
+        vals = [node.value]
+        if isinstance(node.value, ast.IfExp):
+            vals = [node.value.body, node.value.orelse]
+        for v in vals:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.add(v.value)
+            else:
+                return None
+    return out if found else None

@@ -85,6 +85,10 @@ class _Method:
         self.obj_calls: list[tuple[str, frozenset[str], int]] = []
         # (lock, held-before frozenset, lineno)
         self.acquires: list[tuple[str, frozenset[str], int]] = []
+        # EVERY call expression with the lexical held set at the site —
+        # consumed by the dist pass (GL301 blocking-under-lock), which
+        # adds the inherited/annotated locks after the fixpoint
+        self.calls: list[tuple[ast.Call, frozenset[str]]] = []
         self.annotated: frozenset[str] = frozenset()
         self.inherited: frozenset[str] = frozenset()
         self.construction_only = False  # called only from __init__/__del__
@@ -267,6 +271,7 @@ class _MethodWalker:
             if not isinstance(n, ast.Call):
                 continue
             call_funcs.add(id(n.func))
+            self.meth.calls.append((n, held))
             f = n.func
             if isinstance(f, ast.Attribute):
                 recv_attr = _self_attr(f.value)

@@ -28,7 +28,8 @@ runs JUST the style pass with the same `# noqa` semantics:
 EVERY rule honors `# noqa` (suppress the line) and `# noqa: CODE[,..]`
 (suppress the listed codes) — suppression is applied centrally in
 gofrlint, not per rule. For the full analyzer (lock discipline GL001/
-GL002, TPU hot-path GL101-GL103, baseline workflow) run
+GL002, TPU hot-path GL101-GL103, resource lifetime GL201-GL204,
+distributed safety GL301-GL304, baseline workflow) run
 `python -m tools.gofrlint` — see docs/advanced-guide/static-analysis.md.
 
 Usage: python tools/lint.py [paths...]   (default: the repo)
